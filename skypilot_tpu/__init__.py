@@ -1,0 +1,59 @@
+"""skypilot_tpu: a TPU-native sky-computing framework.
+
+Declarative Task/Resources API + cost optimizer + TPU pod-slice gang
+provisioning on GCP with zone/slice failover, an on-slice job queue
+("podlet"), managed (preemptible) jobs with checkpoint/resume recovery, and
+an autoscaled serving plane — plus a JAX/XLA-native compute stack (models,
+pallas ops, mesh parallelism, training and serving engines).
+
+Public surface parity: sky/__init__.py:139-199.
+"""
+__version__ = '0.1.0'
+
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+__all__ = [
+    'Dag',
+    'Resources',
+    'Task',
+    '__version__',
+]
+
+
+def __getattr__(name):
+    """Lazy re-exports: keep `import skypilot_tpu` fast (no jax/pandas)."""
+    _lazy = {
+        # execution
+        'launch': ('skypilot_tpu.execution', 'launch'),
+        'exec': ('skypilot_tpu.execution', 'exec_'),
+        'optimize': ('skypilot_tpu.optimizer', 'optimize'),
+        # core ops
+        'status': ('skypilot_tpu.core', 'status'),
+        'start': ('skypilot_tpu.core', 'start'),
+        'stop': ('skypilot_tpu.core', 'stop'),
+        'down': ('skypilot_tpu.core', 'down'),
+        'autostop': ('skypilot_tpu.core', 'autostop'),
+        'queue': ('skypilot_tpu.core', 'queue'),
+        'cancel': ('skypilot_tpu.core', 'cancel'),
+        'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+        'download_logs': ('skypilot_tpu.core', 'download_logs'),
+        'cost_report': ('skypilot_tpu.core', 'cost_report'),
+        'storage_ls': ('skypilot_tpu.core', 'storage_ls'),
+        'storage_delete': ('skypilot_tpu.core', 'storage_delete'),
+        # planes
+        'jobs': ('skypilot_tpu', 'jobs'),
+        'serve': ('skypilot_tpu', 'serve'),
+        # optimizer enum
+        'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
+        'ClusterStatus': ('skypilot_tpu.status_lib', 'ClusterStatus'),
+    }
+    if name in _lazy:
+        import importlib
+        module, attr = _lazy[name]
+        mod = importlib.import_module(module)
+        if attr == name and module == 'skypilot_tpu':
+            return importlib.import_module(f'skypilot_tpu.{name}')
+        return getattr(mod, attr)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,252 @@
+"""TPU-first service catalog: accelerator ⇄ topology ⇄ price ⇄ zone lookups.
+
+Parity: sky/clouds/service_catalog/ (LazyDataFrame, read_catalog,
+get_instance_type_for_accelerator, list_accelerators, get_tpus) — reduced to
+the GCP TPU + controller-VM catalog that a TPU-native framework needs, with
+the slice (not the VM) as the unit the optimizer reasons about.
+
+CSVs are checked in under ``catalog/data/`` and regenerable with
+``python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp``.  A user-local
+override dir ``$SKYTPU_HOME/catalogs/`` takes precedence when present
+(mirrors the reference's ~/.sky/catalogs cache).
+"""
+import dataclasses
+import functools
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'data')
+
+# Accepted accelerator spellings: 'tpu-v5e-8', 'v5e-8', 'tpu-v5litepod-8'.
+_ACC_RE = re.compile(r'^(?:tpu-)?(v\d+[a-z]*|v5litepod)-(\d+)$')
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceInfo:
+    """Static facts about one TPU slice shape (the atomic scheduling unit)."""
+    accelerator: str          # canonical 'tpu-v5e-8'
+    generation: str           # 'v5e'
+    chips: int                # total chips in the slice
+    hosts: int                # TPU VM hosts backing the slice
+    chips_per_host: int
+    topology: str             # e.g. '2x4'
+    runtime_version: str      # default TPU software version
+    tflops_bf16_per_chip: float
+    hbm_gb_per_chip: float
+
+    @property
+    def total_tflops_bf16(self) -> float:
+        return self.tflops_bf16_per_chip * self.chips
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.hosts > 1
+
+
+def canonicalize(accelerator: str) -> str:
+    """'v5e-8' / 'tpu-v5litepod-8' -> 'tpu-v5e-8'. Raises on bad syntax."""
+    m = _ACC_RE.match(accelerator.strip().lower())
+    if m is None:
+        raise exceptions.InvalidResourcesError(
+            f'Invalid TPU accelerator string: {accelerator!r}. Expected '
+            f"e.g. 'tpu-v5e-8', 'v4-32', 'tpu-v6e-256'.")
+    gen, size = m.group(1), int(m.group(2))
+    if gen == 'v5litepod':
+        gen = 'v5e'
+    return f'tpu-{gen}-{size}'
+
+
+def _read_csv(name: str):
+    import pandas as pd  # lazy: pandas import is slow
+    user_path = os.path.join(common.catalogs_dir(), name)
+    path = user_path if os.path.exists(user_path) else os.path.join(
+        _DATA_DIR, name)
+    return pd.read_csv(path)
+
+
+@functools.lru_cache(maxsize=None)
+def _tpu_df():
+    return _read_csv('gcp_tpus.csv')
+
+
+@functools.lru_cache(maxsize=None)
+def _vm_df():
+    return _read_csv('gcp_vms.csv')
+
+
+def clear_cache() -> None:
+    _tpu_df.cache_clear()
+    _vm_df.cache_clear()
+
+
+# ------------------------------------------------------------------- TPUs
+
+
+def get_slice_info(accelerator: str) -> SliceInfo:
+    acc = canonicalize(accelerator)
+    df = _tpu_df()
+    rows = df[df['accelerator'] == acc]
+    if rows.empty:
+        raise exceptions.InvalidResourcesError(
+            f'TPU accelerator {acc!r} not found in catalog. '
+            f'Run `skytpu show-tpus` to list available types.')
+    r = rows.iloc[0]
+    return SliceInfo(accelerator=acc,
+                     generation=r['generation'],
+                     chips=int(r['chips']),
+                     hosts=int(r['hosts']),
+                     chips_per_host=int(r['chips_per_host']),
+                     topology=r['topology'],
+                     runtime_version=r['runtime_version'],
+                     tflops_bf16_per_chip=float(r['tflops_bf16_per_chip']),
+                     hbm_gb_per_chip=float(r['hbm_gb_per_chip']))
+
+
+def accelerator_exists(accelerator: str) -> bool:
+    try:
+        get_slice_info(accelerator)
+        return True
+    except exceptions.InvalidResourcesError:
+        return False
+
+
+def get_hourly_cost(accelerator: str,
+                    use_spot: bool = False,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    """$/hr for the whole slice (cheapest matching zone if unpinned)."""
+    acc = canonicalize(accelerator)
+    df = _tpu_df()
+    rows = df[df['accelerator'] == acc]
+    if region is not None:
+        rows = rows[rows['region'] == region]
+    if zone is not None:
+        rows = rows[rows['zone'] == zone]
+    if rows.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'{acc} not offered in region={region} zone={zone}.')
+    col = 'spot_price' if use_spot else 'price'
+    return float(rows[col].min())
+
+
+def get_regions_zones(accelerator: str) -> List[Tuple[str, str]]:
+    """All (region, zone) pairs offering the slice, cheapest first."""
+    acc = canonicalize(accelerator)
+    df = _tpu_df()
+    rows = df[df['accelerator'] == acc].sort_values('price')
+    return list(zip(rows['region'], rows['zone']))
+
+
+def validate_region_zone(accelerator: str, region: Optional[str],
+                         zone: Optional[str]) -> None:
+    pairs = get_regions_zones(accelerator)
+    if zone is not None and zone not in [z for _, z in pairs]:
+        raise exceptions.ResourcesUnavailableError(
+            f'{canonicalize(accelerator)} is not offered in zone {zone!r}. '
+            f'Available zones: {sorted({z for _, z in pairs})}')
+    if region is not None and region not in [r for r, _ in pairs]:
+        raise exceptions.ResourcesUnavailableError(
+            f'{canonicalize(accelerator)} is not offered in region '
+            f'{region!r}. Available regions: {sorted({r for r, _ in pairs})}')
+    if (zone is not None and region is not None and
+            (region, zone) not in pairs):
+        raise exceptions.ResourcesUnavailableError(
+            f'Zone {zone!r} is not in region {region!r} for '
+            f'{canonicalize(accelerator)}.')
+
+
+def list_accelerators(
+        gpus_only: bool = False,  # signature parity; TPUs only here
+        name_filter: Optional[str] = None) -> Dict[str, List[SliceInfo]]:
+    """generation -> [SliceInfo] for every slice shape in the catalog."""
+    del gpus_only
+    df = _tpu_df()
+    out: Dict[str, List[SliceInfo]] = {}
+    for acc in df['accelerator'].unique():
+        if name_filter and name_filter.lower() not in acc:
+            continue
+        info = get_slice_info(acc)
+        out.setdefault(info.generation, []).append(info)
+    for infos in out.values():
+        infos.sort(key=lambda i: i.chips)
+    return out
+
+
+def default_runtime_version(accelerator: str) -> str:
+    return get_slice_info(accelerator).runtime_version
+
+
+# ----------------------------------------------------------------- CPU VMs
+
+
+def get_vm_hourly_cost(instance_type: str,
+                       use_spot: bool = False,
+                       region: Optional[str] = None,
+                       zone: Optional[str] = None) -> float:
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if region is not None:
+        rows = rows[rows['region'] == region]
+    if zone is not None:
+        rows = rows[rows['zone'] == zone]
+    if rows.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'VM {instance_type} not offered in region={region} zone={zone}.')
+    col = 'spot_price' if use_spot else 'price'
+    return float(rows[col].min())
+
+
+def get_vm_for_cpus(cpus: Optional[str] = None,
+                    memory_gb: Optional[str] = None) -> Optional[str]:
+    """Cheapest VM satisfying '8' / '8+' cpu and memory constraints.
+
+    Parity: reference get_instance_type_for_cpus_mem_impl
+    (sky/clouds/service_catalog/common.py).
+    """
+    df = _vm_df().drop_duplicates('instance_type')
+
+    def _parse(spec):
+        if spec is None:
+            return None, True
+        s = str(spec)
+        return (float(s[:-1]), True) if s.endswith('+') else (float(s), False)
+
+    cpu_v, cpu_plus = _parse(cpus)
+    mem_v, mem_plus = _parse(memory_gb)
+    candidates = []
+    for _, r in df.iterrows():
+        if cpu_v is not None:
+            if cpu_plus and r['vcpus'] < cpu_v:
+                continue
+            if not cpu_plus and r['vcpus'] != cpu_v:
+                continue
+        if mem_v is not None:
+            if mem_plus and r['memory_gb'] < mem_v:
+                continue
+            if not mem_plus and r['memory_gb'] != mem_v:
+                continue
+        candidates.append((float(r['price']), r['instance_type']))
+    if not candidates:
+        return None
+    return min(candidates)[1]
+
+
+def get_vm_info(instance_type: str) -> Tuple[float, float]:
+    """(vcpus, memory_gb) for a VM type."""
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type]
+    if rows.empty:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown instance type {instance_type!r}.')
+    r = rows.iloc[0]
+    return float(r['vcpus']), float(r['memory_gb'])
+
+
+def get_vm_regions_zones(instance_type: str) -> List[Tuple[str, str]]:
+    df = _vm_df()
+    rows = df[df['instance_type'] == instance_type].sort_values('price')
+    return list(zip(rows['region'], rows['zone']))

@@ -1,0 +1,146 @@
+"""Regenerate the checked-in GCP TPU/VM catalog CSVs.
+
+Parity: the reference's catalog data_fetchers
+(sky/clouds/service_catalog/data_fetchers/fetch_gcp.py) query live cloud
+pricing APIs and emit CSVs consumed lazily at runtime.  This fetcher embeds a
+static snapshot (public GCP list prices, early 2025) because the build
+environment has no egress; with network access the `--live` path would query
+cloudbilling.googleapis.com and tpu.googleapis.com/acceleratorTypes instead.
+
+Run:  python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp
+"""
+import csv
+import os
+
+# generation -> (tflops_bf16/chip, hbm_gb/chip, $/chip-hr on-demand,
+#                $/chip-hr spot, chips/host in multi-host slices,
+#                max chips on a single host, cores-per-chip naming factor)
+# vN naming: v2/v3/v4/v5p sizes count TensorCores (2 cores/chip);
+# v5e (v5litepod) and v6e sizes count chips.  (Matches GCP naming.)
+_GENERATIONS = {
+    'v2': dict(tflops=23, hbm=8, price=1.125, spot=0.36, chips_per_host=4,
+               single_host_chips=4, cores_per_chip=2,
+               sizes=[8, 32, 128, 256, 512]),
+    'v3': dict(tflops=61, hbm=16, price=2.00, spot=0.64, chips_per_host=4,
+               single_host_chips=4, cores_per_chip=2,
+               sizes=[8, 32, 64, 128, 256, 512, 1024, 2048]),
+    'v4': dict(tflops=137.5, hbm=32, price=3.22, spot=1.13, chips_per_host=4,
+               single_host_chips=4, cores_per_chip=2,
+               sizes=[8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096]),
+    'v5p': dict(tflops=229.5, hbm=95, price=4.20, spot=1.89, chips_per_host=4,
+                single_host_chips=4, cores_per_chip=2,
+                sizes=[8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                       12288]),
+    'v5e': dict(tflops=196.8, hbm=16, price=1.20, spot=0.54, chips_per_host=4,
+                single_host_chips=8, cores_per_chip=1,
+                sizes=[1, 4, 8, 16, 32, 64, 128, 256]),
+    'v6e': dict(tflops=918, hbm=32, price=2.70, spot=1.22, chips_per_host=4,
+                single_host_chips=8, cores_per_chip=1,
+                sizes=[1, 4, 8, 16, 32, 64, 128, 256]),
+}
+
+# generation -> [(region, zone, price_multiplier)]
+_ZONES = {
+    'v2': [('us-central1', 'us-central1-b', 1.0),
+           ('us-central1', 'us-central1-f', 1.0),
+           ('europe-west4', 'europe-west4-a', 1.09),
+           ('asia-east1', 'asia-east1-c', 1.13)],
+    'v3': [('us-central1', 'us-central1-a', 1.0),
+           ('us-central1', 'us-central1-b', 1.0),
+           ('europe-west4', 'europe-west4-a', 1.09)],
+    'v4': [('us-central2', 'us-central2-b', 1.0)],
+    'v5p': [('us-east5', 'us-east5-a', 1.0),
+            ('us-central1', 'us-central1-a', 1.0),
+            ('europe-west4', 'europe-west4-b', 1.06)],
+    'v5e': [('us-central1', 'us-central1-a', 1.0),
+            ('us-west4', 'us-west4-a', 1.0),
+            ('us-east1', 'us-east1-c', 1.0),
+            ('us-east5', 'us-east5-b', 1.0),
+            ('europe-west4', 'europe-west4-b', 1.08),
+            ('asia-southeast1', 'asia-southeast1-b', 1.12)],
+    'v6e': [('us-east5', 'us-east5-b', 1.0),
+            ('us-east1', 'us-east1-d', 1.0),
+            ('us-central2', 'us-central2-b', 1.0),
+            ('europe-west4', 'europe-west4-a', 1.06),
+            ('asia-northeast1', 'asia-northeast1-b', 1.14)],
+}
+
+# TPU software versions (accelerator_args.runtime_version default).
+_RUNTIME_VERSIONS = {
+    'v2': 'tpu-ubuntu2204-base',
+    'v3': 'tpu-ubuntu2204-base',
+    'v4': 'tpu-ubuntu2204-base',
+    'v5p': 'v2-alpha-tpuv5',
+    'v5e': 'v2-alpha-tpuv5-lite',
+    'v6e': 'v2-alpha-tpuv6e',
+}
+
+# Controller-grade CPU VMs (vcpus, mem_gb, $/hr on-demand, $/hr spot).
+_VMS = [
+    ('n2-standard-4', 4, 16, 0.1942, 0.047),
+    ('n2-standard-8', 8, 32, 0.3885, 0.094),
+    ('n2-standard-16', 16, 64, 0.7769, 0.189),
+    ('n2-standard-32', 32, 128, 1.5539, 0.377),
+    ('e2-standard-4', 4, 16, 0.1340, 0.040),
+    ('e2-standard-8', 8, 32, 0.2681, 0.080),
+    ('e2-medium', 2, 4, 0.0335, 0.010),
+]
+_VM_ZONES = [('us-central1', 'us-central1-a'), ('us-central1', 'us-central1-b'),
+             ('us-east1', 'us-east1-c'), ('us-east5', 'us-east5-a'),
+             ('us-east5', 'us-east5-b'), ('us-west4', 'us-west4-a'),
+             ('us-central2', 'us-central2-b'),
+             ('europe-west4', 'europe-west4-a'),
+             ('europe-west4', 'europe-west4-b')]
+
+
+def _topology(gen: str, chips: int, chips_per_host: int) -> str:
+    """Human-readable physical topology (approximate for the snapshot)."""
+    if chips <= 8:
+        return {1: '1x1', 4: '2x2', 8: '2x4'}.get(chips, f'{chips}')
+    # Multi-host slices: report hosts x chips-per-host grid.
+    return f'{chips // chips_per_host}x{chips_per_host}'
+
+
+def generate(out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    tpu_path = os.path.join(out_dir, 'gcp_tpus.csv')
+    with open(tpu_path, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow([
+            'accelerator', 'generation', 'chips', 'hosts', 'chips_per_host',
+            'topology', 'runtime_version', 'tflops_bf16_per_chip',
+            'hbm_gb_per_chip', 'price', 'spot_price', 'region', 'zone'
+        ])
+        for gen, info in _GENERATIONS.items():
+            for size in info['sizes']:
+                chips = size // info['cores_per_chip']
+                if chips <= info['single_host_chips']:
+                    hosts, cph = 1, chips
+                else:
+                    cph = info['chips_per_host']
+                    hosts = chips // cph
+                acc = f'tpu-{gen}-{size}'
+                for region, zone, mult in _ZONES[gen]:
+                    price = round(info['price'] * chips * mult, 2)
+                    spot = round(info['spot'] * chips * mult, 2)
+                    w.writerow([
+                        acc, gen, chips, hosts, cph,
+                        _topology(gen, chips, cph), _RUNTIME_VERSIONS[gen],
+                        info['tflops'], info['hbm'], price, spot, region, zone
+                    ])
+    vm_path = os.path.join(out_dir, 'gcp_vms.csv')
+    with open(vm_path, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow([
+            'instance_type', 'vcpus', 'memory_gb', 'price', 'spot_price',
+            'region', 'zone'
+        ])
+        for name, vcpus, mem, price, spot in _VMS:
+            for region, zone in _VM_ZONES:
+                w.writerow([name, vcpus, mem, price, spot, region, zone])
+    print(f'Wrote {tpu_path} and {vm_path}')
+
+
+if __name__ == '__main__':
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    generate(os.path.join(here, 'data'))

@@ -1,0 +1,98 @@
+"""Layered user configuration (``~/.skytpu/config.yaml``).
+
+Parity: sky/skypilot_config.py:84-257 — nested dot-path get/set, loaded once
+at import, overridable via the ``SKYTPU_CONFIG`` env var.  Example::
+
+    gcp:
+      project_id: my-project
+    jobs:
+      controller:
+        resources:
+          cpus: 8+
+    serve:
+      controller:
+        resources:
+          cloud: gcp
+"""
+import copy
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import yaml
+
+from skypilot_tpu import logsys
+from skypilot_tpu.utils import common
+
+logger = logsys.init_logger(__name__)
+
+ENV_VAR_CONFIG_PATH = 'SKYTPU_CONFIG'
+
+_dict: Optional[Dict[str, Any]] = None
+_loaded_path: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _config_path() -> str:
+    env = os.environ.get(ENV_VAR_CONFIG_PATH)
+    if env:
+        return os.path.expanduser(env)
+    return os.path.join(common.home_dir(), 'config.yaml')
+
+
+def _load() -> Dict[str, Any]:
+    global _dict, _loaded_path
+    path = _config_path()
+    with _lock:
+        if _dict is not None and _loaded_path == path:
+            return _dict
+        _dict = {}
+        _loaded_path = path
+        if os.path.exists(path):
+            try:
+                with open(path, 'r', encoding='utf-8') as f:
+                    loaded = yaml.safe_load(f)
+                if loaded is not None:
+                    if not isinstance(loaded, dict):
+                        raise ValueError(
+                            f'Config file {path} must contain a mapping.')
+                    _dict = loaded
+            except yaml.YAMLError as e:
+                raise ValueError(f'Invalid config YAML at {path}: {e}') from e
+        return _dict
+
+
+def reload() -> None:
+    """Force re-read (tests change SKYTPU_HOME / SKYTPU_CONFIG)."""
+    global _dict, _loaded_path
+    with _lock:
+        _dict = None
+        _loaded_path = None
+
+
+def get_nested(keys, default_value: Any = None) -> Any:
+    """config.get_nested(('jobs','controller','resources')) style lookup."""
+    cur: Any = _load()
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default_value
+        cur = cur[k]
+    return copy.deepcopy(cur)
+
+
+def set_nested(keys, value: Any) -> Dict[str, Any]:
+    """Return a copy of the config with keys set (does not persist)."""
+    base = copy.deepcopy(_load())
+    cur = base
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    cur[keys[-1]] = value
+    return base
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_load())
+
+
+def loaded() -> bool:
+    return bool(_load())

@@ -1,0 +1,81 @@
+"""Task DAG: a thin DiGraph wrapper with a thread-local ``with Dag():``
+context.  Parity: sky/dag.py:11 (Dag, _DagContext)."""
+import threading
+from typing import List, Optional
+
+
+class Dag:
+    """A DAG of Tasks; edges mean 'downstream consumes upstream outputs'."""
+
+    def __init__(self, name: Optional[str] = None):
+        import networkx as nx  # lazy
+        self.name = name
+        self.graph = nx.DiGraph()
+        self.tasks: List = []
+
+    def add(self, task) -> None:
+        if task not in self.tasks:
+            self.graph.add_node(task)
+            self.tasks.append(task)
+
+    def remove(self, task) -> None:
+        self.graph.remove_node(task)
+        self.tasks.remove(task)
+
+    def add_edge(self, op1, op2) -> None:
+        assert op1 in self.graph.nodes and op2 in self.graph.nodes, (
+            'Add both tasks to the DAG first.')
+        self.graph.add_edge(op1, op2)
+
+    def get_graph(self):
+        return self.graph
+
+    def is_chain(self) -> bool:
+        """True for linear pipelines (enables the cheap DP optimizer)."""
+        import networkx as nx
+        if len(self.tasks) <= 1:
+            return True
+        degrees = [self.graph.degree(t) for t in self.tasks]
+        return (nx.is_weakly_connected(self.graph) and
+                all(d <= 2 for d in degrees) and
+                sum(1 for d in degrees if d == 1) == 2)
+
+    def topological_order(self) -> List:
+        import networkx as nx
+        return list(nx.topological_sort(self.graph))
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        _push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        _pop_dag()
+
+    def __repr__(self) -> str:
+        return f'<Dag {self.name or ""}: {len(self.tasks)} task(s)>'
+
+
+_context = threading.local()
+
+
+def _stack() -> List[Dag]:
+    if not hasattr(_context, 'stack'):
+        _context.stack = []
+    return _context.stack
+
+
+def _push_dag(dag: Dag) -> None:
+    _stack().append(dag)
+
+
+def _pop_dag() -> Optional[Dag]:
+    s = _stack()
+    return s.pop() if s else None
+
+
+def get_current_dag() -> Optional[Dag]:
+    s = _stack()
+    return s[-1] if s else None

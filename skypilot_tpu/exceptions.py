@@ -1,0 +1,173 @@
+"""Exception hierarchy for skypilot_tpu.
+
+Parity target: the reference's exception set (``sky/exceptions.py``) — we keep
+the same *failure taxonomy* (provision failover, cluster lifecycle, identity,
+storage, command execution) but TPU-first: provisioning failures are described
+at pod-slice granularity.
+"""
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidTaskError(SkyTpuError):
+    """Task YAML / Task object is malformed."""
+
+
+class InvalidResourcesError(SkyTpuError):
+    """Resources spec is malformed or inconsistent."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No feasible placement (or all candidates exhausted during failover).
+
+    Mirrors the role of the reference's ResourcesUnavailableError raised by
+    the failover provisioner (sky/backends/cloud_vm_ray_backend.py:1934).
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None):
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match an existing cluster's resources."""
+
+
+class ProvisionError(SkyTpuError):
+    """A single provisioning attempt failed.
+
+    ``blocked_resources`` carries (zone/accelerator)-granular Resources that
+    the failover loop should not retry — the analog of the reference's
+    blocklist mechanism (FailoverCloudErrorHandlerV2,
+    sky/backends/cloud_vm_ray_backend.py:914).
+    """
+
+    def __init__(self, message: str, blocked_resources=None,
+                 retryable: bool = True):
+        super().__init__(message)
+        self.blocked_resources = blocked_resources or []
+        self.retryable = retryable
+
+
+class TpuStockoutError(ProvisionError):
+    """The zone has no capacity for the requested slice (dominant TPU failure)."""
+
+
+class QuotaExceededError(ProvisionError):
+    """Project quota prevents creating the slice anywhere in the region."""
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None):
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster is not in the local state DB."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Active cloud identity differs from the cluster creator's identity.
+
+    Parity: reference check_owner_identity (sky/backends/backend_utils.py:1421).
+    """
+
+
+class NotSupportedError(SkyTpuError):
+    """Requested feature is unsupported for this cloud / accelerator."""
+
+
+class CommandError(SkyTpuError):
+    """A remote or local command exited non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: str = ''):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        cmd = command if len(command) < 150 else command[:150] + '...'
+        super().__init__(
+            f'Command {cmd!r} failed with return code {returncode}.'
+            f' {error_msg}')
+
+
+class JobError(SkyTpuError):
+    """On-slice job failed."""
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in the podlet job table."""
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted its recovery budget."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Managed job is in an unexpected state for the requested operation."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was terminated by the user mid-operation."""
+
+
+class StorageError(SkyTpuError):
+    """Base for storage subsystem errors."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageSourceError(StorageError):
+    """Local/remote storage source is invalid."""
+
+
+class StorageModeError(StorageError):
+    """Unsupported storage mode for this store."""
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled / credentials missing."""
+
+
+class CloudUserIdentityError(SkyTpuError):
+    """Failed to determine the active cloud identity."""
+
+
+class ApiError(SkyTpuError):
+    """Cloud API returned an error we could not classify."""
+
+
+class AutostopError(SkyTpuError):
+    """Autostop configuration / execution failed."""
+
+
+class NetworkError(SkyTpuError):
+    """Transient network failure talking to a cloud API or a host."""

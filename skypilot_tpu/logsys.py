@@ -1,0 +1,71 @@
+"""Logging setup for skypilot_tpu.
+
+Parity target: reference sky/sky_logging.py (init_logger, env-gated debug).
+"""
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_root_name = 'skypilot_tpu'
+_setup_lock = threading.Lock()
+_initialized = False
+
+
+def _debug_enabled() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '0') not in ('0', '', 'false')
+
+
+class _NoColorFormatter(logging.Formatter):
+    pass
+
+
+def _setup_root():
+    global _initialized
+    with _setup_lock:
+        if _initialized:
+            return
+        root = logging.getLogger(_root_name)
+        root.setLevel(logging.DEBUG if _debug_enabled() else logging.INFO)
+        handler = logging.StreamHandler(sys.stdout)
+        handler.flush = sys.stdout.flush  # type: ignore[method-assign]
+        if _debug_enabled():
+            handler.setFormatter(
+                _NoColorFormatter(_FORMAT, datefmt=_DATE_FORMAT))
+        else:
+            handler.setFormatter(_NoColorFormatter('%(message)s'))
+        root.addHandler(handler)
+        root.propagate = False
+        _initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    """Return a child logger under the framework root logger."""
+    _setup_root()
+    if not name.startswith(_root_name):
+        name = f'{_root_name}.{name}'
+    return logging.getLogger(name)
+
+
+def logging_enabled(logger: logging.Logger, level: int) -> bool:
+    return logger.isEnabledFor(level)
+
+
+@contextlib.contextmanager
+def silent():
+    """Suppress INFO logs within the context (used by nested API calls)."""
+    root = logging.getLogger(_root_name)
+    prev = root.level
+    root.setLevel(logging.WARNING)
+    try:
+        yield
+    finally:
+        root.setLevel(prev)
+
+
+def is_silent() -> bool:
+    return logging.getLogger(_root_name).level > logging.INFO

@@ -1,0 +1,458 @@
+"""Hardware requirement model — TPU pod slices are the atomic unit.
+
+Parity: sky/resources.py:30 (``Resources``) with the reference's semantics —
+feasibility ordering (less_demanding_than), blocklist matching
+(should_be_blocked_by), YAML round-trip, cost estimation, deploy-variable
+generation — but re-designed for TPU-first placement: instead of
+(cloud, instance_type, accelerator-on-VM), the primary axis is
+(accelerator slice shape, zone, spot/reservation).  CPU-only VMs (for the
+jobs/serve controllers) are the secondary axis via instance_type/cpus.
+"""
+import textwrap
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import ux
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+class Resources:
+    """A (possibly partial) hardware requirement.
+
+    Examples::
+
+        Resources(accelerator='tpu-v5e-8')
+        Resources(accelerator='v6e-64', zone='us-east5-b', use_spot=True)
+        Resources(cloud='gcp', cpus='8+')              # controller VM
+        Resources(cloud='local')                        # dev/test backend
+    """
+
+    _VERSION = 1
+
+    def __init__(
+        self,
+        cloud: Optional[str] = None,
+        accelerator: Optional[str] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        cpus: Optional[Union[int, float, str]] = None,
+        memory: Optional[Union[int, float, str]] = None,
+        instance_type: Optional[str] = None,
+        use_spot: bool = False,
+        job_recovery: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        image_id: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        ports: Optional[List[Union[int, str]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        reservation: Optional[str] = None,
+        autostop: Optional[Dict[str, Any]] = None,
+    ):
+        self._version = self._VERSION
+        self._cloud = cloud.lower() if cloud else None
+        self._accelerator: Optional[str] = None
+        if accelerator is not None:
+            self._accelerator = catalog.canonicalize(accelerator)
+            if self._cloud is None:
+                self._cloud = 'gcp'
+        self._accelerator_args = dict(accelerator_args or {})
+        self._cpus = str(cpus) if cpus is not None else None
+        self._memory = str(memory) if memory is not None else None
+        self._instance_type = instance_type
+        self._use_spot = bool(use_spot)
+        self._job_recovery = job_recovery
+        self._region = region
+        self._zone = zone
+        self._image_id = image_id
+        self._disk_size = int(disk_size) if disk_size else _DEFAULT_DISK_SIZE_GB
+        self._ports = [str(p) for p in ports] if ports else None
+        self._labels = dict(labels) if labels else None
+        self._reservation = reservation
+        self._autostop = autostop
+        self._validate()
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud
+
+    @property
+    def accelerator(self) -> Optional[str]:
+        return self._accelerator
+
+    @property
+    def accelerator_args(self) -> Dict[str, Any]:
+        return self._accelerator_args
+
+    @property
+    def runtime_version(self) -> Optional[str]:
+        """TPU software version; catalog default when unspecified."""
+        if self._accelerator is None:
+            return None
+        rv = self._accelerator_args.get('runtime_version')
+        return rv or catalog.default_runtime_version(self._accelerator)
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def job_recovery(self) -> Optional[str]:
+        return self._job_recovery
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    @property
+    def reservation(self) -> Optional[str]:
+        return self._reservation
+
+    @property
+    def autostop(self) -> Optional[Dict[str, Any]]:
+        return self._autostop
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._accelerator is not None
+
+    @property
+    def slice_info(self) -> Optional[catalog.SliceInfo]:
+        if self._accelerator is None:
+            return None
+        return catalog.get_slice_info(self._accelerator)
+
+    @property
+    def num_hosts(self) -> int:
+        """Hosts per node: a multi-host slice is 1 node with many hosts.
+
+        Parity: the reference models the same thing as num_ips_per_node
+        (sky/backends/cloud_vm_ray_backend.py:2469).
+        """
+        info = self.slice_info
+        return info.hosts if info is not None else 1
+
+    @property
+    def chips_per_host(self) -> int:
+        info = self.slice_info
+        return info.chips_per_host if info is not None else 0
+
+    @property
+    def need_cleanup_after_preemption(self) -> bool:
+        """Preempted TPU slices must be deleted, not restarted.
+
+        Parity: sky/resources.py:622 (consulted by the managed-jobs
+        controller before relaunch, sky/jobs/controller.py:320-329).
+        """
+        return self.is_tpu and self._use_spot
+
+    # ------------------------------------------------------------ validation
+
+    def _validate(self) -> None:
+        if self._cloud is not None and self._cloud not in ('gcp', 'local'):
+            raise exceptions.InvalidResourcesError(
+                f'Unknown cloud {self._cloud!r}; supported: gcp, local.')
+        if self._accelerator is not None:
+            if self._instance_type is not None:
+                raise exceptions.InvalidResourcesError(
+                    'Cannot specify both accelerator and instance_type; the '
+                    'TPU slice shape determines its host VMs.')
+            if self._cloud == 'local':
+                # Local cloud simulates slices with processes; allow it for
+                # the dryrun/fake-cloud test tier.
+                pass
+            catalog.validate_region_zone(self._accelerator, self._region,
+                                         self._zone)
+            bad_keys = set(self._accelerator_args) - {
+                'runtime_version', 'network', 'subnetwork', 'best_effort',
+                'queued_resource',
+            }
+            if bad_keys:
+                raise exceptions.InvalidResourcesError(
+                    f'Unknown accelerator_args: {sorted(bad_keys)}')
+        for spec, name in ((self._cpus, 'cpus'), (self._memory, 'memory')):
+            if spec is None:
+                continue
+            body = spec[:-1] if spec.endswith('+') else spec
+            try:
+                float(body)
+            except ValueError:
+                raise exceptions.InvalidResourcesError(
+                    f'Invalid {name} spec {spec!r}; expected "8" or "8+".'
+                    ) from None
+        if self._ports:
+            for p in self._ports:
+                parts = p.split('-')
+                if not all(x.isdigit() for x in parts) or len(parts) > 2:
+                    raise exceptions.InvalidResourcesError(
+                        f'Invalid port spec {p!r}; expected "8080" or '
+                        f'"10000-10010".')
+
+    # ---------------------------------------------------------------- costs
+
+    def get_cost(self, seconds: float) -> float:
+        """Estimated $ for running this many seconds."""
+        hours = seconds / 3600.0
+        if self._cloud == 'local':
+            return 0.0
+        if self._accelerator is not None:
+            hourly = catalog.get_hourly_cost(self._accelerator,
+                                             use_spot=self._use_spot,
+                                             region=self._region,
+                                             zone=self._zone)
+        else:
+            instance = self._instance_type or catalog.get_vm_for_cpus(
+                self._cpus, self._memory)
+            if instance is None:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No VM type satisfies cpus={self._cpus} '
+                    f'memory={self._memory}.')
+            hourly = catalog.get_vm_hourly_cost(instance,
+                                                use_spot=self._use_spot,
+                                                region=self._region,
+                                                zone=self._zone)
+        return hourly * hours
+
+    # ---------------------------------------------------- feasibility order
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if self's requirements are satisfied by `other`'s concrete
+        resources.  Parity: sky/resources.py:1107."""
+        if self._cloud is not None and self._cloud != other._cloud:
+            return False
+        if self._region is not None and self._region != other._region:
+            return False
+        if self._zone is not None and self._zone != other._zone:
+            return False
+        if self._accelerator is not None:
+            if self._accelerator != other._accelerator:
+                return False
+            mine = self._accelerator_args.get('runtime_version')
+            theirs = other._accelerator_args.get('runtime_version')
+            if mine is not None and theirs is not None and mine != theirs:
+                return False
+        if self._use_spot != other._use_spot:
+            return False
+        if self._instance_type is not None:
+            if self._instance_type != other._instance_type:
+                return False
+
+        def _satisfies(spec: Optional[str], actual: Optional[float]) -> bool:
+            if spec is None:
+                return True
+            if actual is None:
+                return False
+            if spec.endswith('+'):
+                return actual >= float(spec[:-1])
+            return actual == float(spec)
+
+        if self._cpus is not None or self._memory is not None:
+            if other._instance_type is not None:
+                vcpus, mem = catalog.get_vm_info(other._instance_type)
+            elif other.is_tpu:
+                vcpus, mem = 96.0, 192.0  # TPU-VM hosts are large
+            else:
+                vcpus, mem = None, None
+            if not _satisfies(self._cpus, vcpus):
+                return False
+            if not _satisfies(self._memory, mem):
+                return False
+        if self._image_id is not None and self._image_id != other._image_id:
+            return False
+        if other._disk_size < self._disk_size:
+            return False
+        return True
+
+    def should_be_blocked_by(self, blocked: 'Resources') -> bool:
+        """Subset matching against a failover blocklist entry.
+
+        Parity: sky/resources.py:1207.  A blocked entry with a field set to
+        None matches any value of that field.
+        """
+        return ((blocked._cloud is None or blocked._cloud == self._cloud) and
+                (blocked._accelerator is None or
+                 blocked._accelerator == self._accelerator) and
+                (blocked._instance_type is None or
+                 blocked._instance_type == self._instance_type) and
+                (blocked._region is None or blocked._region == self._region)
+                and (blocked._zone is None or blocked._zone == self._zone) and
+                (blocked._use_spot == self._use_spot))
+
+    # ------------------------------------------------------------- mutation
+
+    def copy(self, **override) -> 'Resources':
+        fields = dict(
+            cloud=self._cloud,
+            accelerator=self._accelerator,
+            accelerator_args=dict(self._accelerator_args),
+            cpus=self._cpus,
+            memory=self._memory,
+            instance_type=self._instance_type,
+            use_spot=self._use_spot,
+            job_recovery=self._job_recovery,
+            region=self._region,
+            zone=self._zone,
+            image_id=self._image_id,
+            disk_size=self._disk_size,
+            ports=self._ports,
+            labels=self._labels,
+            reservation=self._reservation,
+            autostop=self._autostop,
+        )
+        fields.update(override)
+        return Resources(**fields)
+
+    # ------------------------------------------------------------ YAML i/o
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if config is None:
+            return cls()
+        config = dict(config)
+        known = {
+            'cloud', 'accelerator', 'accelerators', 'accelerator_args',
+            'cpus', 'memory', 'instance_type', 'use_spot', 'job_recovery',
+            'region', 'zone', 'image_id', 'disk_size', 'ports', 'labels',
+            'reservation', 'autostop', 'any_of'
+        }
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidTaskError(
+                f'Unknown resources fields: {sorted(unknown)}')
+        acc = config.pop('accelerator', None) or config.pop(
+            'accelerators', None)
+        if isinstance(acc, dict):
+            # reference-style {'V100': 4}; TPU slices are a single string
+            if len(acc) != 1:
+                raise exceptions.InvalidTaskError(
+                    'accelerators mapping must have exactly one entry')
+            acc = next(iter(acc))
+        ports = config.pop('ports', None)
+        if ports is not None and not isinstance(ports, list):
+            ports = [ports]
+        config.pop('any_of', None)  # handled by Task
+        return cls(accelerator=acc, ports=ports, **config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+
+        def put(k, v):
+            if v is not None and v != {} and v != []:
+                cfg[k] = v
+
+        put('cloud', self._cloud)
+        put('accelerator', self._accelerator)
+        put('accelerator_args', self._accelerator_args or None)
+        put('cpus', self._cpus)
+        put('memory', self._memory)
+        put('instance_type', self._instance_type)
+        if self._use_spot:
+            cfg['use_spot'] = True
+        put('job_recovery', self._job_recovery)
+        put('region', self._region)
+        put('zone', self._zone)
+        put('image_id', self._image_id)
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            cfg['disk_size'] = self._disk_size
+        put('ports', self._ports)
+        put('labels', self._labels)
+        put('reservation', self._reservation)
+        put('autostop', self._autostop)
+        return cfg
+
+    # ------------------------------------------------------------- dunders
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud:
+            parts.append(self._cloud.upper() if self._cloud == 'gcp' else
+                         self._cloud)
+        if self._accelerator:
+            spot = '[Spot]' if self._use_spot else ''
+            parts.append(f'{self._accelerator}{spot}')
+            info = self.slice_info
+            if info and info.is_multi_host:
+                parts.append(f'({info.hosts} hosts)')
+        elif self._instance_type:
+            spot = '[Spot]' if self._use_spot else ''
+            parts.append(f'{self._instance_type}{spot}')
+        else:
+            if self._cpus:
+                parts.append(f'cpus={self._cpus}')
+            if self._memory:
+                parts.append(f'mem={self._memory}')
+        if self._zone:
+            parts.append(f'zone={self._zone}')
+        elif self._region:
+            parts.append(f'region={self._region}')
+        return '<Resources: ' + ' '.join(parts or ['(empty)']) + '>'
+
+    def pretty(self) -> str:
+        if self._accelerator:
+            base = self._accelerator
+            if self._use_spot:
+                base += ' ' + ux.colored('[spot]', ux.Color.YELLOW)
+            return base
+        return self._instance_type or f'cpus={self._cpus or "any"}'
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        return hash(
+            tuple(
+                sorted((k, str(v)) for k, v in self.to_yaml_config().items())))
+
+    def __setstate__(self, state):
+        """Unpickle with forward-compat version handling (handles are
+        pickled into the state DB; parity: reference __setstate__ chains)."""
+        version = state.get('_version', 0)
+        if version < 1:
+            state.setdefault('_reservation', None)
+            state.setdefault('_autostop', None)
+        self.__dict__.update(state)
+
+
+def format_resources_table(resources_list: List[Resources]) -> str:
+    lines = []
+    for r in resources_list:
+        cost = r.get_cost(3600)
+        lines.append(f'  {r.pretty():30s} ${cost:.2f}/hr')
+    return textwrap.indent('\n'.join(lines), '')

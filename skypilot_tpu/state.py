@@ -1,0 +1,334 @@
+"""Local persistent state: SQLite DB at ``$SKYTPU_HOME/state.db``.
+
+Parity: sky/global_user_state.py:34 — tables for clusters (pickled handle,
+status, autostop, owner), cluster history, storage, and a config KV store
+(enabled clouds cache).  No long-lived daemon: every CLI/SDK call opens the
+DB directly; concurrency is handled with WAL mode + per-cluster file locks
+(utils/locks.py).
+"""
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import logsys
+from skypilot_tpu.status_lib import ClusterStatus, StorageStatus
+from skypilot_tpu.utils import common
+
+logger = logsys.init_logger(__name__)
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT,
+    autostop INTEGER DEFAULT -1,
+    to_down INTEGER DEFAULT 0,
+    owner TEXT DEFAULT NULL,
+    metadata TEXT DEFAULT '{}',
+    cluster_hash TEXT DEFAULT NULL,
+    status_updated_at INTEGER DEFAULT 0);
+CREATE TABLE IF NOT EXISTS cluster_history (
+    cluster_hash TEXT PRIMARY KEY,
+    name TEXT,
+    num_nodes INTEGER,
+    requested_resources BLOB,
+    launched_resources BLOB,
+    usage_intervals BLOB);
+CREATE TABLE IF NOT EXISTS storage (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT);
+CREATE TABLE IF NOT EXISTS config (
+    key TEXT PRIMARY KEY,
+    value TEXT);
+"""
+
+_local = threading.local()
+
+
+def _db() -> sqlite3.Connection:
+    """One connection per (thread, db-path); creates schema on first use."""
+    path = common.state_db_path()
+    conn = getattr(_local, 'conn', None)
+    if conn is not None and getattr(_local, 'path', None) == path:
+        return conn
+    common.ensure_dir(os.path.dirname(path))
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.executescript(_CREATE_SQL)
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    return conn
+
+
+def reset_for_tests() -> None:
+    """Drop the cached connection so SKYTPU_HOME changes take effect."""
+    _local.conn = None
+    _local.path = None
+
+
+# ----------------------------------------------------------------- clusters
+
+
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: Any,
+                          requested_resources: Optional[set],
+                          ready: bool,
+                          is_launch: bool = True) -> None:
+    """Record a (re)provisioned cluster.  Parity:
+    sky/global_user_state.py:139."""
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    now = int(time.time())
+    handle_blob = pickle.dumps(cluster_handle)
+    last_use = _current_command() if is_launch else None
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name) or str(
+        uuid.uuid4())
+    conn = _db()
+    with conn:
+        row = conn.execute('SELECT launched_at FROM clusters WHERE name=?',
+                           (cluster_name,)).fetchone()
+        launched_at = now if (is_launch or row is None) else row[0]
+        conn.execute(
+            'INSERT INTO clusters (name, launched_at, handle, last_use,'
+            ' status, autostop, to_down, owner, metadata, cluster_hash,'
+            ' status_updated_at)'
+            ' VALUES (?,?,?,?,?,'
+            '  COALESCE((SELECT autostop FROM clusters WHERE name=?), -1),'
+            '  COALESCE((SELECT to_down FROM clusters WHERE name=?), 0),'
+            '  COALESCE((SELECT owner FROM clusters WHERE name=?), ?),'
+            '  COALESCE((SELECT metadata FROM clusters WHERE name=?), \'{}\'),'
+            '  ?, ?)'
+            ' ON CONFLICT(name) DO UPDATE SET launched_at=excluded.launched_at,'
+            ' handle=excluded.handle,'
+            ' last_use=COALESCE(excluded.last_use, last_use),'
+            ' status=excluded.status, cluster_hash=excluded.cluster_hash,'
+            ' status_updated_at=excluded.status_updated_at',
+            (cluster_name, launched_at, handle_blob, last_use, status.value,
+             cluster_name, cluster_name, cluster_name, common.get_user_hash(),
+             cluster_name, cluster_hash, now))
+        if requested_resources is not None:
+            _record_history(conn, cluster_name, cluster_hash,
+                            cluster_handle, requested_resources, now)
+
+
+def _record_history(conn, name, cluster_hash, handle, requested_resources,
+                    now) -> None:
+    launched = getattr(handle, 'launched_resources', None)
+    num_nodes = getattr(handle, 'launched_nodes', None)
+    row = conn.execute(
+        'SELECT usage_intervals FROM cluster_history WHERE cluster_hash=?',
+        (cluster_hash,)).fetchone()
+    intervals: List = pickle.loads(row[0]) if row and row[0] else []
+    if not intervals or intervals[-1][1] is not None:
+        intervals.append((now, None))
+    conn.execute(
+        'INSERT OR REPLACE INTO cluster_history'
+        ' (cluster_hash, name, num_nodes, requested_resources,'
+        '  launched_resources, usage_intervals) VALUES (?,?,?,?,?,?)',
+        (cluster_hash, name, num_nodes, pickle.dumps(requested_resources),
+         pickle.dumps(launched), pickle.dumps(intervals)))
+
+
+def update_cluster_status(cluster_name: str, status: ClusterStatus) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE clusters SET status=?, status_updated_at=? WHERE name=?',
+            (status.value, int(time.time()), cluster_name))
+
+
+def update_cluster_handle(cluster_name: str, handle: Any) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE clusters SET handle=? WHERE name=?',
+                     (pickle.dumps(handle), cluster_name))
+
+
+def update_last_use(cluster_name: str) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE clusters SET last_use=? WHERE name=?',
+                     (_current_command(), cluster_name))
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    """On stop: clear stale network info; on terminate: drop the record and
+    close the usage interval."""
+    conn = _db()
+    with conn:
+        if terminate:
+            row = conn.execute(
+                'SELECT cluster_hash FROM clusters WHERE name=?',
+                (cluster_name,)).fetchone()
+            if row and row[0]:
+                hrow = conn.execute(
+                    'SELECT usage_intervals FROM cluster_history'
+                    ' WHERE cluster_hash=?', (row[0],)).fetchone()
+                if hrow and hrow[0]:
+                    intervals = pickle.loads(hrow[0])
+                    if intervals and intervals[-1][1] is None:
+                        intervals[-1] = (intervals[-1][0], int(time.time()))
+                        conn.execute(
+                            'UPDATE cluster_history SET usage_intervals=?'
+                            ' WHERE cluster_hash=?',
+                            (pickle.dumps(intervals), row[0]))
+            conn.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+        else:
+            row = conn.execute('SELECT handle FROM clusters WHERE name=?',
+                               (cluster_name,)).fetchone()
+            if row is not None:
+                handle = pickle.loads(row[0])
+                if hasattr(handle, 'stable_internal_external_ips'):
+                    handle.stable_internal_external_ips = None
+                conn.execute(
+                    'UPDATE clusters SET handle=?, status=? WHERE name=?',
+                    (pickle.dumps(handle), ClusterStatus.STOPPED.value,
+                     cluster_name))
+
+
+def get_handle_from_cluster_name(cluster_name: str) -> Optional[Any]:
+    row = _db().execute('SELECT handle FROM clusters WHERE name=?',
+                        (cluster_name,)).fetchone()
+    return pickle.loads(row[0]) if row else None
+
+
+def get_cluster_from_name(cluster_name: str) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM clusters WHERE name=?',
+                        (cluster_name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (name, launched_at, handle, last_use, status, autostop, to_down, owner,
+     metadata, cluster_hash, status_updated_at) = row
+    return {
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle),
+        'last_use': last_use,
+        'status': ClusterStatus(status),
+        'autostop': autostop,
+        'to_down': bool(to_down),
+        'owner': owner,
+        'metadata': json.loads(metadata or '{}'),
+        'cluster_hash': cluster_hash,
+        'status_updated_at': status_updated_at,
+    }
+
+
+def set_cluster_autostop(cluster_name: str, idle_minutes: int,
+                         to_down: bool) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+                     (idle_minutes, int(to_down), cluster_name))
+
+
+def set_cluster_metadata(cluster_name: str, metadata: Dict[str, Any]) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE clusters SET metadata=? WHERE name=?',
+                     (json.dumps(metadata), cluster_name))
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    rows = _db().execute('SELECT * FROM cluster_history').fetchall()
+    out = []
+    for (cluster_hash, name, num_nodes, requested, launched,
+         intervals) in rows:
+        out.append({
+            'cluster_hash': cluster_hash,
+            'name': name,
+            'num_nodes': num_nodes,
+            'requested_resources':
+                pickle.loads(requested) if requested else None,
+            'launched_resources': pickle.loads(launched) if launched else None,
+            'usage_intervals': pickle.loads(intervals) if intervals else [],
+        })
+    return out
+
+
+def _get_hash_for_existing_cluster(cluster_name: str) -> Optional[str]:
+    row = _db().execute('SELECT cluster_hash FROM clusters WHERE name=?',
+                        (cluster_name,)).fetchone()
+    return row[0] if row else None
+
+
+def _current_command() -> str:
+    import sys
+    return ' '.join(sys.argv)
+
+
+# ------------------------------------------------------------------ storage
+
+
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: StorageStatus) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO storage'
+            ' (name, launched_at, handle, last_use, status) VALUES (?,?,?,?,?)',
+            (storage_name, int(time.time()), pickle.dumps(storage_handle),
+             _current_command(), storage_status.value))
+
+
+def set_storage_status(storage_name: str, status: StorageStatus) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE storage SET status=? WHERE name=?',
+                     (status.value, storage_name))
+
+
+def remove_storage(storage_name: str) -> None:
+    with _db() as conn:
+        conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    rows = _db().execute('SELECT * FROM storage').fetchall()
+    return [{
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle),
+        'last_use': last_use,
+        'status': StorageStatus(status),
+    } for name, launched_at, handle, last_use, status in rows]
+
+
+def get_storage_handle(storage_name: str) -> Optional[Any]:
+    row = _db().execute('SELECT handle FROM storage WHERE name=?',
+                        (storage_name,)).fetchone()
+    return pickle.loads(row[0]) if row else None
+
+
+# ---------------------------------------------------------------- config KV
+
+
+def kv_set(key: str, value: Any) -> None:
+    with _db() as conn:
+        conn.execute('INSERT OR REPLACE INTO config (key, value) VALUES (?,?)',
+                     (key, json.dumps(value)))
+
+
+def kv_get(key: str, default: Any = None) -> Any:
+    row = _db().execute('SELECT value FROM config WHERE key=?',
+                        (key,)).fetchone()
+    return json.loads(row[0]) if row else default
+
+
+def set_enabled_clouds(clouds: List[str]) -> None:
+    kv_set('enabled_clouds', clouds)
+
+
+def get_cached_enabled_clouds() -> List[str]:
+    return kv_get('enabled_clouds', [])

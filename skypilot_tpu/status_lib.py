@@ -1,0 +1,32 @@
+"""Shared status enums for clusters and storage."""
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    """Cluster lifecycle states (parity: reference ClusterStatus).
+
+    INIT: provisioning in progress, or the cluster is in an abnormal state
+        (e.g. partial slice failure detected during refresh).
+    UP: the slice exists and the podlet runtime is healthy on all hosts.
+    STOPPED: instances stopped but resumable (CPU VMs only — TPU slices
+        generally cannot stop; see clouds/gcp.py).
+    """
+    INIT = 'INIT'
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+    def colored_str(self) -> str:
+        from skypilot_tpu.utils import ux
+        color = {
+            ClusterStatus.INIT: ux.Color.BLUE,
+            ClusterStatus.UP: ux.Color.GREEN,
+            ClusterStatus.STOPPED: ux.Color.YELLOW,
+        }[self]
+        return ux.colored(self.value, color)
+
+
+class StorageStatus(enum.Enum):
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    READY = 'READY'
+    DELETED = 'DELETED'

@@ -1,0 +1,263 @@
+"""Declarative unit of work: the Task.
+
+Parity: sky/task.py:171 — name/setup/run/envs/workdir/num_nodes/
+file_mounts/storage_mounts/service with YAML ⇄ object round-trip, plus the
+``>>`` DAG-edge operator (sky/task.py:1159).  TPU-first change: ``num_nodes``
+counts *pod slices* (each slice is gang-provisioned atomically and may span
+many hosts); multi-slice tasks train over DCN with
+``SKYTPU_SLICE_ID``/``SKYTPU_NUM_SLICES`` exported for megascale-style setups.
+"""
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import logsys
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import common, schemas
+
+logger = logsys.init_logger(__name__)
+
+_VALID_NAME_REGEX = re.compile(r'^[a-zA-Z0-9]+(?:[._-][a-zA-Z0-9]+)*$')
+
+CommandOrGenerator = Optional[Union[str, Callable[[int, List[str]], str]]]
+
+
+class Task:
+    """A coarse-grained unit of work: setup + run on some Resources."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGenerator = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+    ):
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._envs = {k: str(v) for k, v in (envs or {}).items()}
+        self.num_nodes = num_nodes or 1
+        self.resources: Set[Resources] = {Resources()}
+        self.file_mounts: Dict[str, str] = {}
+        self.storage_mounts: Dict[str, Any] = {}  # path -> data.Storage
+        self.service: Optional[Any] = None  # serve.SkyTpuServiceSpec
+        self.best_resources: Optional[Resources] = None
+        self.estimated_duration_hours: Optional[float] = None
+        self._validate()
+        # Auto-register into an active `with Dag():` context.
+        from skypilot_tpu import dag as dag_lib
+        d = dag_lib.get_current_dag()
+        if d is not None:
+            d.add(self)
+
+    # ----------------------------------------------------------- validation
+
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_REGEX.fullmatch(
+                self.name):
+            raise exceptions.InvalidTaskError(
+                f'Invalid task name {self.name!r}: use letters, digits and '
+                f'[._-] separators.')
+        if self.run is not None and not (isinstance(self.run, str) or
+                                         callable(self.run)):
+            raise exceptions.InvalidTaskError(
+                'run must be a shell command string or a '
+                'callable(node_rank, ip_list) -> str.')
+        if self.setup is not None and not isinstance(self.setup, str):
+            raise exceptions.InvalidTaskError('setup must be a string.')
+        if not isinstance(self.num_nodes, int) or self.num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be a positive int, got {self.num_nodes!r}.')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidTaskError(
+                    f'workdir must be an existing directory: {self.workdir}')
+
+    # ----------------------------------------------------------------- envs
+
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    def update_envs(self, envs: Union[Dict[str, str], List]) -> 'Task':
+        if isinstance(envs, list):  # [('K','V'), ...] or ['K=V', ...]
+            parsed = {}
+            for item in envs:
+                if isinstance(item, str):
+                    if '=' not in item:
+                        raise exceptions.InvalidTaskError(
+                            f'Env {item!r} must be KEY=VALUE.')
+                    k, v = item.split('=', 1)
+                else:
+                    k, v = item
+                parsed[k] = v
+            envs = parsed
+        for k in envs:
+            if not isinstance(k, str) or not k:
+                raise exceptions.InvalidTaskError(f'Bad env name: {k!r}')
+        self._envs.update({k: str(v) for k, v in envs.items()})
+        return self
+
+    # ------------------------------------------------------------ resources
+
+    def set_resources(
+        self, resources: Union[Resources, Set[Resources], List[Resources]]
+    ) -> 'Task':
+        if isinstance(resources, Resources):
+            resources = {resources}
+        self.resources = set(resources)
+        if not self.resources:
+            raise exceptions.InvalidTaskError('resources must be non-empty.')
+        return self
+
+    def get_preferred_resources(self) -> Resources:
+        """Any single requested resources (for messages); optimizer decides."""
+        return next(iter(self.resources))
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        self.file_mounts = dict(file_mounts or {})
+        for dst, src in self.file_mounts.items():
+            if src.startswith(('gs://', 's3://')):
+                continue
+            if not os.path.exists(os.path.expanduser(src)):
+                raise exceptions.InvalidTaskError(
+                    f'file_mount source not found: {src} (-> {dst})')
+        return self
+
+    def set_storage_mounts(self, storage_mounts: Optional[Dict[str,
+                                                               Any]]) -> 'Task':
+        self.storage_mounts = dict(storage_mounts or {})
+        return self
+
+    def set_service(self, service: Optional[Any]) -> 'Task':
+        self.service = service
+        return self
+
+    # -------------------------------------------------------------- yaml io
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        schemas.validate_task(config)
+        envs = {k: v for k, v in (config.get('envs') or {}).items()}
+        if env_overrides:
+            envs.update(env_overrides)
+        missing = [k for k, v in envs.items() if v is None]
+        if missing:
+            raise exceptions.InvalidTaskError(
+                f'Env var(s) {missing} declared with null value; pass values '
+                f'via --env.')
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+        )
+        res_config = config.get('resources') or {}
+        any_of = res_config.pop('any_of', None)
+        if any_of:
+            base = Resources.from_yaml_config(res_config)
+            task.set_resources({
+                base.copy(**{
+                    k: v for k, v in Resources.from_yaml_config(
+                        alt).to_yaml_config().items()
+                }) for alt in any_of
+            })
+        else:
+            task.set_resources(Resources.from_yaml_config(res_config))
+        task.set_file_mounts(config.get('file_mounts'))
+        raw_storage = config.get('storage_mounts') or {}
+        if raw_storage:
+            from skypilot_tpu.data import storage as storage_lib
+            mounts = {}
+            for path, sconf in raw_storage.items():
+                schemas.validate(sconf, schemas.get_storage_schema(),
+                                 'storage mount')
+                mounts[path] = storage_lib.Storage.from_yaml_config(sconf)
+            task.set_storage_mounts(mounts)
+        if config.get('service'):
+            from skypilot_tpu.serve import service_spec
+            task.set_service(
+                service_spec.SkyTpuServiceSpec.from_yaml_config(
+                    config['service']))
+        return task
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        with open(os.path.expanduser(yaml_path), 'r', encoding='utf-8') as f:
+            config = yaml.safe_load(f)
+        if config is None:
+            config = {}
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'{yaml_path} must contain a YAML mapping.')
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+
+        def put(k, v):
+            if v is not None and v != {} and v != []:
+                cfg[k] = v
+
+        put('name', self.name)
+        if len(self.resources) == 1:
+            put('resources', next(iter(self.resources)).to_yaml_config())
+        else:
+            rs = sorted((r.to_yaml_config() for r in self.resources),
+                        key=str)
+            put('resources', {'any_of': rs})
+        if self.num_nodes != 1:
+            cfg['num_nodes'] = self.num_nodes
+        put('workdir', self.workdir)
+        put('setup', self.setup)
+        put('run', self.run if isinstance(self.run, str) else None)
+        put('envs', self._envs or None)
+        put('file_mounts', self.file_mounts or None)
+        if self.storage_mounts:
+            cfg['storage_mounts'] = {
+                path: s.to_yaml_config()
+                for path, s in self.storage_mounts.items()
+            }
+        if self.service is not None:
+            cfg['service'] = self.service.to_yaml_config()
+        return cfg
+
+    def to_yaml(self, path: str) -> None:
+        with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+            yaml.safe_dump(self.to_yaml_config(), f, sort_keys=False)
+
+    # ------------------------------------------------------------------ DAG
+
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """``a >> b``: b depends on a (chain DAGs for train→eval pipelines)."""
+        from skypilot_tpu import dag as dag_lib
+        d = dag_lib.get_current_dag()
+        if d is None:
+            raise exceptions.InvalidTaskError(
+                'Task >> Task requires an active `with Dag():` context.')
+        d.add_edge(self, other)
+        return other
+
+    def get_total_num_hosts(self) -> int:
+        """Total host VMs this task will fan out to (slices × hosts/slice)."""
+        r = self.get_preferred_resources()
+        return self.num_nodes * r.num_hosts
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        r = next(iter(self.resources))
+        nodes = f', {self.num_nodes} slices' if self.num_nodes > 1 else ''
+        return f'<Task {name}: {r.pretty()}{nodes}>'

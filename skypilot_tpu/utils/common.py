@@ -1,0 +1,128 @@
+"""Shared paths, env vars and small helpers.
+
+The framework home defaults to ``~/.skytpu`` and is overridable via
+``SKYTPU_HOME`` so tests can run fully hermetic.  (Parity: the reference
+hard-codes ``~/.sky``; making it overridable is what lets us run the
+reference's tier-2 "fake cloud" test strategy, SURVEY.md §4.)
+"""
+import getpass
+import hashlib
+import os
+import re
+import time
+import uuid
+from typing import Optional
+
+# Environment variables exported into every task's run environment.
+# Parity: SKYPILOT_NODE_RANK / NODE_IPS / NUM_NODES / NUM_GPUS_PER_NODE and
+# SKYPILOT_TASK_ID (sky/skylet/constants.py:62,263-266).
+ENV_VAR_NODE_RANK = 'SKYTPU_NODE_RANK'
+ENV_VAR_NODE_IPS = 'SKYTPU_NODE_IPS'
+ENV_VAR_NUM_NODES = 'SKYTPU_NUM_NODES'
+ENV_VAR_NUM_CHIPS_PER_NODE = 'SKYTPU_NUM_CHIPS_PER_NODE'
+ENV_VAR_TASK_ID = 'SKYTPU_TASK_ID'
+ENV_VAR_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
+# jax.distributed rendezvous: exported so recipes can simply call
+# jax.distributed.initialize() with these.
+ENV_VAR_COORDINATOR_ADDRESS = 'SKYTPU_COORDINATOR_ADDRESS'
+ENV_VAR_PROCESS_ID = 'SKYTPU_PROCESS_ID'
+ENV_VAR_NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'
+# Multi-slice (DCN) topology, MEGASCALE-style.
+ENV_VAR_SLICE_ID = 'SKYTPU_SLICE_ID'
+ENV_VAR_NUM_SLICES = 'SKYTPU_NUM_SLICES'
+
+JAX_COORDINATOR_PORT = 8476
+
+USER_HASH_LENGTH = 8
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+
+def home_dir() -> str:
+    return os.path.expanduser(os.environ.get('SKYTPU_HOME', '~/.skytpu'))
+
+
+def state_db_path() -> str:
+    return os.path.join(home_dir(), 'state.db')
+
+
+def generated_dir() -> str:
+    return os.path.join(home_dir(), 'generated')
+
+
+def logs_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_LOGS_DIR', os.path.join(home_dir(), 'logs')))
+
+
+def catalogs_dir() -> str:
+    return os.path.join(home_dir(), 'catalogs')
+
+
+def keys_dir() -> str:
+    return os.path.join(home_dir(), 'keys')
+
+
+def ensure_dir(path: str) -> str:
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def get_user() -> str:
+    try:
+        return getpass.getuser()
+    except Exception:  # pylint: disable=broad-except
+        return os.environ.get('USER', 'unknown')
+
+
+def get_user_hash() -> str:
+    """Stable per-user hash used for owner identity + default names."""
+    forced = os.environ.get('SKYTPU_USER_HASH')
+    if forced:
+        return forced[:USER_HASH_LENGTH]
+    hash_input = f'{get_user()}-{os.path.expanduser("~")}'
+    return hashlib.md5(hash_input.encode()).hexdigest()[:USER_HASH_LENGTH]
+
+
+def get_run_timestamp() -> str:
+    return 'skytpu-' + time.strftime('%Y-%m-%d-%H-%M-%S-%f', time.localtime())
+
+
+def make_task_id(task_name: Optional[str], job_id: Optional[int] = None) -> str:
+    """Stable task id; managed jobs keep it constant across recoveries.
+
+    Parity: SKYPILOT_TASK_ID semantics (sky/jobs/controller.py:59-87).
+    """
+    ts = time.strftime('%Y%m%d-%H%M%S', time.localtime())
+    name = task_name or 'task'
+    jid = f'{job_id}-' if job_id is not None else ''
+    return f'skytpu-{ts}_{jid}{name}_{uuid.uuid4().hex[:6]}'
+
+
+def is_valid_cluster_name(name: Optional[str]) -> bool:
+    return name is not None and bool(CLUSTER_NAME_VALID_REGEX.fullmatch(name))
+
+
+def truncate(s: str, limit: int = 80) -> str:
+    return s if len(s) <= limit else s[:limit - 3] + '...'
+
+
+def format_float(x, precision: int = 2) -> str:
+    if x is None:
+        return '-'
+    if abs(x) >= 100 or x == int(x):
+        return str(int(round(x)))
+    return f'{x:.{precision}f}'
+
+
+def readable_duration(seconds: float) -> str:
+    seconds = int(seconds)
+    if seconds < 60:
+        return f'{seconds}s'
+    mins, secs = divmod(seconds, 60)
+    if mins < 60:
+        return f'{mins}m {secs}s'
+    hours, mins = divmod(mins, 60)
+    if hours < 24:
+        return f'{hours}h {mins}m'
+    days, hours = divmod(hours, 24)
+    return f'{days}d {hours}h'

@@ -1,0 +1,23 @@
+"""Boolean env-flag registry.
+
+Parity: sky/utils/env_options.py (SKYPILOT_DEBUG, DISABLE_USAGE_COLLECTION...).
+"""
+import enum
+import os
+
+
+class Options(enum.Enum):
+    IS_DEVELOPER = 'SKYTPU_DEV'
+    SHOW_DEBUG_INFO = 'SKYTPU_DEBUG'
+    DISABLE_LOGGING = 'SKYTPU_DISABLE_USAGE_COLLECTION'
+    MINIMIZE_LOGGING = 'SKYTPU_MINIMIZE_LOGGING'
+    # Internal: set inside controller VMs so nested launches skip
+    # controller-specific checks.
+    RUNNING_IN_CONTROLLER = 'SKYTPU_IN_CONTROLLER'
+
+    def get(self) -> bool:
+        return os.environ.get(self.value, '0') not in ('0', '', 'false',
+                                                       'False')
+
+    def __bool__(self) -> bool:
+        return self.get()

@@ -1,0 +1,31 @@
+"""Coarse file locks guarding shared local state.
+
+Parity: the reference's concurrency model is file locks + SQLite transactions
+(SURVEY.md §5 "race detection"): per-cluster status lock
+(sky/backends/cloud_vm_ray_backend.py:2723), wheel lock, per-job lock.
+"""
+import hashlib
+import os
+
+import filelock
+
+from skypilot_tpu.utils import common
+
+
+def _lock_dir() -> str:
+    return common.ensure_dir(os.path.join(common.home_dir(), 'locks'))
+
+
+def _lock_path(name: str) -> str:
+    safe = hashlib.md5(name.encode()).hexdigest()[:16]
+    return os.path.join(_lock_dir(), f'{name[:60]}-{safe}.lock')
+
+
+def cluster_status_lock(cluster_name: str,
+                        timeout: float = -1) -> filelock.FileLock:
+    """Serializes provision/teardown/status-refresh per cluster."""
+    return filelock.FileLock(_lock_path(f'cluster.{cluster_name}'), timeout)
+
+
+def named_lock(name: str, timeout: float = -1) -> filelock.FileLock:
+    return filelock.FileLock(_lock_path(name), timeout)

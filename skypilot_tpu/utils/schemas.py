@@ -1,0 +1,162 @@
+"""JSON schemas validating task YAML / resources / service / config.
+
+Parity: sky/utils/schemas.py:36,204 — same role (fail fast with a readable
+message before any cloud call), trimmed to this framework's surface.
+"""
+from typing import Any, Dict
+
+_RESOURCES_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'cloud': {'type': 'string'},
+        'accelerator': {'type': 'string'},
+        'accelerators': {
+            'anyOf': [{'type': 'string'}, {'type': 'object'}]
+        },
+        'accelerator_args': {'type': 'object'},
+        'cpus': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'memory': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'instance_type': {'type': 'string'},
+        'use_spot': {'type': 'boolean'},
+        'job_recovery': {
+            'anyOf': [{'type': 'string'}, {'type': 'object'}]
+        },
+        'region': {'type': 'string'},
+        'zone': {'type': 'string'},
+        'image_id': {'type': 'string'},
+        'disk_size': {'type': 'integer'},
+        'ports': {
+            'anyOf': [{'type': 'integer'}, {'type': 'string'},
+                      {'type': 'array'}]
+        },
+        'labels': {'type': 'object'},
+        'reservation': {'type': 'string'},
+        'autostop': {
+            'anyOf': [{'type': 'boolean'}, {'type': 'integer'},
+                      {'type': 'object'}]
+        },
+        'any_of': {'type': 'array', 'items': {'type': 'object'}},
+    },
+}
+
+_STORAGE_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'source': {
+            'anyOf': [{'type': 'string'},
+                      {'type': 'array', 'items': {'type': 'string'}}]
+        },
+        'store': {'type': 'string', 'enum': ['gcs']},
+        'persistent': {'type': 'boolean'},
+        'mode': {'type': 'string', 'enum': ['MOUNT', 'COPY', 'mount', 'copy']},
+    },
+}
+
+_SERVICE_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'required': ['path'],
+                    'properties': {
+                        'path': {'type': 'string'},
+                        'initial_delay_seconds': {'type': 'number'},
+                        'timeout_seconds': {'type': 'number'},
+                        'post_data': {
+                            'anyOf': [{'type': 'string'}, {'type': 'object'}]
+                        },
+                        'headers': {'type': 'object'},
+                    },
+                },
+            ]
+        },
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer'},
+                'max_replicas': {'type': 'integer'},
+                'target_qps_per_replica': {'type': 'number'},
+                'upscale_delay_seconds': {'type': 'number'},
+                'downscale_delay_seconds': {'type': 'number'},
+                'base_ondemand_fallback_replicas': {'type': 'integer'},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+            },
+        },
+        'replicas': {'type': 'integer'},
+        'load_balancing_policy': {'type': 'string'},
+    },
+}
+
+TASK_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'workdir': {'type': 'string'},
+        'setup': {'type': 'string'},
+        'run': {'type': 'string'},
+        'envs': {
+            'type': 'object',
+            'additionalProperties': {
+                'anyOf': [{'type': 'string'}, {'type': 'number'},
+                          {'type': 'null'}]
+            },
+        },
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'resources': _RESOURCES_SCHEMA,
+        'file_mounts': {'type': 'object'},
+        'storage_mounts': {'type': 'object'},
+        'service': _SERVICE_SCHEMA,
+    },
+}
+
+CONFIG_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': True,
+    'properties': {
+        'gcp': {
+            'type': 'object',
+            'properties': {
+                'project_id': {'type': 'string'},
+                'service_account': {'type': 'string'},
+            },
+        },
+        'jobs': {'type': 'object'},
+        'serve': {'type': 'object'},
+        'admin_policy': {'type': 'string'},
+    },
+}
+
+
+def validate(obj: Dict[str, Any], schema: Dict[str, Any],
+             what: str = 'YAML') -> None:
+    import jsonschema  # lazy
+    from skypilot_tpu import exceptions
+    try:
+        jsonschema.validate(obj, schema)
+    except jsonschema.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise exceptions.InvalidTaskError(
+            f'Invalid {what} at {path!r}: {e.message}') from None
+
+
+def validate_task(config: Dict[str, Any]) -> None:
+    validate(config, TASK_SCHEMA, 'task YAML')
+
+
+def validate_service(config: Dict[str, Any]) -> None:
+    validate(config, _SERVICE_SCHEMA, 'service spec')
+
+
+def get_storage_schema():
+    return _STORAGE_SCHEMA

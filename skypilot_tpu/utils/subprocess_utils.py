@@ -1,0 +1,130 @@
+"""Subprocess helpers: logged command execution and parallel fan-out.
+
+Parity targets: sky/utils/subprocess_utils.py (run_in_parallel) and
+sky/skylet/log_lib.py:131 (run_with_log) — re-designed: one implementation
+shared by client-side provisioning and the on-slice podlet runtime.
+"""
+import os
+import shlex
+import subprocess
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import psutil
+
+from skypilot_tpu import logsys
+
+logger = logsys.init_logger(__name__)
+
+
+def run(cmd: Union[str, Sequence[str]], **kwargs) -> subprocess.CompletedProcess:
+    shell = isinstance(cmd, str)
+    kwargs.setdefault('shell', shell)
+    kwargs.setdefault('check', False)
+    return subprocess.run(cmd, **kwargs)
+
+
+def run_in_parallel(fn: Callable, args: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Map fn over args with a thread pool; re-raises the first exception."""
+    if not args:
+        return []
+    if len(args) == 1:
+        return [fn(args[0])]
+    workers = num_threads or min(32, len(args))
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(fn, args))
+
+
+def run_with_log(cmd: Union[str, List[str]],
+                 log_path: str,
+                 *,
+                 stream_logs: bool = False,
+                 prefix: str = '',
+                 cwd: Optional[str] = None,
+                 env: Optional[dict] = None,
+                 shell: bool = False,
+                 start_new_session: bool = True,
+                 line_hook: Optional[Callable[[str], None]] = None,
+                 ) -> Tuple[int, str]:
+    """Run cmd, teeing combined stdout/stderr to log_path (and optionally the
+    console).  Returns (returncode, tail_of_output).
+
+    The tail (last ~8KB) is returned so failover error handlers can classify
+    failures without re-reading the log file.
+    """
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+    tail: List[str] = []
+    tail_bytes = 0
+    with open(log_path, 'a', encoding='utf-8') as fout:
+        proc = subprocess.Popen(
+            cmd,
+            shell=shell,
+            cwd=cwd,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            start_new_session=start_new_session,
+            text=True,
+            bufsize=1,
+        )
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            fout.write(line)
+            fout.flush()
+            if line_hook is not None:
+                line_hook(line)
+            if stream_logs:
+                sys.stdout.write(prefix + line)
+                sys.stdout.flush()
+            tail.append(line)
+            tail_bytes += len(line)
+            while tail_bytes > 8192 and len(tail) > 1:
+                tail_bytes -= len(tail.pop(0))
+        proc.wait()
+    return proc.returncode, ''.join(tail)
+
+
+def kill_process_tree(pid: int, include_parent: bool = True,
+                      sig_timeout: float = 5.0) -> None:
+    """Terminate a process and all descendants (grandchild-killer).
+
+    Parity: sky/skylet/subprocess_daemon.py — reaping job process trees on
+    cancel so `run:` scripts cannot leak background children.
+    """
+    try:
+        parent = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    procs = parent.children(recursive=True)
+    if include_parent:
+        procs.append(parent)
+    for p in procs:
+        try:
+            p.terminate()
+        except psutil.NoSuchProcess:
+            pass
+    _, alive = psutil.wait_procs(procs, timeout=sig_timeout)
+    for p in alive:
+        try:
+            p.kill()
+        except psutil.NoSuchProcess:
+            pass
+
+
+def wait_for(predicate: Callable[[], bool], timeout: float,
+             interval: float = 1.0, desc: str = 'condition') -> bool:
+    """Poll predicate until true or timeout. Returns whether it became true."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def quote(s: str) -> str:
+    return shlex.quote(s)

@@ -1,0 +1,140 @@
+"""Chrome-trace timeline tracing.
+
+Parity: sky/utils/timeline.py:21,73,77 — `@timeline.event` decorators on hot
+entry points plus FileLockEvent wrappers; dump at exit when
+``SKYTPU_TIMELINE_FILE`` is set.  Load the output in chrome://tracing or
+Perfetto.
+"""
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Union
+
+_events: List[dict] = []
+_lock = threading.Lock()
+_enabled: Optional[bool] = None
+
+
+def _file_path() -> Optional[str]:
+    return os.environ.get('SKYTPU_TIMELINE_FILE')
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = _file_path() is not None
+        if _enabled:
+            atexit.register(save)
+    return _enabled
+
+
+class Event:
+    """Duration event context manager ('B'/'E' phase pairs)."""
+
+    def __init__(self, name: str, message: Optional[str] = None):
+        self._name = name
+        self._message = message
+
+    def _record(self, phase: str) -> None:
+        event = {
+            'name': self._name,
+            'cat': 'skytpu',
+            'pid': str(os.getpid()),
+            'tid': str(threading.get_ident()),
+            'ph': phase,
+            'ts': f'{time.time() * 10 ** 6: .3f}',
+        }
+        if self._message is not None:
+            event['args'] = {'message': self._message}
+        with _lock:
+            _events.append(event)
+
+    def begin(self):
+        self._record('B')
+
+    def end(self):
+        self._record('E')
+
+    def __enter__(self):
+        if enabled():
+            self.begin()
+        return self
+
+    def __exit__(self, *args):
+        if enabled():
+            self.end()
+
+
+def event(name_or_fn: Union[str, Callable], message: Optional[str] = None):
+    """Decorator (or decorator factory) tracing a function call."""
+    if callable(name_or_fn):
+        fn = name_or_fn
+        name = getattr(fn, '__qualname__', fn.__name__)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(name_or_fn, message):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+class FileLockEvent:
+    """Wrap a filelock acquisition so lock contention shows in the trace."""
+
+    def __init__(self, lockfile: str, timeout: float = -1):
+        import filelock  # lazy
+        self._lockfile = lockfile
+        os.makedirs(os.path.dirname(os.path.expanduser(lockfile)) or '.',
+                    exist_ok=True)
+        self._lock = filelock.FileLock(os.path.expanduser(lockfile), timeout)
+        self._hold_event = Event(f'[FileLock.hold]:{lockfile}')
+
+    def acquire(self):
+        with Event(f'[FileLock.acquire]:{self._lockfile}'):
+            self._lock.acquire()
+        if enabled():
+            self._hold_event.begin()
+
+    def release(self):
+        self._lock.release()
+        if enabled():
+            self._hold_event.end()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *args):
+        self.release()
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self:
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def save() -> None:
+    path = _file_path()
+    if not path:
+        return
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+    with _lock, open(path, 'w', encoding='utf-8') as f:
+        json.dump({'traceEvents': _events}, f)

@@ -1,0 +1,111 @@
+"""User-facing console helpers: colors, spinners, error brevity.
+
+Parity: sky/utils/ux_utils.py + rich_utils.py.
+"""
+import contextlib
+import sys
+from typing import Optional
+
+
+class Color:
+    RESET = '\x1b[0m'
+    BOLD = '\x1b[1m'
+    DIM = '\x1b[2m'
+    RED = '\x1b[31m'
+    GREEN = '\x1b[32m'
+    YELLOW = '\x1b[33m'
+    BLUE = '\x1b[34m'
+    MAGENTA = '\x1b[35m'
+    CYAN = '\x1b[36m'
+
+
+def _tty() -> bool:
+    return sys.stdout.isatty()
+
+
+def colored(text: str, color: str, bold: bool = False) -> str:
+    if not _tty():
+        return text
+    prefix = color + (Color.BOLD if bold else '')
+    return f'{prefix}{text}{Color.RESET}'
+
+
+def emph(text: str) -> str:
+    return colored(text, Color.CYAN, bold=True)
+
+
+def warning(text: str) -> str:
+    return colored(text, Color.YELLOW)
+
+
+def error(text: str) -> str:
+    return colored(text, Color.RED, bold=True)
+
+
+def ok(text: str) -> str:
+    return colored(text, Color.GREEN)
+
+
+def log_hint(log_path: str) -> str:
+    return colored(f'  To view detailed progress: tail -f {log_path}',
+                   Color.DIM)
+
+
+@contextlib.contextmanager
+def print_exception_no_traceback():
+    """Raise user errors without a wall of traceback (unless SKYTPU_DEBUG)."""
+    import os
+    if os.environ.get('SKYTPU_DEBUG'):
+        yield
+        return
+    prev = getattr(sys, 'tracebacklimit', 1000)
+    sys.tracebacklimit = 0
+    try:
+        yield
+    finally:
+        sys.tracebacklimit = prev
+
+
+@contextlib.contextmanager
+def spinner(message: str):
+    """Lightweight rich spinner; degrades to a plain print when not a tty."""
+    try:
+        import rich.status  # lazy
+        if _tty():
+            with rich.status.Status(message):
+                yield
+            return
+    except Exception:  # pylint: disable=broad-except
+        pass
+    print(message)
+    yield
+
+
+class StatusMessage:
+    """Updatable one-line status (no-op when not a tty)."""
+
+    def __init__(self, message: str):
+        self._message = message
+        self._status: Optional[object] = None
+
+    def __enter__(self):
+        try:
+            import rich.status
+            if _tty():
+                self._status = rich.status.Status(self._message)
+                self._status.__enter__()  # type: ignore[attr-defined]
+                return self
+        except Exception:  # pylint: disable=broad-except
+            pass
+        print(self._message)
+        return self
+
+    def update(self, message: str):
+        if self._status is not None:
+            self._status.update(message)  # type: ignore[attr-defined]
+        else:
+            print(message)
+
+    def __exit__(self, *args):
+        if self._status is not None:
+            self._status.__exit__(*args)  # type: ignore[attr-defined]

@@ -1,0 +1,37 @@
+"""Test harness configuration.
+
+- Forces JAX onto a virtual 8-device CPU platform so sharding/mesh tests run
+  without TPU hardware (parity with the reference's tier-2 strategy of
+  testing plan/codegen/state machines without clouds, SURVEY.md §4).
+- Points SKYTPU_HOME at a per-session tmpdir so every test run is hermetic.
+"""
+import os
+
+# Must happen before jax is imported anywhere.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+prev = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in prev:
+    os.environ['XLA_FLAGS'] = (
+        prev + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def skytpu_home(tmp_path, monkeypatch):
+    """Hermetic state dir per test."""
+    home = tmp_path / '.skytpu'
+    monkeypatch.setenv('SKYTPU_HOME', str(home))
+    from skypilot_tpu import config, state
+    state.reset_for_tests()
+    config.reload()
+    yield str(home)
+    state.reset_for_tests()
+
+
+@pytest.fixture
+def enable_local_cloud(monkeypatch):
+    """Make the 'local' cloud the only enabled cloud (fake-cloud tier)."""
+    from skypilot_tpu import state
+    state.set_enabled_clouds(['local', 'gcp'])
+    yield

@@ -1,0 +1,112 @@
+"""Unit tests: Resources model (parity: tests/unit_tests/test_resources.py)."""
+import pickle
+
+import pytest
+
+from skypilot_tpu import Resources, exceptions
+from skypilot_tpu import catalog
+
+
+def test_canonicalize():
+    assert catalog.canonicalize('v5e-8') == 'tpu-v5e-8'
+    assert catalog.canonicalize('tpu-v5litepod-8') == 'tpu-v5e-8'
+    assert catalog.canonicalize('TPU-V4-32') == 'tpu-v4-32'
+    with pytest.raises(exceptions.InvalidResourcesError):
+        catalog.canonicalize('a100-8')
+
+
+def test_slice_info_single_vs_multi_host():
+    r8 = Resources(accelerator='tpu-v5e-8')
+    assert r8.num_hosts == 1 and r8.chips_per_host == 8
+    r64 = Resources(accelerator='tpu-v5e-64')
+    assert r64.num_hosts == 16 and r64.chips_per_host == 4
+    v4 = Resources(accelerator='tpu-v4-32')  # 16 chips, 4 hosts
+    assert v4.slice_info.chips == 16
+    assert v4.num_hosts == 4
+
+
+def test_default_cloud_is_gcp_for_tpu():
+    r = Resources(accelerator='v6e-8')
+    assert r.cloud == 'gcp'
+    assert r.is_tpu
+
+
+def test_runtime_version_default_and_override():
+    r = Resources(accelerator='tpu-v5e-8')
+    assert r.runtime_version == 'v2-alpha-tpuv5-lite'
+    r2 = Resources(accelerator='tpu-v5e-8',
+                   accelerator_args={'runtime_version': 'custom'})
+    assert r2.runtime_version == 'custom'
+
+
+def test_invalid_zone_rejected():
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        Resources(accelerator='tpu-v4-8', zone='us-west4-a')
+    Resources(accelerator='tpu-v4-8', zone='us-central2-b')  # ok
+
+
+def test_cost_spot_cheaper():
+    od = Resources(accelerator='tpu-v5e-8').get_cost(3600)
+    spot = Resources(accelerator='tpu-v5e-8', use_spot=True).get_cost(3600)
+    assert spot < od
+    assert od == pytest.approx(1.20 * 8, rel=0.01)
+
+
+def test_less_demanding_than():
+    want = Resources(accelerator='tpu-v5e-8')
+    have = Resources(accelerator='tpu-v5e-8', zone='us-west4-a',
+                     region='us-west4')
+    assert want.less_demanding_than(have)
+    assert not Resources(accelerator='tpu-v5e-16').less_demanding_than(have)
+    assert not Resources(accelerator='tpu-v5e-8',
+                         use_spot=True).less_demanding_than(have)
+    # cpus satisfied by a TPU host VM
+    assert Resources(cpus='8+').less_demanding_than(have)
+
+
+def test_blocklist_matching():
+    r = Resources(accelerator='tpu-v5e-8', zone='us-west4-a',
+                  region='us-west4')
+    assert r.should_be_blocked_by(Resources(accelerator='tpu-v5e-8'))
+    assert r.should_be_blocked_by(
+        Resources(accelerator='tpu-v5e-8', zone='us-west4-a',
+                  region='us-west4'))
+    assert not r.should_be_blocked_by(
+        Resources(accelerator='tpu-v5e-8', zone='us-east1-c',
+                  region='us-east1'))
+    assert not r.should_be_blocked_by(
+        Resources(accelerator='tpu-v5e-8', use_spot=True))
+
+
+def test_yaml_roundtrip():
+    r = Resources(accelerator='tpu-v6e-64', use_spot=True,
+                  zone='us-east5-b', region='us-east5',
+                  accelerator_args={'runtime_version': 'v2-alpha-tpuv6e'},
+                  labels={'team': 'ml'})
+    r2 = Resources.from_yaml_config(r.to_yaml_config())
+    assert r == r2
+    assert hash(r) == hash(r2)
+
+
+def test_pickle_roundtrip():
+    r = Resources(accelerator='tpu-v4-8', use_spot=True)
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r == r2
+
+
+def test_preemption_cleanup_flag():
+    assert Resources(accelerator='tpu-v4-8',
+                     use_spot=True).need_cleanup_after_preemption
+    assert not Resources(accelerator='tpu-v4-8').need_cleanup_after_preemption
+    assert not Resources(cpus='4', use_spot=True).need_cleanup_after_preemption
+
+
+def test_accelerator_and_instance_type_conflict():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerator='tpu-v5e-8', instance_type='n2-standard-8')
+
+
+def test_vm_for_cpus():
+    assert catalog.get_vm_for_cpus('8') == 'e2-standard-8'
+    assert catalog.get_vm_for_cpus('8+', '60+') == 'n2-standard-16'
+    assert catalog.get_vm_for_cpus('128+') is None

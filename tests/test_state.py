@@ -1,0 +1,55 @@
+"""Unit tests: local SQLite state (parity: tests/test_global_user_state.py)."""
+from skypilot_tpu import state
+from skypilot_tpu.status_lib import ClusterStatus
+
+
+class FakeHandle:
+    def __init__(self, name):
+        self.cluster_name = name
+        self.launched_nodes = 1
+        self.launched_resources = None
+        self.stable_internal_external_ips = [('10.0.0.1', '1.2.3.4')]
+
+
+def test_cluster_crud():
+    h = FakeHandle('c1')
+    state.add_or_update_cluster('c1', h, requested_resources={'r'}, ready=False)
+    rec = state.get_cluster_from_name('c1')
+    assert rec['status'] == ClusterStatus.INIT
+    state.add_or_update_cluster('c1', h, requested_resources=None, ready=True)
+    rec = state.get_cluster_from_name('c1')
+    assert rec['status'] == ClusterStatus.UP
+    assert rec['handle'].cluster_name == 'c1'
+
+    state.set_cluster_autostop('c1', 30, to_down=True)
+    rec = state.get_cluster_from_name('c1')
+    assert rec['autostop'] == 30 and rec['to_down']
+
+    assert len(state.get_clusters()) == 1
+    state.remove_cluster('c1', terminate=False)
+    assert state.get_cluster_from_name('c1')['status'] == ClusterStatus.STOPPED
+    # stop clears cached IPs
+    assert (state.get_cluster_from_name('c1')
+            ['handle'].stable_internal_external_ips is None)
+    state.remove_cluster('c1', terminate=True)
+    assert state.get_cluster_from_name('c1') is None
+
+
+def test_cluster_history_interval_closed_on_down():
+    h = FakeHandle('c2')
+    state.add_or_update_cluster('c2', h, requested_resources={'r'}, ready=True)
+    hist = state.get_cluster_history()
+    assert len(hist) == 1
+    assert hist[0]['usage_intervals'][-1][1] is None
+    state.remove_cluster('c2', terminate=True)
+    hist = state.get_cluster_history()
+    assert hist[0]['usage_intervals'][-1][1] is not None
+
+
+def test_kv_and_enabled_clouds():
+    assert state.get_cached_enabled_clouds() == []
+    state.set_enabled_clouds(['gcp'])
+    assert state.get_cached_enabled_clouds() == ['gcp']
+    state.kv_set('x', {'a': 1})
+    assert state.kv_get('x') == {'a': 1}
+    assert state.kv_get('missing', 42) == 42

@@ -1,0 +1,118 @@
+"""Unit tests: Task model + DAG (parity: tests/test_yaml_parser.py)."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions
+
+
+def _write_yaml(tmp_path, content):
+    p = tmp_path / 'task.yaml'
+    p.write_text(textwrap.dedent(content))
+    return str(p)
+
+
+def test_empty_yaml(tmp_path):
+    task = Task.from_yaml(_write_yaml(tmp_path, ''))
+    assert task.name is None and task.num_nodes == 1
+
+
+def test_basic_yaml(tmp_path):
+    task = Task.from_yaml(
+        _write_yaml(
+            tmp_path, """\
+            name: train
+            resources:
+              accelerator: tpu-v5e-64
+              use_spot: true
+            num_nodes: 2
+            setup: pip list
+            run: python train.py
+            envs:
+              MODEL: llama3-8b
+            """))
+    assert task.name == 'train'
+    assert task.num_nodes == 2
+    r = task.get_preferred_resources()
+    assert r.accelerator == 'tpu-v5e-64' and r.use_spot
+    assert task.envs['MODEL'] == 'llama3-8b'
+    # 2 slices x 16 hosts
+    assert task.get_total_num_hosts() == 32
+
+
+def test_unknown_field_rejected(tmp_path):
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml(_write_yaml(tmp_path, 'nme: typo\n'))
+
+
+def test_null_env_requires_override(tmp_path):
+    path = _write_yaml(
+        tmp_path, """\
+        run: echo $TOKEN
+        envs:
+          TOKEN:
+        """)
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml(path)
+    task = Task.from_yaml(path, env_overrides={'TOKEN': 'abc'})
+    assert task.envs['TOKEN'] == 'abc'
+
+
+def test_any_of_resources(tmp_path):
+    task = Task.from_yaml(
+        _write_yaml(
+            tmp_path, """\
+            run: echo hi
+            resources:
+              use_spot: true
+              any_of:
+                - accelerator: tpu-v5e-8
+                - accelerator: tpu-v6e-8
+            """))
+    accs = sorted(r.accelerator for r in task.resources)
+    assert accs == ['tpu-v5e-8', 'tpu-v6e-8']
+    assert all(r.use_spot for r in task.resources)
+
+
+def test_yaml_roundtrip(tmp_path):
+    src = _write_yaml(
+        tmp_path, """\
+        name: t
+        resources:
+          accelerator: tpu-v4-8
+        run: python x.py
+        """)
+    task = Task.from_yaml(src)
+    cfg = task.to_yaml_config()
+    task2 = Task.from_yaml_config(cfg)
+    assert task2.to_yaml_config() == cfg
+
+
+def test_dag_chain():
+    with Dag('pipeline') as dag:
+        a = Task('a', run='echo a')
+        b = Task('b', run='echo b')
+        c = Task('c', run='echo c')
+        a >> b >> c
+    assert len(dag) == 3
+    assert dag.is_chain()
+    assert dag.topological_order() == [a, b, c]
+
+
+def test_dag_not_chain():
+    with Dag() as dag:
+        a = Task('a', run=':')
+        b = Task('b', run=':')
+        c = Task('c', run=':')
+        d = Task('d', run=':')
+        a >> b
+        a >> c
+        b >> d
+        c >> d
+    assert not dag.is_chain()
+
+
+def test_set_resources_api():
+    t = Task(run='true')
+    t.set_resources(Resources(accelerator='v5e-8'))
+    assert t.get_preferred_resources().accelerator == 'tpu-v5e-8'
