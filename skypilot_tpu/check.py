@@ -1,0 +1,57 @@
+"""Credential checking / cloud enablement (`skytpu check`).
+
+Parity: sky/check.py — probes each cloud's credentials, caches the enabled
+set in the local state DB.
+"""
+from typing import List, Optional
+
+from skypilot_tpu import exceptions, logsys, state
+from skypilot_tpu.clouds import Cloud
+from skypilot_tpu.utils import ux
+
+logger = logsys.init_logger(__name__)
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe all clouds; persist and return the enabled list."""
+    enabled = []
+    lines = []
+    for cloud in Cloud.all_clouds():
+        ok, reason = cloud.check_credentials()
+        if ok:
+            enabled.append(cloud.NAME)
+            lines.append(f'  {ux.ok("[ok]")} {cloud}')
+        else:
+            lines.append(f'  {ux.error("[x]")} {cloud}: {reason}')
+    state.set_enabled_clouds(enabled)
+    if not quiet:
+        print('Checked credentials for all clouds:')
+        print('\n'.join(lines))
+        if not enabled:
+            print(
+                ux.warning('No cloud is enabled. The "local" cloud should '
+                           'always be available — this indicates a bug.'))
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[str]:
+    enabled = state.get_cached_enabled_clouds()
+    if not enabled:
+        enabled = check(quiet=True)
+    if raise_if_no_cloud_access and not enabled:
+        raise exceptions.NoCloudAccessError(
+            'No cloud access configured. Run `skytpu check`.')
+    return enabled
+
+
+def get_cloud_if_enabled(cloud_name: Optional[str]):
+    """Resolve a cloud name to an enabled Cloud instance (or raise)."""
+    if cloud_name is None:
+        return None
+    enabled = get_cached_enabled_clouds_or_refresh()
+    if cloud_name not in enabled:
+        raise exceptions.NoCloudAccessError(
+            f'Cloud {cloud_name!r} is not enabled (enabled: {enabled}). '
+            f'Run `skytpu check`.')
+    return Cloud.from_name(cloud_name)

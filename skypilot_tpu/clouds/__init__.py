@@ -1,0 +1,6 @@
+"""Cloud abstraction layer (parity: sky/clouds/)."""
+from skypilot_tpu.clouds.cloud import Cloud, CloudCapability, Region
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+__all__ = ['Cloud', 'CloudCapability', 'Region', 'GCP', 'Local']

@@ -1,0 +1,120 @@
+"""Abstract cloud interface.
+
+Parity: sky/clouds/cloud.py:116 — feasibility, pricing hooks, deploy
+variables, credential checks, capability flags — reduced to what a TPU-first
+framework needs (two concrete clouds: GCP and Local).
+"""
+import enum
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+
+class CloudCapability(enum.Enum):
+    """Parity: CloudImplementationFeatures (sky/clouds/cloud.py:28)."""
+    STOP = 'stop'
+    AUTOSTOP = 'autostop'
+    SPOT = 'spot'
+    OPEN_PORTS = 'open_ports'
+    MULTI_HOST = 'multi_host'
+    STORAGE_MOUNT = 'storage_mount'
+    HOST_CONTROLLERS = 'host_controllers'
+
+
+class Region:
+    def __init__(self, name: str, zones: Optional[List[str]] = None):
+        self.name = name
+        self.zones = zones or []
+
+    def __repr__(self):
+        return f'Region({self.name}, zones={self.zones})'
+
+
+class Cloud:
+    """A provider of slices/VMs.  Subclasses are stateless singletons."""
+
+    NAME = 'abstract'
+    _REGISTRY: Dict[str, 'Cloud'] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.NAME != 'abstract':
+            Cloud._REGISTRY[cls.NAME] = cls()
+
+    # ----------------------------------------------------------- registry
+
+    @classmethod
+    def from_name(cls, name: Optional[str]) -> Optional['Cloud']:
+        if name is None:
+            return None
+        # Import concrete clouds on first use (registers subclasses).
+        from skypilot_tpu.clouds import gcp, local  # noqa: F401  pylint: disable=unused-import
+        cloud = cls._REGISTRY.get(name.lower())
+        if cloud is None:
+            from skypilot_tpu import exceptions
+            raise exceptions.InvalidResourcesError(
+                f'Unknown cloud {name!r}. Supported: '
+                f'{sorted(cls._REGISTRY)}')
+        return cloud
+
+    @classmethod
+    def all_clouds(cls) -> List['Cloud']:
+        from skypilot_tpu.clouds import gcp, local  # noqa: F401  pylint: disable=unused-import
+        return list(cls._REGISTRY.values())
+
+    # ------------------------------------------------------- capabilities
+
+    def capabilities(self) -> set:
+        raise NotImplementedError
+
+    def supports(self, cap: CloudCapability) -> bool:
+        return cap in self.capabilities()
+
+    def unsupported_capabilities_for(self, resources) -> Dict[
+            CloudCapability, str]:
+        """Map of capability -> reason, for caps this placement lacks."""
+        return {}
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_resources(self, resources) -> List[Any]:
+        """Concrete launchable Resources (zone-unpinned) matching the
+        request, or [] if infeasible.  Parity:
+        sky/clouds/cloud.py:369 get_feasible_launchable_resources."""
+        raise NotImplementedError
+
+    def region_zones_for(self, resources) -> Iterator[Tuple[str,
+                                                            Optional[str]]]:
+        """Yield (region, zone) candidates in provisioning order.
+
+        TPU spot capacity is zone-granular, so TPUs yield per-zone (parity:
+        _yield_zones, sky/backends/cloud_vm_ray_backend.py:1178).
+        """
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ pricing
+
+    def hourly_cost(self, resources) -> float:
+        raise NotImplementedError
+
+    def egress_cost_per_gb(self, num_gb: float) -> float:
+        return 0.0
+
+    # ---------------------------------------------------------- deployment
+
+    def make_deploy_variables(self, resources, cluster_name: str,
+                              region: str, zone: Optional[str]) -> Dict[str,
+                                                                        Any]:
+        """Variables consumed by the provisioner for this placement.
+        Parity: make_deploy_resources_variables (sky/clouds/gcp.py:456)."""
+        raise NotImplementedError
+
+    # --------------------------------------------------------- credentials
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+    def get_active_user_identity(self) -> Optional[List[str]]:
+        return None
+
+    def __repr__(self):
+        return self.NAME.upper() if self.NAME == 'gcp' else self.NAME.title()

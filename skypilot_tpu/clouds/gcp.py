@@ -1,0 +1,200 @@
+"""GCP cloud: TPU pod slices (tpu.googleapis.com) + controller CPU VMs.
+
+Parity: sky/clouds/gcp.py — but TPU-first instead of TPU-aware: the
+reference bolts TPUs onto a GPU/VM model ('TPU-VM' pseudo instance type,
+sky/clouds/gcp.py:238); here the slice IS the unit, and plain VMs exist only
+to host the jobs/serve controllers.
+"""
+import os
+import subprocess
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import config as config_lib
+from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
+
+DEFAULT_CONTROLLER_VM = 'n2-standard-8'
+
+
+class GCP(Cloud):
+    NAME = 'gcp'
+
+    def capabilities(self) -> set:
+        return {
+            CloudCapability.SPOT,
+            CloudCapability.OPEN_PORTS,
+            CloudCapability.MULTI_HOST,
+            CloudCapability.STORAGE_MOUNT,
+            CloudCapability.HOST_CONTROLLERS,
+            # STOP/AUTOSTOP supported for CPU VMs only; TPU slices must be
+            # deleted (autostop => autodown for slices). Checked per-resource
+            # in unsupported_capabilities_for().
+            CloudCapability.STOP,
+            CloudCapability.AUTOSTOP,
+        }
+
+    def unsupported_capabilities_for(self, resources) -> Dict[
+            CloudCapability, str]:
+        out = {}
+        if resources.is_tpu:
+            # TPU slices cannot be stopped and restarted in place: the slice's
+            # ICI fabric allocation is released on stop. (The reference blocks
+            # stop on TPU pods similarly, sky/clouds/gcp.py:190-200.)
+            out[CloudCapability.STOP] = (
+                'TPU slices cannot be stopped; use autostop with down=True '
+                '(autodown) instead.')
+        return out
+
+    # -------------------------------------------------------- feasibility
+
+    def get_feasible_resources(self, resources) -> List[Any]:
+        if resources.cloud not in (None, 'gcp'):
+            return []
+        r = resources.copy(cloud='gcp')
+        if r.is_tpu:
+            if not catalog.accelerator_exists(r.accelerator):
+                return []
+            try:
+                catalog.validate_region_zone(r.accelerator, r.region, r.zone)
+            except Exception:  # pylint: disable=broad-except
+                return []
+            return [r]
+        # CPU-only: resolve cpus/memory to a concrete instance type.
+        if r.instance_type is None:
+            instance = catalog.get_vm_for_cpus(r.cpus, r.memory)
+            if instance is None:
+                return []
+            r = r.copy(instance_type=instance)
+        return [r]
+
+    def region_zones_for(self, resources) -> Iterator[Tuple[str,
+                                                            Optional[str]]]:
+        if resources.is_tpu:
+            pairs = catalog.get_regions_zones(resources.accelerator)
+        else:
+            instance = resources.instance_type or catalog.get_vm_for_cpus(
+                resources.cpus, resources.memory)
+            pairs = catalog.get_vm_regions_zones(instance)
+        for region, zone in pairs:
+            if resources.region is not None and region != resources.region:
+                continue
+            if resources.zone is not None and zone != resources.zone:
+                continue
+            yield region, zone
+
+    # ------------------------------------------------------------ pricing
+
+    def hourly_cost(self, resources) -> float:
+        return resources.get_cost(3600)
+
+    def egress_cost_per_gb(self, num_gb: float) -> float:
+        # Simplified public tiered egress pricing.
+        if num_gb <= 0:
+            return 0.0
+        if num_gb <= 1024:
+            return 0.12
+        if num_gb <= 10240:
+            return 0.11
+        return 0.08
+
+    # ---------------------------------------------------------- deployment
+
+    def make_deploy_variables(self, resources, cluster_name: str,
+                              region: str, zone: Optional[str]) -> Dict[str,
+                                                                        Any]:
+        project = self.get_project_id()
+        base = {
+            'cluster_name': cluster_name,
+            'project_id': project,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'labels': resources.labels or {},
+            'ports': resources.ports or [],
+        }
+        if resources.is_tpu:
+            info = resources.slice_info
+            base.update({
+                'node_kind': 'tpu_slice',
+                'accelerator': info.accelerator,
+                'tpu_type': _gcp_accelerator_type(info),
+                'topology': info.topology,
+                'runtime_version': resources.runtime_version,
+                'num_hosts': info.hosts,
+                'chips_per_host': info.chips_per_host,
+                'reservation': resources.reservation,
+                'network': resources.accelerator_args.get('network'),
+                'subnetwork': resources.accelerator_args.get('subnetwork'),
+                'queued_resource':
+                    bool(resources.accelerator_args.get('queued_resource')),
+            })
+        else:
+            instance = resources.instance_type or catalog.get_vm_for_cpus(
+                resources.cpus, resources.memory)
+            base.update({
+                'node_kind': 'vm',
+                'instance_type': instance,
+                'image_id': resources.image_id,
+                'num_hosts': 1,
+            })
+        return base
+
+    # --------------------------------------------------------- credentials
+
+    def get_project_id(self) -> Optional[str]:
+        project = config_lib.get_nested(('gcp', 'project_id'))
+        if project:
+            return project
+        project = os.environ.get('GOOGLE_CLOUD_PROJECT')
+        if project:
+            return project
+        try:
+            out = subprocess.run(
+                ['gcloud', 'config', 'get-value', 'project'],
+                capture_output=True, text=True, timeout=10, check=False)
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip()
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return None
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        adc = os.environ.get('GOOGLE_APPLICATION_CREDENTIALS')
+        if adc and os.path.exists(os.path.expanduser(adc)):
+            if self.get_project_id() is None:
+                return False, ('Found credentials but no project id; set '
+                               'gcp.project_id in ~/.skytpu/config.yaml or '
+                               'GOOGLE_CLOUD_PROJECT.')
+            return True, None
+        default_adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.path.exists(default_adc):
+            if self.get_project_id() is None:
+                return False, ('Found application-default credentials but no '
+                               'project id configured.')
+            return True, None
+        return False, (
+            'GCP credentials not found. Run `gcloud auth '
+            'application-default login`, or set '
+            'GOOGLE_APPLICATION_CREDENTIALS.')
+
+    def get_active_user_identity(self) -> Optional[List[str]]:
+        # [account, project] — changes when the user switches accounts.
+        try:
+            out = subprocess.run(
+                ['gcloud', 'config', 'get-value', 'account'],
+                capture_output=True, text=True, timeout=10, check=False)
+            account = out.stdout.strip() if out.returncode == 0 else None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            account = None
+        if not account:
+            return None
+        return [account, self.get_project_id() or '']
+
+
+def _gcp_accelerator_type(info: catalog.SliceInfo) -> str:
+    """Catalog name -> GCP acceleratorType string ('v5litepod-16')."""
+    size = info.chips if info.generation in ('v5e', 'v6e') else info.chips * 2
+    gen = 'v5litepod' if info.generation == 'v5e' else info.generation
+    return f'{gen}-{size}'

@@ -1,0 +1,70 @@
+"""Local cloud: simulates slices with localhost processes & directories.
+
+This is the framework's dev/test backend — the analog of the reference's
+LocalDockerBackend (sky/backends/local_docker_backend.py) *and* its
+fake-cloud test tier (tests/common.py enable_all_clouds_in_monkeypatch):
+a "host" is a directory under $SKYTPU_HOME/local_cloud/<cluster>/<host_i>,
+commands run via subprocess, and multi-host fan-out exercises the exact same
+backend/podlet code paths as real TPU slices.  Provisioning latency and
+stockouts are injectable for failover tests.
+"""
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
+
+# Tests can set this to simulate stockouts: {zone: Exception-to-raise}.
+FAULT_INJECTION: Dict[str, Any] = {}
+
+_ZONES = ['local-a', 'local-b', 'local-c']
+
+
+class Local(Cloud):
+    NAME = 'local'
+
+    def capabilities(self) -> set:
+        return {
+            CloudCapability.SPOT,
+            CloudCapability.MULTI_HOST,
+            CloudCapability.AUTOSTOP,
+            CloudCapability.STOP,
+            CloudCapability.HOST_CONTROLLERS,
+            CloudCapability.OPEN_PORTS,
+        }
+
+    def get_feasible_resources(self, resources) -> List[Any]:
+        if resources.cloud != 'local':
+            # Local is opt-in: never chosen unless explicitly requested.
+            return []
+        return [resources]
+
+    def region_zones_for(self, resources) -> Iterator[Tuple[str,
+                                                            Optional[str]]]:
+        for zone in _ZONES:
+            if resources.zone is not None and zone != resources.zone:
+                continue
+            yield 'local', zone
+
+    def hourly_cost(self, resources) -> float:
+        return 0.0
+
+    def make_deploy_variables(self, resources, cluster_name: str,
+                              region: str, zone: Optional[str]) -> Dict[str,
+                                                                        Any]:
+        num_hosts = resources.num_hosts if resources.is_tpu else 1
+        return {
+            'cluster_name': cluster_name,
+            'node_kind': 'local',
+            'region': region,
+            'zone': zone,
+            'num_hosts': num_hosts,
+            'chips_per_host': resources.chips_per_host,
+            'use_spot': resources.use_spot,
+            'accelerator': resources.accelerator,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    def get_active_user_identity(self) -> Optional[List[str]]:
+        from skypilot_tpu.utils import common
+        return [common.get_user_hash()]

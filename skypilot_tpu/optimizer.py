@@ -1,0 +1,259 @@
+"""Placement optimizer: pick (cloud, slice/VM, region, zone, spot) per task.
+
+Parity: sky/optimizer.py — enumerate feasible "launchables" per task with
+$/hr from the catalog, then minimize cost or end-to-end time over the DAG
+(chain DAGs via DP, sky/optimizer.py:409; general DAGs via ILP, :470).
+
+TPU-first differences:
+- Candidates are zone-granular (TPU capacity and stockouts are per-zone),
+  and the *ranked candidate list* is kept on each task for the failover
+  provisioner to walk (stockout is the dominant failure mode).
+- The TIME objective uses a simple roofline: estimated task duration scales
+  inversely with the slice's aggregate bf16 TFLOPs, so "minimize time"
+  naturally prefers bigger/faster slices while "minimize cost" prefers
+  cheaper ones.
+- The general-DAG solver is an exact branch-and-bound over the (small) TPU
+  catalog instead of an external pulp/CBC dependency.
+"""
+import enum
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import exceptions, logsys
+from skypilot_tpu.clouds import Cloud
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import timeline, ux
+
+logger = logsys.init_logger(__name__)
+
+_DEFAULT_DURATION_HOURS = 1.0
+# Reference slice for duration scaling: a v5e-8 (8 x 196.8 TFLOPs).
+_REFERENCE_TFLOPS = 8 * 196.8
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+class Candidate:
+    """One concrete placement choice with its estimated cost/time."""
+
+    __slots__ = ('resources', 'region', 'zone', 'cost_per_hour',
+                 'duration_hours')
+
+    def __init__(self, resources: Resources, region: str, zone: Optional[str],
+                 cost_per_hour: float, duration_hours: float):
+        self.resources = resources
+        self.region = region
+        self.zone = zone
+        self.cost_per_hour = cost_per_hour
+        self.duration_hours = duration_hours
+
+    @property
+    def total_cost(self) -> float:
+        return self.cost_per_hour * self.duration_hours
+
+    def __repr__(self):
+        return (f'<Candidate {self.resources.pretty()} {self.zone} '
+                f'${self.cost_per_hour:.2f}/hr {self.duration_hours:.2f}h>')
+
+
+def _estimate_duration_hours(task, resources: Resources) -> float:
+    """Roofline duration estimate (parity role:
+    _estimate_nodes_cost_or_time, sky/optimizer.py:239)."""
+    base = task.estimated_duration_hours or _DEFAULT_DURATION_HOURS
+    info = resources.slice_info
+    if info is None:
+        return base
+    # Sublinear speedup (communication overhead grows with slice size):
+    # speedup = (relative TFLOPs)^0.9.  This makes "minimize time" prefer
+    # bigger slices while "minimize cost" prefers smaller/cheaper ones.
+    rel = max(info.total_tflops_bf16, 1e-9) / _REFERENCE_TFLOPS
+    return base / (rel ** 0.9)
+
+
+def _enumerate_candidates(task, blocked: Optional[List[Resources]]
+                          ) -> List[Candidate]:
+    """All feasible (resources, region, zone) placements for one task."""
+    enabled = check_lib.get_cached_enabled_clouds_or_refresh()
+    blocked = blocked or []
+    out: List[Candidate] = []
+    for want in task.resources:
+        clouds = ([Cloud.from_name(want.cloud)]
+                  if want.cloud is not None else
+                  [Cloud.from_name(name) for name in enabled])
+        for cloud in clouds:
+            if cloud is None or cloud.NAME not in enabled:
+                continue
+            for feasible in cloud.get_feasible_resources(want):
+                for region, zone in cloud.region_zones_for(feasible):
+                    pinned = feasible.copy(region=region, zone=zone)
+                    if any(pinned.should_be_blocked_by(b) for b in blocked):
+                        continue
+                    try:
+                        cost = cloud.hourly_cost(pinned) * task.num_nodes
+                    except exceptions.ResourcesUnavailableError:
+                        continue
+                    out.append(
+                        Candidate(pinned, region, zone, cost,
+                                  _estimate_duration_hours(task, pinned)))
+    return out
+
+
+def _rank(candidates: List[Candidate],
+          minimize: OptimizeTarget) -> List[Candidate]:
+    if minimize == OptimizeTarget.COST:
+        return sorted(candidates,
+                      key=lambda c: (c.total_cost, c.duration_hours))
+    return sorted(candidates, key=lambda c: (c.duration_hours, c.total_cost))
+
+
+@timeline.event
+def optimize(dag,
+             minimize: OptimizeTarget = OptimizeTarget.COST,
+             blocked_resources: Optional[List[Resources]] = None,
+             quiet: bool = False):
+    """Assign ``task.best_resources`` (and ranked ``task.candidates``) for
+    every task in the DAG.  Returns the same DAG.
+
+    Raises ResourcesUnavailableError if any task has no feasible placement.
+    """
+    per_task: Dict[object, List[Candidate]] = {}
+    for task in dag.tasks:
+        cands = _enumerate_candidates(task, blocked_resources)
+        if not cands:
+            raise exceptions.ResourcesUnavailableError(
+                f'No feasible placement for task {task.name or task!r}. '
+                f'Requested: '
+                f'{[r.pretty() for r in task.resources]}. Check `skytpu '
+                f'check` and the catalog (`skytpu show-tpus`).')
+        per_task[task] = _rank(cands, minimize)
+
+    if len(dag.tasks) <= 1 or dag.is_chain():
+        choice = _optimize_chain_dp(dag, per_task, minimize)
+    else:
+        choice = _optimize_general_bb(dag, per_task, minimize)
+
+    for task, cand in choice.items():
+        ranked = per_task[task]
+        # Failover order: chosen candidate first, then remaining by rank.
+        task.candidates = [cand] + [c for c in ranked if c is not cand]
+        task.best_resources = cand.resources
+    if not quiet:
+        _print_plan(dag, choice, minimize)
+    return dag
+
+
+def _egress_cost(src: Candidate, dst: Candidate, gb: float = 0.0) -> float:
+    """Cross-placement egress between consecutive tasks.  Tasks don't yet
+    declare output sizes, so this is 0 unless regions differ (small constant
+    penalty keeps pipelines co-located, matching the reference's intent)."""
+    if gb <= 0 and src.region == dst.region:
+        return 0.0
+    per_gb = 0.12 if src.region != dst.region else 0.0
+    return per_gb * max(gb, 1.0) if src.region != dst.region else 0.0
+
+
+def _objective(cand: Candidate, minimize: OptimizeTarget) -> float:
+    return (cand.total_cost
+            if minimize == OptimizeTarget.COST else cand.duration_hours)
+
+
+def _optimize_chain_dp(dag, per_task, minimize) -> Dict[object, Candidate]:
+    """Exact forward DP over a linear chain with pairwise egress costs
+    (parity: sky/optimizer.py:409)."""
+    order = dag.topological_order()
+    layers: List[List[Candidate]] = [per_task[t] for t in order]
+    costs: List[Dict[int, float]] = [{}]
+    parents: List[Dict[int, int]] = [{}]
+    for j, cand in enumerate(layers[0]):
+        costs[0][j] = _objective(cand, minimize)
+    for i in range(1, len(layers)):
+        costs.append({})
+        parents.append({})
+        for j, cand in enumerate(layers[i]):
+            best, arg = float('inf'), -1
+            for pj, pval in costs[i - 1].items():
+                val = pval + _objective(cand, minimize) + _egress_cost(
+                    layers[i - 1][pj], cand)
+                if val < best:
+                    best, arg = val, pj
+            costs[i][j] = best
+            parents[i][j] = arg
+    j = min(costs[-1], key=costs[-1].get)  # type: ignore[arg-type]
+    choice: Dict[object, Candidate] = {}
+    for i in range(len(layers) - 1, -1, -1):
+        choice[order[i]] = layers[i][j]
+        if i > 0:
+            j = parents[i][j]
+    return choice
+
+
+def _optimize_general_bb(dag, per_task, minimize) -> Dict[object, Candidate]:
+    """Exact branch-and-bound for general DAGs (parity role:
+    _optimize_by_ilp, sky/optimizer.py:470 — without the pulp dependency).
+
+    Candidates per task are capped to the top-K to bound the search; the
+    remaining tail is still available to the failover provisioner.
+    """
+    topk = 8
+    order = dag.topological_order()
+    layers = [per_task[t][:topk] for t in order]
+    graph = dag.get_graph()
+    index = {t: i for i, t in enumerate(order)}
+    preds: List[List[int]] = [
+        [index[p] for p in graph.predecessors(t)] for t in order
+    ]
+    # Lower bound: sum of per-task minima for unassigned tasks.
+    min_rest = [0.0] * (len(order) + 1)
+    for i in range(len(order) - 1, -1, -1):
+        min_rest[i] = min_rest[i + 1] + min(
+            _objective(c, minimize) for c in layers[i])
+    best_val = float('inf')
+    best_assign: Optional[List[int]] = None
+    assign: List[int] = [-1] * len(order)
+
+    def _dfs(i: int, acc: float):
+        nonlocal best_val, best_assign
+        if acc + min_rest[i] >= best_val:
+            return
+        if i == len(order):
+            best_val, best_assign = acc, assign.copy()
+            return
+        for j, cand in enumerate(layers[i]):
+            extra = _objective(cand, minimize)
+            for p in preds[i]:
+                extra += _egress_cost(layers[p][assign[p]], cand)
+            assign[i] = j
+            _dfs(i + 1, acc + extra)
+        assign[i] = -1
+
+    _dfs(0, 0.0)
+    assert best_assign is not None
+    return {t: layers[i][best_assign[i]] for i, t in enumerate(order)}
+
+
+def _print_plan(dag, choice: Dict[object, Candidate],
+                minimize: OptimizeTarget) -> None:
+    rows = []
+    total_cost = 0.0
+    for task in dag.topological_order():
+        cand = choice[task]
+        total_cost += cand.total_cost
+        rows.append((task.name or '-', cand.resources.pretty(),
+                     cand.zone or cand.region,
+                     f'${cand.cost_per_hour:.2f}/hr',
+                     f'~{cand.duration_hours:.2f}h',
+                     f'${cand.total_cost:.2f}'))
+    name_w = max(len(r[0]) for r in rows) + 2
+    res_w = max(len(r[1]) for r in rows) + 2
+    zone_w = max(len(r[2]) for r in rows) + 2
+    print(ux.emph(f'Optimizer plan (minimizing {minimize.value}):'))
+    header = (f'  {"TASK":<{name_w}}{"RESOURCES":<{res_w}}'
+              f'{"ZONE":<{zone_w}}{"PRICE":<12}{"EST.TIME":<10}{"EST.COST"}')
+    print(header)
+    for r in rows:
+        print(f'  {r[0]:<{name_w}}{r[1]:<{res_w}}{r[2]:<{zone_w}}'
+              f'{r[3]:<12}{r[4]:<10}{r[5]}')
+    print(f'  Estimated total cost: ${total_cost:.2f}')

@@ -192,10 +192,9 @@ class Resources:
                 raise exceptions.InvalidResourcesError(
                     'Cannot specify both accelerator and instance_type; the '
                     'TPU slice shape determines its host VMs.')
-            if self._cloud == 'local':
-                # Local cloud simulates slices with processes; allow it for
-                # the dryrun/fake-cloud test tier.
-                pass
+            # Note: cloud='local' simulates slices with processes but still
+            # uses the real catalog, so shapes/zones are validated uniformly.
+            catalog.get_slice_info(self._accelerator)  # raises if unknown
             catalog.validate_region_zone(self._accelerator, self._region,
                                          self._zone)
             bad_keys = set(self._accelerator_args) - {
@@ -354,13 +353,21 @@ class Resources:
         if unknown:
             raise exceptions.InvalidTaskError(
                 f'Unknown resources fields: {sorted(unknown)}')
-        acc = config.pop('accelerator', None) or config.pop(
-            'accelerators', None)
+        acc_singular = config.pop('accelerator', None)
+        acc_plural = config.pop('accelerators', None)
+        if acc_singular is not None and acc_plural is not None:
+            raise exceptions.InvalidTaskError(
+                "Specify either 'accelerator' or 'accelerators', not both.")
+        acc = acc_singular if acc_singular is not None else acc_plural
         if isinstance(acc, dict):
-            # reference-style {'V100': 4}; TPU slices are a single string
-            if len(acc) != 1:
+            # reference-style {'V100': 4} mapping; a TPU slice is a single
+            # string and its shape already encodes the count.
+            if len(acc) != 1 or next(iter(acc.values())) not in (1, None):
                 raise exceptions.InvalidTaskError(
-                    'accelerators mapping must have exactly one entry')
+                    'accelerators mapping must be a single entry with count '
+                    "1; TPU slice shapes encode their own size (use e.g. "
+                    "accelerator: tpu-v5e-16, or num_nodes for multiple "
+                    'slices).')
             acc = next(iter(acc))
         ports = config.pop('ports', None)
         if ports is not None and not isinstance(ports, list):

@@ -164,7 +164,7 @@ class Task:
             workdir=config.get('workdir'),
             num_nodes=config.get('num_nodes'),
         )
-        res_config = config.get('resources') or {}
+        res_config = dict(config.get('resources') or {})
         any_of = res_config.pop('any_of', None)
         if any_of:
             base = Resources.from_yaml_config(res_config)

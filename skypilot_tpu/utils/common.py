@@ -84,7 +84,8 @@ def get_user_hash() -> str:
 
 
 def get_run_timestamp() -> str:
-    return 'skytpu-' + time.strftime('%Y-%m-%d-%H-%M-%S-%f', time.localtime())
+    import datetime
+    return 'skytpu-' + datetime.datetime.now().strftime('%Y-%m-%d-%H-%M-%S-%f')
 
 
 def make_task_id(task_name: Optional[str], job_id: Optional[int] = None) -> str:

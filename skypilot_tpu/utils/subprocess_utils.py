@@ -69,6 +69,7 @@ def run_with_log(cmd: Union[str, List[str]],
             stderr=subprocess.STDOUT,
             start_new_session=start_new_session,
             text=True,
+            errors='replace',  # job output may contain non-UTF-8 bytes
             bufsize=1,
         )
         assert proc.stdout is not None

@@ -69,16 +69,19 @@ def print_exception_no_traceback():
 @contextlib.contextmanager
 def spinner(message: str):
     """Lightweight rich spinner; degrades to a plain print when not a tty."""
-    try:
-        import rich.status  # lazy
-        if _tty():
-            with rich.status.Status(message):
-                yield
-            return
-    except Exception:  # pylint: disable=broad-except
-        pass
-    print(message)
-    yield
+    status = None
+    if _tty():
+        try:
+            import rich.status  # lazy
+            status = rich.status.Status(message)
+        except Exception:  # pylint: disable=broad-except
+            status = None
+    if status is None:
+        print(message)
+        yield
+        return
+    with status:
+        yield
 
 
 class StatusMessage:

@@ -1,0 +1,128 @@
+"""Optimizer tests (parity: tests/test_optimizer_dryruns.py — plan-level
+testing with no cloud calls)."""
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions, state
+from skypilot_tpu.optimizer import OptimizeTarget, optimize
+
+
+@pytest.fixture(autouse=True)
+def _enable(skytpu_home):
+    state.set_enabled_clouds(['gcp', 'local'])
+
+
+def _single_task_dag(task):
+    with Dag() as dag:
+        dag.add(task)
+    return dag
+
+
+def test_cost_picks_cheapest_zone():
+    task = Task('t', run='true')
+    task.set_resources(Resources(accelerator='tpu-v5e-8'))
+    optimize(_single_task_dag(task), quiet=True)
+    assert task.best_resources is not None
+    assert task.best_resources.zone is not None
+    # Cheapest v5e zone has multiplier 1.0 (us zones).
+    assert task.best_resources.zone.startswith('us-')
+    assert len(task.candidates) >= 4  # all zones available for failover
+
+
+def test_time_prefers_bigger_slice():
+    task = Task('t', run='true')
+    task.set_resources({
+        Resources(accelerator='tpu-v5e-8'),
+        Resources(accelerator='tpu-v5e-64'),
+    })
+    optimize(_single_task_dag(task), minimize=OptimizeTarget.TIME, quiet=True)
+    assert task.best_resources.accelerator == 'tpu-v5e-64'
+    task2 = Task('t2', run='true')
+    task2.set_resources({
+        Resources(accelerator='tpu-v5e-8'),
+        Resources(accelerator='tpu-v5e-64'),
+    })
+    optimize(_single_task_dag(task2), minimize=OptimizeTarget.COST, quiet=True)
+    assert task2.best_resources.accelerator == 'tpu-v5e-8'
+
+
+def test_spot_candidates_cheaper():
+    t_od = Task('od', run='true')
+    t_od.set_resources(Resources(accelerator='tpu-v4-8'))
+    t_spot = Task('spot', run='true')
+    t_spot.set_resources(Resources(accelerator='tpu-v4-8', use_spot=True))
+    optimize(_single_task_dag(t_od), quiet=True)
+    optimize(_single_task_dag(t_spot), quiet=True)
+    assert (t_spot.candidates[0].cost_per_hour <
+            t_od.candidates[0].cost_per_hour)
+
+
+def test_blocked_resources_skipped():
+    task = Task('t', run='true')
+    task.set_resources(Resources(accelerator='tpu-v4-8'))
+    # v4 only exists in us-central2-b; blocking it makes the task infeasible.
+    blocked = [Resources(accelerator='tpu-v4-8', zone='us-central2-b',
+                         region='us-central2')]
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimize(_single_task_dag(task), blocked_resources=blocked,
+                 quiet=True)
+
+
+def test_infeasible_accelerator():
+    task = Task('t', run='true')
+    task.set_resources(Resources(accelerator='tpu-v5e-8', region='us-west4'))
+    state.set_enabled_clouds(['local'])  # gcp disabled -> no feasible cloud
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        optimize(_single_task_dag(task), quiet=True)
+
+
+def test_chain_dag_co_location():
+    with Dag() as dag:
+        a = Task('a', run='true')
+        a.set_resources(Resources(accelerator='tpu-v5e-8'))
+        b = Task('b', run='true')
+        b.set_resources(Resources(accelerator='tpu-v5e-8'))
+        a >> b
+    optimize(dag, quiet=True)
+    # Same region avoids egress penalty.
+    assert a.best_resources.region == b.best_resources.region
+
+
+def test_general_dag():
+    with Dag() as dag:
+        a = Task('a', run='true')
+        a.set_resources(Resources(accelerator='tpu-v5e-8'))
+        b = Task('b', run='true')
+        b.set_resources(Resources(accelerator='tpu-v5e-8'))
+        c = Task('c', run='true')
+        c.set_resources(Resources(accelerator='tpu-v5e-8'))
+        d = Task('d', run='true')
+        d.set_resources(Resources(accelerator='tpu-v5e-8'))
+        a >> b
+        a >> c
+        b >> d
+        c >> d
+    optimize(dag, quiet=True)
+    regions = {t.best_resources.region for t in dag.tasks}
+    assert len(regions) == 1  # co-located, no egress
+
+
+def test_num_nodes_multiplies_cost():
+    t1 = Task('one', run='true')
+    t1.set_resources(Resources(accelerator='tpu-v5e-8'))
+    t2 = Task('two', run='true', num_nodes=2)
+    t2.set_resources(Resources(accelerator='tpu-v5e-8'))
+    optimize(_single_task_dag(t1), quiet=True)
+    optimize(_single_task_dag(t2), quiet=True)
+    assert t2.candidates[0].cost_per_hour == pytest.approx(
+        2 * t1.candidates[0].cost_per_hour)
+
+
+def test_local_cloud_requires_opt_in():
+    task = Task('t', run='true')  # no cloud specified
+    optimize(_single_task_dag(task), quiet=True)
+    assert task.best_resources.cloud == 'gcp'
+    t2 = Task('t2', run='true')
+    t2.set_resources(Resources(cloud='local'))
+    optimize(_single_task_dag(t2), quiet=True)
+    assert t2.best_resources.cloud == 'local'
+    assert t2.candidates[0].cost_per_hour == 0.0
