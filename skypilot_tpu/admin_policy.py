@@ -1,0 +1,55 @@
+"""Pluggable admin policy applied to every launch.
+
+Parity: sky/admin_policy.py:61 + sky/utils/admin_policy_utils.py — an org
+can point ``admin_policy: mymodule.MyPolicy`` in config at a class with
+``validate_and_mutate(task) -> task`` to enforce labels, forbid on-demand,
+cap slice sizes, etc.
+"""
+import importlib
+from typing import Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions, logsys
+
+logger = logsys.init_logger(__name__)
+
+
+class AdminPolicy:
+    """Base policy: identity."""
+
+    def validate_and_mutate(self, task):
+        return task
+
+
+_cached_policy: Optional[AdminPolicy] = None
+_cached_path: Optional[str] = None
+
+
+def _load() -> Optional[AdminPolicy]:
+    global _cached_policy, _cached_path
+    path = config_lib.get_nested(('admin_policy',))
+    if path is None:
+        return None
+    if _cached_policy is not None and _cached_path == path:
+        return _cached_policy
+    try:
+        module_name, class_name = path.rsplit('.', 1)
+        module = importlib.import_module(module_name)
+        cls = getattr(module, class_name)
+    except (ImportError, AttributeError, ValueError) as e:
+        raise exceptions.InvalidTaskError(
+            f'Cannot load admin policy {path!r}: {e}') from e
+    policy = cls()
+    if not hasattr(policy, 'validate_and_mutate'):
+        raise exceptions.InvalidTaskError(
+            f'Admin policy {path!r} lacks validate_and_mutate().')
+    _cached_policy, _cached_path = policy, path
+    return policy
+
+
+def apply(task):
+    policy = _load()
+    if policy is None:
+        return task
+    logger.debug('Applying admin policy %s.', type(policy).__name__)
+    return policy.validate_and_mutate(task)

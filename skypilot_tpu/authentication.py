@@ -1,0 +1,50 @@
+"""SSH key management: one framework keypair, injected per cloud.
+
+Parity: sky/authentication.py — generates ``~/.skytpu/keys/skytpu-key``
+once; the public key is injected into TPU-VM / GCE instance metadata at
+provision time so the client can SSH without gcloud.
+"""
+import os
+import subprocess
+from typing import Tuple
+
+import filelock
+
+from skypilot_tpu import logsys
+from skypilot_tpu.utils import common
+
+logger = logsys.init_logger(__name__)
+
+PRIVATE_KEY_NAME = 'skytpu-key'
+
+
+def get_key_paths() -> Tuple[str, str]:
+    d = common.keys_dir()
+    return (os.path.join(d, PRIVATE_KEY_NAME),
+            os.path.join(d, PRIVATE_KEY_NAME + '.pub'))
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path), generating once."""
+    private, public = get_key_paths()
+    lock = filelock.FileLock(private + '.lock')
+    with lock:
+        if not (os.path.exists(private) and os.path.exists(public)):
+            common.ensure_dir(os.path.dirname(private))
+            subprocess.run(
+                ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', private,
+                 '-C', f'skytpu-{common.get_user_hash()}'],
+                check=True)
+            os.chmod(private, 0o600)
+    return private, public
+
+
+def public_key_openssh() -> str:
+    _, public = get_or_generate_keys()
+    with open(public, 'r', encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def default_ssh_user() -> str:
+    # TPU VMs accept any user present in the injected ssh-keys metadata.
+    return 'skytpu'

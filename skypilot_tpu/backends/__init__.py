@@ -1,0 +1,6 @@
+"""Execution backends (parity: sky/backends/)."""
+from skypilot_tpu.backends.backend import Backend, ResourceHandle
+from skypilot_tpu.backends.slice_backend import (SliceBackend,
+                                                 SliceResourceHandle)
+
+__all__ = ['Backend', 'ResourceHandle', 'SliceBackend', 'SliceResourceHandle']

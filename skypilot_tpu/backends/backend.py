@@ -1,0 +1,50 @@
+"""Abstract backend lifecycle contract.
+
+Parity: sky/backends/backend.py:24,30 — provision / sync_workdir /
+sync_file_mounts / setup / execute / teardown, plus the pickled
+ResourceHandle stored in the local state DB.
+"""
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+
+class ResourceHandle:
+    """Opaque per-cluster record persisted in the state DB."""
+    cluster_name: str
+
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+
+_HandleT = TypeVar('_HandleT', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleT]):
+    NAME = 'backend'
+
+    # Stage methods; each corresponds to an execution.Stage.
+    def provision(self, task, to_provision, dryrun: bool,
+                  stream_logs: bool, cluster_name: str,
+                  retry_until_up: bool = False) -> Optional[_HandleT]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: _HandleT, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: _HandleT,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: _HandleT, task, detach_setup: bool) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: _HandleT, task, detach_run: bool,
+                dryrun: bool = False) -> Optional[int]:
+        raise NotImplementedError
+
+    def post_execute(self, handle: _HandleT, down: bool) -> None:
+        del handle, down
+
+    def teardown(self, handle: _HandleT, terminate: bool,
+                 purge: bool = False) -> None:
+        raise NotImplementedError

@@ -1,0 +1,520 @@
+"""SliceBackend: the production backend for TPU pod slices (and the local
+simulated slices / controller VMs).
+
+Parity: CloudVmRayBackend (sky/backends/cloud_vm_ray_backend.py:2539) +
+RetryingVmProvisioner (:1134) + the failover error handlers (:707-1133) —
+re-designed without Ray: job submission goes through podlet codegen, gang
+execution through the podlet driver, and failover walks the optimizer's
+ranked zone-granular candidates (stockout being the dominant TPU failure).
+"""
+import getpass
+import os
+import textwrap
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, logsys, provision, state
+from skypilot_tpu.backends.backend import Backend, ResourceHandle
+from skypilot_tpu.podlet import codegen as podlet_codegen
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import (command_runner, common, locks,
+                                subprocess_utils, timeline, ux)
+
+logger = logsys.init_logger(__name__)
+
+_WORKDIR_REMOTE = '~/sky_workdir'
+_PROVISION_RETRY_GAP_SECONDS = 30
+
+
+class SliceResourceHandle(ResourceHandle):
+    """Pickled per-cluster record.
+    Parity: CloudVmRayResourceHandle (cloud_vm_ray_backend.py:2077)."""
+
+    _VERSION = 1
+
+    def __init__(self, cluster_name: str, launched_resources: Resources,
+                 launched_nodes: int = 1):
+        self._version = self._VERSION
+        self.cluster_name = cluster_name
+        self.launched_resources = launched_resources
+        self.launched_nodes = launched_nodes  # slices (1 for now)
+        self.stable_internal_external_ips: Optional[List] = None
+        self.cached_cluster_info: Optional[Dict[str, Any]] = None
+        self.run_timestamp: Optional[str] = None
+
+    @property
+    def provider(self) -> str:
+        return self.launched_resources.cloud or 'gcp'
+
+    @property
+    def num_hosts(self) -> int:
+        """Hosts per slice (parity: num_ips_per_node,
+        cloud_vm_ray_backend.py:2469)."""
+        return self.launched_resources.num_hosts
+
+    def cluster_info(self, refresh: bool = False) -> ClusterInfo:
+        if self.cached_cluster_info is None or refresh:
+            info = provision.get_cluster_info(self.provider, None, None,
+                                              self.cluster_name)
+            self.cached_cluster_info = info.to_json()
+            self.stable_internal_external_ips = list(
+                zip(info.internal_ips(), info.external_ips()))
+            state.update_cluster_handle(self.cluster_name, self)
+        return ClusterInfo.from_json(self.cached_cluster_info)
+
+    def get_command_runners(
+            self, refresh: bool = False
+    ) -> List[command_runner.CommandRunner]:
+        info = self.cluster_info(refresh=refresh)
+        return provision.get_command_runners(self.provider, info)
+
+    def head_runner(self) -> command_runner.CommandRunner:
+        return self.get_command_runners()[0]
+
+    def __repr__(self):
+        return (f'<SliceResourceHandle {self.cluster_name}: '
+                f'{self.launched_resources.pretty()}>')
+
+
+def _log_dir_for(cluster_name: str) -> str:
+    d = os.path.join(common.logs_dir(), cluster_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class RetryingProvisioner:
+    """Walks optimizer-ranked candidates, consuming a blocklist.
+
+    Parity: RetryingVmProvisioner.provision_with_retries
+    (cloud_vm_ray_backend.py:1934) + FailoverCloudErrorHandlerV2 (:914):
+    - stockout     -> block this zone for this accelerator
+    - quota        -> block the whole region
+    - non-retryable-> abort failover entirely
+    """
+
+    def __init__(self, cluster_name: str, log_path: str):
+        self.cluster_name = cluster_name
+        self.log_path = log_path
+        self.blocked: List[Resources] = []
+        self.failover_history: List[Exception] = []
+
+    def _update_blocklist(self, resources: Resources,
+                          error: Exception) -> None:
+        if isinstance(error, exceptions.QuotaExceededError):
+            self.blocked.append(
+                Resources(cloud=resources.cloud,
+                          accelerator=resources.accelerator,
+                          region=resources.region,
+                          use_spot=resources.use_spot))
+            logger.warning('Quota exhausted: blocking region %s.',
+                           resources.region)
+        elif isinstance(error, exceptions.TpuStockoutError):
+            self.blocked.append(
+                Resources(cloud=resources.cloud,
+                          accelerator=resources.accelerator,
+                          region=resources.region,
+                          zone=resources.zone,
+                          use_spot=resources.use_spot))
+            logger.warning('No capacity: blocking zone %s.', resources.zone)
+        else:
+            self.blocked.append(
+                Resources(cloud=resources.cloud,
+                          accelerator=resources.accelerator,
+                          region=resources.region,
+                          zone=resources.zone,
+                          use_spot=resources.use_spot))
+
+    def provision_with_retries(self, task, candidates,
+                               retry_until_up: bool):
+        """Try candidates in order; returns (chosen Candidate,
+        ProvisionRecord, deploy_config)."""
+        from skypilot_tpu.clouds import Cloud
+        while True:
+            for cand in candidates:
+                resources = cand.resources
+                if any(
+                        resources.should_be_blocked_by(b)
+                        for b in self.blocked):
+                    continue
+                cloud = Cloud.from_name(resources.cloud)
+                config = cloud.make_deploy_variables(resources,
+                                                     self.cluster_name,
+                                                     cand.region, cand.zone)
+                logger.info('%s Provisioning %s in %s...',
+                            ux.emph('[provision]'), resources.pretty(),
+                            cand.zone or cand.region)
+                try:
+                    record = provisioner.bulk_provision(
+                        resources.cloud, cand.region, cand.zone,
+                        self.cluster_name, config, self.log_path)
+                    return cand, record, config
+                except exceptions.ProvisionError as e:
+                    self.failover_history.append(e)
+                    if not e.retryable:
+                        raise exceptions.ResourcesUnavailableError(
+                            f'Provisioning failed with non-retryable error: '
+                            f'{e}').with_failover_history(
+                                self.failover_history)
+                    self._update_blocklist(resources, e)
+                except exceptions.ApiError as e:
+                    self.failover_history.append(e)
+                    self._update_blocklist(resources, e)
+            if not retry_until_up:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Failed to provision {task.name or "task"} on all '
+                    f'candidate placements '
+                    f'({len(self.failover_history)} attempt(s)). Errors: ' +
+                    '; '.join(
+                        str(e)[:200] for e in self.failover_history[-5:])
+                ).with_failover_history(self.failover_history)
+            logger.info(
+                'Retrying provisioning in %ds (retry_until_up set)...',
+                _PROVISION_RETRY_GAP_SECONDS)
+            self.blocked = []  # fresh round: capacity may have appeared
+            time.sleep(_PROVISION_RETRY_GAP_SECONDS)
+
+
+class SliceBackend(Backend[SliceResourceHandle]):
+    NAME = 'slice'
+
+    # ------------------------------------------------------------ provision
+
+    @timeline.event
+    def provision(self, task, to_provision: Optional[Resources],
+                  dryrun: bool, stream_logs: bool, cluster_name: str,
+                  retry_until_up: bool = False
+                  ) -> Optional[SliceResourceHandle]:
+        if task.num_nodes != 1:
+            raise exceptions.NotSupportedError(
+                'Multi-slice tasks (num_nodes > 1) are not yet supported by '
+                'SliceBackend; coming with DCN multislice support.')
+        candidates = getattr(task, 'candidates', None)
+        if candidates is None:
+            from skypilot_tpu import dag as dag_lib
+            from skypilot_tpu import optimizer as optimizer_lib
+            with dag_lib.Dag() as d:
+                d.add(task)
+            optimizer_lib.optimize(d, quiet=True)
+            candidates = task.candidates
+        if dryrun:
+            cand = candidates[0]
+            logger.info('Dryrun: would provision %s in %s.',
+                        cand.resources.pretty(), cand.zone or cand.region)
+            return None
+        log_path = os.path.join(_log_dir_for(cluster_name), 'provision.log')
+        with locks.cluster_status_lock(cluster_name):
+            existing = state.get_cluster_from_name(cluster_name)
+            if existing is not None:
+                handle = existing['handle']
+                launched = handle.launched_resources
+                wanted_ok = any(
+                    r.less_demanding_than(launched) for r in task.resources)
+                if not wanted_ok:
+                    raise exceptions.ResourcesMismatchError(
+                        f'Cluster {cluster_name!r} exists with '
+                        f'{launched.pretty()}, which does not satisfy the '
+                        f'requested resources. Use a new cluster name, or '
+                        f'`skytpu down {cluster_name}` first.')
+                # Narrow candidates to the existing placement so a restart
+                # reuses the same zone.
+                candidates = [
+                    c for c in candidates
+                    if c.resources.zone == launched.zone
+                ] or candidates
+            retrier = RetryingProvisioner(cluster_name, log_path)
+            cand, record, config = retrier.provision_with_retries(
+                task, candidates, retry_until_up)
+            handle = SliceResourceHandle(cluster_name, cand.resources)
+            state.add_or_update_cluster(cluster_name, handle,
+                                        set(task.resources), ready=False)
+            try:
+                info = provision.get_cluster_info(cand.resources.cloud,
+                                                  cand.region, cand.zone,
+                                                  cluster_name)
+                provisioner.post_provision_runtime_setup(
+                    cluster_name, info, log_path)
+                if cand.resources.ports:
+                    provision.open_ports(cand.resources.cloud, cluster_name,
+                                         cand.resources.ports)
+            except Exception:
+                state.add_or_update_cluster(cluster_name, handle,
+                                            set(task.resources), ready=False,
+                                            is_launch=False)
+                raise
+            handle.cached_cluster_info = info.to_json()
+            handle.stable_internal_external_ips = list(
+                zip(info.internal_ips(), info.external_ips()))
+            state.add_or_update_cluster(cluster_name, handle,
+                                        set(task.resources), ready=True)
+            logger.info('%s Cluster %r is UP (%d host(s)).',
+                        ux.ok('[done]'), cluster_name, info.num_hosts)
+            return handle
+
+    # ----------------------------------------------------------- file sync
+
+    @timeline.event
+    def sync_workdir(self, handle: SliceResourceHandle, workdir: str) -> None:
+        runners = handle.get_command_runners()
+        src = os.path.abspath(os.path.expanduser(workdir)).rstrip('/') + '/'
+        log_path = os.path.join(_log_dir_for(handle.cluster_name),
+                                'sync_workdir.log')
+
+        def _sync(runner):
+            runner.rsync(src, _WORKDIR_REMOTE + '/', up=True,
+                         log_path=log_path)
+
+        logger.info('%s Syncing workdir %s -> %s on %d host(s).',
+                    ux.emph('[sync]'), workdir, _WORKDIR_REMOTE,
+                    len(runners))
+        subprocess_utils.run_in_parallel(_sync, runners)
+
+    @timeline.event
+    def sync_file_mounts(self, handle: SliceResourceHandle,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        runners = handle.get_command_runners()
+        log_path = os.path.join(_log_dir_for(handle.cluster_name),
+                                'file_mounts.log')
+        for dst, src in (all_file_mounts or {}).items():
+            if src.startswith('gs://'):
+                from skypilot_tpu.data import storage_mounting
+                cmd = storage_mounting.copy_object_command(src, dst)
+                subprocess_utils.run_in_parallel(
+                    lambda r, c=cmd: r.run_or_raise(c, log_path=log_path),
+                    runners)
+            else:
+                src_exp = os.path.expanduser(src)
+                src_exp = (src_exp.rstrip('/') +
+                           '/') if os.path.isdir(src_exp) else src_exp
+
+                def _sync(runner, s=src_exp, d=dst):
+                    runner.rsync(s, d, up=True, log_path=log_path)
+
+                subprocess_utils.run_in_parallel(_sync, runners)
+        for mount_path, storage in (storage_mounts or {}).items():
+            from skypilot_tpu.data import storage_mounting
+            storage_mounting.mount_storage(runners, mount_path, storage,
+                                           log_path)
+
+    # ---------------------------------------------------------------- setup
+
+    @timeline.event
+    def setup(self, handle: SliceResourceHandle, task,
+              detach_setup: bool = False) -> None:
+        if task.setup is None:
+            return
+        runners = handle.get_command_runners()
+        log_dir = _log_dir_for(handle.cluster_name)
+        script = _make_setup_script(task.setup, task.envs)
+        info = handle.cluster_info()
+        logger.info('%s Running setup on %d host(s).', ux.emph('[setup]'),
+                    len(runners))
+
+        def _setup_one(i: int) -> None:
+            runner = runners[i]
+            env = _cluster_env(info, i)
+            log_path = os.path.join(log_dir, f'setup-{i}.log')
+            runner.run(f'mkdir -p {_WORKDIR_REMOTE}', log_path=log_path)
+            rc = runner.run(script, log_path=log_path,
+                            stream_logs=(i == 0), env=env)
+            if rc != 0:
+                raise exceptions.CommandError(
+                    rc, f'setup on host {i}',
+                    f'Setup failed; see {log_path}')
+
+        subprocess_utils.run_in_parallel(_setup_one,
+                                         list(range(len(runners))))
+
+    # -------------------------------------------------------------- execute
+
+    @timeline.event
+    def execute(self, handle: SliceResourceHandle, task, detach_run: bool,
+                dryrun: bool = False) -> Optional[int]:
+        if dryrun:
+            logger.info('Dryrun: skipping execution.')
+            return None
+        if task.run is None:
+            logger.info('No run command; nothing to execute.')
+            return None
+        if not isinstance(task.run, str):
+            raise exceptions.NotSupportedError(
+                'Callable task.run is only supported for local execution; '
+                'use a command string for cluster jobs.')
+        head = handle.head_runner()
+        run_timestamp = common.get_run_timestamp()
+        handle.run_timestamp = run_timestamp
+        task_id = common.make_task_id(task.name)
+        spec = {
+            'envs': task.envs,
+            'task_id': os.environ.get('SKYTPU_TASK_ID_OVERRIDE', task_id),
+            'task_name': task.name,
+        }
+        log_path = os.path.join(_log_dir_for(handle.cluster_name),
+                                'exec.log')
+        # 1. register the job on the head host
+        add_cmd = podlet_codegen.JobCodeGen.add_job(
+            task.name or 'task', getpass.getuser(), run_timestamp, spec)
+        rc, stdout, stderr = head.run(add_cmd, require_outputs=True,
+                                      log_path=log_path)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'podlet add_job',
+                                          stderr[-800:])
+        job_id = podlet_codegen.parse_result(stdout)['job_id']
+        # 2. upload the run bundle
+        run_script = _make_run_script(task.run, task.envs,
+                                      bool(task.workdir))
+        local_script = os.path.join(_log_dir_for(handle.cluster_name),
+                                    f'run-{job_id}.sh')
+        with open(local_script, 'w', encoding='utf-8') as f:
+            f.write(run_script)
+        head.rsync(local_script, f'~/.skytpu/jobs/{job_id}/run.sh', up=True,
+                   log_path=log_path)
+        # 3. queue it (podlet scheduler picks it up FIFO)
+        queue_cmd = podlet_codegen.JobCodeGen.queue_job(job_id)
+        rc, stdout, stderr = head.run(queue_cmd, require_outputs=True,
+                                      log_path=log_path)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'podlet queue_job',
+                                          stderr[-800:])
+        logger.info('%s Job %d submitted (cluster %r).', ux.ok('[job]'),
+                    job_id, handle.cluster_name)
+        state.update_last_use(handle.cluster_name)
+        if not detach_run:
+            self.tail_logs(handle, job_id)
+        return job_id
+
+    # ------------------------------------------------------------- job ops
+
+    def tail_logs(self, handle: SliceResourceHandle,
+                  job_id: Optional[int] = None, follow: bool = True) -> int:
+        head = handle.head_runner()
+        cmd = podlet_codegen.JobCodeGen.tail_logs(job_id, follow=follow)
+        return int(head.run(cmd, stream_logs=True, log_path='/dev/null'))
+
+    def get_job_queue(self, handle: SliceResourceHandle) -> List[Dict]:
+        head = handle.head_runner()
+        cmd = podlet_codegen.JobCodeGen.get_job_queue()
+        rc, stdout, stderr = head.run(cmd, require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'podlet queue', stderr[-800:])
+        return podlet_codegen.parse_result(stdout)
+
+    def cancel_jobs(self, handle: SliceResourceHandle,
+                    job_ids: Optional[List[int]] = None) -> List[int]:
+        head = handle.head_runner()
+        cmd = podlet_codegen.JobCodeGen.cancel_jobs(job_ids)
+        rc, stdout, stderr = head.run(cmd, require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'podlet cancel', stderr[-800:])
+        return podlet_codegen.parse_result(stdout)['cancelled']
+
+    def get_job_status(self, handle: SliceResourceHandle,
+                       job_id: Optional[int] = None) -> Dict:
+        head = handle.head_runner()
+        cmd = podlet_codegen.JobCodeGen.get_job_status(job_id)
+        rc, stdout, stderr = head.run(cmd, require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'podlet status', stderr[-800:])
+        return podlet_codegen.parse_result(stdout)
+
+    def set_autostop(self, handle: SliceResourceHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        if (handle.launched_resources.is_tpu and idle_minutes >= 0 and
+                not down):
+            raise exceptions.NotSupportedError(
+                'TPU slices cannot be stopped: use autostop with --down '
+                '(autodown).')
+        head = handle.head_runner()
+        cmd = podlet_codegen.JobCodeGen.set_autostop(idle_minutes, down)
+        rc, stdout, stderr = head.run(cmd, require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'podlet autostop',
+                                          stderr[-800:])
+        state.set_cluster_autostop(handle.cluster_name, idle_minutes, down)
+
+    def sync_down_logs(self, handle: SliceResourceHandle,
+                       job_id: Optional[int] = None,
+                       local_dir: Optional[str] = None) -> str:
+        """Copy a job's log tree from the head host to the local machine.
+        Parity: sync_down_logs (cloud_vm_ray_backend.py:3630)."""
+        status = self.get_job_status(handle, job_id)
+        job_id = status['job_id']
+        head = handle.head_runner()
+        cmd = podlet_codegen.JobCodeGen.get_job_queue()
+        rc, stdout, _ = head.run(cmd, require_outputs=True)
+        jobs = podlet_codegen.parse_result(stdout)
+        match = [j for j in jobs if j['job_id'] == job_id]
+        if not match:
+            raise exceptions.JobNotFoundError(f'job {job_id}')
+        run_timestamp = match[0]['run_timestamp']
+        local_dir = local_dir or os.path.join(common.logs_dir(),
+                                              handle.cluster_name,
+                                              run_timestamp)
+        os.makedirs(local_dir, exist_ok=True)
+        head.rsync(f'~/sky_logs/{run_timestamp}/', local_dir + '/', up=False)
+        return local_dir
+
+    # ------------------------------------------------------------- teardown
+
+    @timeline.event
+    def teardown(self, handle: SliceResourceHandle, terminate: bool,
+                 purge: bool = False) -> None:
+        cluster_name = handle.cluster_name
+        if (not terminate and handle.launched_resources.is_tpu):
+            raise exceptions.NotSupportedError(
+                'TPU slices cannot be stopped (the ICI fabric allocation is '
+                'released); use `skytpu down` to terminate.')
+        with locks.cluster_status_lock(cluster_name):
+            try:
+                provisioner.teardown_cluster(handle.provider, cluster_name,
+                                             terminate)
+            except Exception as e:  # pylint: disable=broad-except
+                if not purge:
+                    raise
+                logger.warning('Teardown error ignored due to purge: %s', e)
+            state.remove_cluster(cluster_name, terminate=terminate)
+        verb = 'Terminated' if terminate else 'Stopped'
+        logger.info('%s %s cluster %r.', ux.ok('[down]'), verb, cluster_name)
+
+
+def _cluster_env(info: ClusterInfo, rank: int) -> Dict[str, str]:
+    ips = info.internal_ips()
+    return {
+        common.ENV_VAR_NODE_RANK: str(rank),
+        common.ENV_VAR_NODE_IPS: '\n'.join(ips),
+        common.ENV_VAR_NUM_NODES: str(len(ips)),
+        common.ENV_VAR_NUM_CHIPS_PER_NODE: str(info.chips_per_host),
+        common.ENV_VAR_CLUSTER_NAME: info.cluster_name,
+    }
+
+
+def _make_setup_script(setup: str, envs: Dict[str, str]) -> str:
+    exports = '\n'.join(
+        f'export {k}={subprocess_utils.quote(str(v))}'
+        for k, v in envs.items())
+    return textwrap.dedent(f"""\
+        set -e
+        cd {_WORKDIR_REMOTE} 2>/dev/null || cd ~
+        {exports}
+        {setup}
+        """)
+
+
+def _make_run_script(run: str, envs: Dict[str, str],
+                     has_workdir: bool) -> str:
+    """Parity: make_task_bash_script (sky/skylet/log_lib.py:256).
+    Rank/coordinator env comes from the podlet driver at execution time;
+    user envs are additionally baked into the script so it behaves the same
+    when run by hand for debugging."""
+    cd = f'cd {_WORKDIR_REMOTE}' if has_workdir else 'cd ~'
+    exports = '\n'.join(
+        f'export {k}={subprocess_utils.quote(str(v))}'
+        for k, v in envs.items())
+    return textwrap.dedent(f"""\
+        #!/bin/bash
+        source ~/.bashrc 2>/dev/null || true
+        {cd}
+        """) + exports + '\n' + run + '\n'

@@ -1,0 +1,155 @@
+"""Core ops API: status / start / stop / down / autostop / queue / cancel /
+logs / cost_report / storage.
+
+Parity: sky/core.py:41-899.
+"""
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import backend_utils, exceptions, logsys, state
+from skypilot_tpu.backends import SliceBackend
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.utils import common, ux
+
+logger = logsys.init_logger(__name__)
+
+
+def status(cluster_names: Optional[Union[str, List[str]]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records (optionally reconciled against the cloud)."""
+    if isinstance(cluster_names, str):
+        cluster_names = [cluster_names]
+    return backend_utils.get_clusters(refresh=refresh,
+                                      cluster_names=cluster_names)
+
+
+def start(cluster_name: str, retry_until_up: bool = False) -> None:
+    """Restart a STOPPED cluster (controller VMs; TPU slices cannot stop).
+    Parity: sky/core.py start()."""
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    if handle.launched_resources.is_tpu:
+        raise exceptions.NotSupportedError(
+            'TPU slices cannot be stopped/started; relaunch instead.')
+    from skypilot_tpu import provision
+    from skypilot_tpu.provision import provisioner
+    from skypilot_tpu.clouds import Cloud
+    resources = handle.launched_resources
+    cloud = Cloud.from_name(resources.cloud)
+    config = cloud.make_deploy_variables(resources, cluster_name,
+                                         resources.region, resources.zone)
+    provision.run_instances(resources.cloud, resources.region,
+                            resources.zone, cluster_name, config)
+    provision.wait_instances(resources.cloud, resources.region,
+                             resources.zone, cluster_name)
+    info = provision.get_cluster_info(resources.cloud, resources.region,
+                                      resources.zone, cluster_name)
+    import os
+    log_path = os.path.join(common.logs_dir(), cluster_name, 'start.log')
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    provisioner.post_provision_runtime_setup(cluster_name, info, log_path)
+    state.add_or_update_cluster(cluster_name, handle, None, ready=True,
+                                is_launch=False)
+
+
+def stop(cluster_name: str, purge: bool = False) -> None:
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    SliceBackend().teardown(record['handle'], terminate=False, purge=purge)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    SliceBackend().teardown(record['handle'], terminate=True, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_after_idle: bool = False) -> None:
+    """idle_minutes < 0 cancels autostop.  TPU slices require down=True."""
+    handle = backend_utils.check_cluster_available(cluster_name)
+    SliceBackend().set_autostop(handle, idle_minutes, down=down_after_idle)
+    if idle_minutes >= 0:
+        what = 'autodown' if down_after_idle else 'autostop'
+        logger.info('%s %s set: %d min idle.', ux.ok('[ok]'), what,
+                    idle_minutes)
+    else:
+        logger.info('%s autostop cancelled.', ux.ok('[ok]'))
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    return SliceBackend().get_job_queue(handle)
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    if not all_jobs and not job_ids:
+        raise exceptions.JobNotFoundError(
+            'Specify job ids or all_jobs=True.')
+    return SliceBackend().cancel_jobs(handle,
+                                      None if all_jobs else job_ids)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    return SliceBackend().tail_logs(handle, job_id, follow=follow)
+
+
+def download_logs(cluster_name: str,
+                  job_id: Optional[int] = None) -> str:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    return SliceBackend().sync_down_logs(handle, job_id)
+
+
+def job_status(cluster_name: str,
+               job_id: Optional[int] = None) -> Dict[str, Any]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    return SliceBackend().get_job_status(handle, job_id)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Per-cluster accumulated cost from usage intervals.
+    Parity: sky/core.py cost_report + status_utils."""
+    out = []
+    for rec in state.get_cluster_history():
+        launched = rec['launched_resources']
+        if launched is None:
+            continue
+        total_seconds = 0.0
+        now = time.time()
+        for start_t, end_t in rec['usage_intervals']:
+            total_seconds += (end_t or now) - start_t
+        try:
+            cost = launched.get_cost(total_seconds) * (rec['num_nodes'] or 1)
+        except exceptions.SkyTpuError:
+            cost = 0.0
+        out.append({
+            'name': rec['name'],
+            'resources': launched,
+            'duration_seconds': total_seconds,
+            'cost': cost,
+        })
+    return out
+
+
+def storage_ls() -> List[Dict[str, Any]]:
+    return state.get_storage()
+
+
+def storage_delete(name: str) -> None:
+    handle = state.get_storage_handle(name)
+    if handle is None:
+        raise exceptions.StorageError(f'Storage {name!r} not found.')
+    from skypilot_tpu.data import storage as storage_lib
+    store = storage_lib.Storage.from_handle(handle)
+    store.delete()

@@ -1,0 +1,159 @@
+"""Bucket-backed storage: lifecycle + MOUNT/COPY modes.
+
+Parity: sky/data/storage.py (Storage :384, GcsStore :1527, StorageMode
+:192) — GCS-only, TPU-first: checkpoints ride gcsfuse MOUNT on TPU VMs
+(the checkpoint/resume contract for managed jobs), datasets ride COPY.
+"""
+import enum
+import os
+import subprocess
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions, logsys, state
+from skypilot_tpu.status_lib import StorageStatus
+from skypilot_tpu.utils import common
+
+logger = logsys.init_logger(__name__)
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+class StorageHandle:
+    """Pickled record in the local state DB."""
+
+    def __init__(self, name: str, source: Optional[Union[str, List[str]]],
+                 mode: StorageMode, persistent: bool):
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+
+
+def _run_gsutil(args: List[str], check: bool = True
+                ) -> subprocess.CompletedProcess:
+    for base in (['gsutil', '-m'], ['gcloud', 'storage']):
+        try:
+            return subprocess.run(base + args, capture_output=True,
+                                  text=True, check=check)
+        except FileNotFoundError:
+            continue
+    raise exceptions.StorageError(
+        'Neither gsutil nor gcloud found; cannot manage GCS buckets.')
+
+
+class Storage:
+    """A named bucket, optionally synced from local source(s)."""
+
+    def __init__(self,
+                 name: Optional[str] = None,
+                 source: Optional[Union[str, List[str]]] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 persistent: bool = True):
+        if name is None and source is None:
+            raise exceptions.StorageSourceError(
+                'Storage needs a name and/or a source.')
+        if name is None:
+            base = os.path.basename(str(source).rstrip('/'))
+            name = f'skytpu-{common.get_user_hash()}-{base}'.lower()
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        self._validate_source()
+
+    def _validate_source(self) -> None:
+        sources = (self.source if isinstance(self.source, list) else
+                   [self.source] if self.source else [])
+        for src in sources:
+            if src.startswith('gs://'):
+                continue
+            if src.startswith(('s3://', 'r2://', 'cos://')):
+                raise exceptions.StorageSourceError(
+                    f'Only gs:// and local sources supported, got {src}')
+            if not os.path.exists(os.path.expanduser(src)):
+                raise exceptions.StorageSourceError(
+                    f'Local source not found: {src}')
+
+    # ------------------------------------------------------------- lifecycle
+
+    @property
+    def bucket_uri(self) -> str:
+        if isinstance(self.source, str) and self.source.startswith('gs://'):
+            return self.source.rstrip('/')
+        return f'gs://{self.name}'
+
+    def ensure_bucket(self) -> None:
+        if isinstance(self.source, str) and self.source.startswith('gs://'):
+            return  # pre-existing bucket
+        res = _run_gsutil(['ls', self.bucket_uri], check=False)
+        if res.returncode != 0:
+            logger.info('Creating bucket %s.', self.bucket_uri)
+            res = _run_gsutil(['mb', self.bucket_uri], check=False)
+            if res.returncode != 0:
+                raise exceptions.StorageBucketCreateError(
+                    f'mb failed: {res.stderr[-500:]}')
+
+    def upload(self) -> None:
+        """Sync local source(s) into the bucket."""
+        self.ensure_bucket()
+        sources = (self.source if isinstance(self.source, list) else
+                   [self.source] if self.source else [])
+        for src in sources:
+            if src.startswith('gs://'):
+                continue
+            src = os.path.expanduser(src)
+            dst = self.bucket_uri
+            if os.path.isdir(src):
+                res = _run_gsutil(['rsync', '-r', src, dst], check=False)
+            else:
+                res = _run_gsutil(['cp', src, dst], check=False)
+            if res.returncode != 0:
+                state.add_or_update_storage(self.name, self.to_handle(),
+                                            StorageStatus.UPLOAD_FAILED)
+                raise exceptions.StorageUploadError(
+                    f'Upload of {src} failed: {res.stderr[-500:]}')
+        state.add_or_update_storage(self.name, self.to_handle(),
+                                    StorageStatus.READY)
+
+    def delete(self) -> None:
+        if (isinstance(self.source, str) and
+                self.source.startswith('gs://')):
+            logger.info('Not deleting externally-managed bucket %s.',
+                        self.bucket_uri)
+        else:
+            res = _run_gsutil(['rm', '-r', self.bucket_uri], check=False)
+            if res.returncode != 0 and 'BucketNotFound' not in res.stderr:
+                raise exceptions.StorageBucketDeleteError(
+                    f'Deletion of {self.bucket_uri} failed: '
+                    f'{res.stderr[-500:]}')
+        state.remove_storage(self.name)
+
+    # ----------------------------------------------------------------- yaml
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        mode_str = str(config.get('mode', 'MOUNT')).upper()
+        return cls(name=config.get('name'),
+                   source=config.get('source'),
+                   mode=StorageMode(mode_str),
+                   persistent=config.get('persistent', True))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {'name': self.name, 'mode': self.mode.value}
+        if self.source is not None:
+            cfg['source'] = self.source
+        if not self.persistent:
+            cfg['persistent'] = False
+        return cfg
+
+    def to_handle(self) -> StorageHandle:
+        return StorageHandle(self.name, self.source, self.mode,
+                             self.persistent)
+
+    @classmethod
+    def from_handle(cls, handle: StorageHandle) -> 'Storage':
+        return cls(name=handle.name, source=handle.source, mode=handle.mode,
+                   persistent=handle.persistent)

@@ -1,0 +1,70 @@
+"""Mount/copy buckets onto cluster hosts.
+
+Parity: sky/data/mounting_utils.py — gcsfuse for MOUNT, gsutil for COPY.
+On the local cloud, MOUNT degrades to a COPY into the host dir (gcsfuse
+needs privileged FUSE), logged as such.
+"""
+from typing import List
+
+from skypilot_tpu import logsys
+from skypilot_tpu.data.storage import Storage, StorageMode
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils.command_runner import (CommandRunner,
+                                               LocalProcessRunner)
+
+logger = logsys.init_logger(__name__)
+
+_GCSFUSE_VERSION = '2.5.1'
+
+_INSTALL_GCSFUSE = (
+    'command -v gcsfuse >/dev/null || { '
+    'curl -sSL -o /tmp/gcsfuse.deb '
+    'https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
+    f'v{_GCSFUSE_VERSION}/gcsfuse_{_GCSFUSE_VERSION}_amd64.deb && '
+    'sudo dpkg -i /tmp/gcsfuse.deb; }')
+
+
+def mount_command(bucket: str, mount_path: str) -> str:
+    return (f'{_INSTALL_GCSFUSE} && '
+            f'mkdir -p {mount_path} && '
+            f'mountpoint -q {mount_path} || '
+            f'gcsfuse --implicit-dirs {bucket} {mount_path}')
+
+
+def copy_command(bucket_uri: str, dst: str) -> str:
+    """Directory sync: bucket -> dst dir."""
+    import shlex
+    d = shlex.quote(dst)
+    return (f'mkdir -p {d} && '
+            f'(command -v gsutil >/dev/null && '
+            f'gsutil -m rsync -r {bucket_uri} {d} || '
+            f'gcloud storage rsync --recursive {bucket_uri} {d})')
+
+
+def copy_object_command(src_uri: str, dst: str) -> str:
+    """Single object/prefix copy: gs://... -> dst path (file mounts)."""
+    import shlex
+    d = shlex.quote(dst)
+    return (f'mkdir -p $(dirname {d}) && '
+            f'(command -v gsutil >/dev/null && '
+            f'gsutil -m cp -r {src_uri} {d} || '
+            f'gcloud storage cp -r {src_uri} {d})')
+
+
+def mount_storage(runners: List[CommandRunner], mount_path: str,
+                  storage: Storage, log_path: str) -> None:
+    if storage.source is not None and not str(
+            storage.source).startswith('gs://'):
+        storage.upload()
+    bucket = storage.bucket_uri.removeprefix('gs://')
+    if storage.mode == StorageMode.MOUNT:
+        if any(isinstance(r, LocalProcessRunner) for r in runners):
+            logger.warning(
+                'MOUNT degrades to COPY on the local cloud (no FUSE).')
+            cmd = copy_command(storage.bucket_uri, mount_path)
+        else:
+            cmd = mount_command(bucket, mount_path)
+    else:
+        cmd = copy_command(storage.bucket_uri, mount_path)
+    subprocess_utils.run_in_parallel(
+        lambda r: r.run_or_raise(cmd, log_path=log_path), runners)

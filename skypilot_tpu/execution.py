@@ -1,0 +1,161 @@
+"""The task lifecycle stage machine: launch / exec.
+
+Parity: sky/execution.py — Stage enum (:31), _execute (:95), launch (:346),
+exec (:510).  `launch` runs the full pipeline; `exec` assumes an UP cluster
+and only re-syncs the workdir and submits the job (fast iteration path).
+"""
+import enum
+from typing import List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions, logsys
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu.backends import SliceBackend
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common, timeline, ux
+
+logger = logsys.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+_ALL_STAGES = list(Stage)
+
+
+def _to_task(entrypoint: Union[Task, 'dag_lib.Dag']) -> Task:
+    if isinstance(entrypoint, dag_lib.Dag):
+        if len(entrypoint.tasks) != 1:
+            raise exceptions.NotSupportedError(
+                'launch() takes a single task; use jobs.launch() for '
+                'pipelines.')
+        return entrypoint.tasks[0]
+    return entrypoint
+
+
+@timeline.event
+def _execute(task: Task,
+             cluster_name: str,
+             stages: List[Stage],
+             *,
+             dryrun: bool = False,
+             stream_logs: bool = True,
+             optimize_target=None,
+             detach_setup: bool = False,
+             detach_run: bool = False,
+             idle_minutes_to_autostop: Optional[int] = None,
+             down: bool = False,
+             retry_until_up: bool = False,
+             no_setup: bool = False) -> Optional[int]:
+    """Run the requested stages; returns job id (if EXEC ran)."""
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task)
+    backend = SliceBackend()
+    optimize_target = optimize_target or optimizer_lib.OptimizeTarget.COST
+    handle = None
+    job_id = None
+
+    if Stage.OPTIMIZE in stages and task.best_resources is None:
+        with dag_lib.Dag() as d:
+            d.add(task)
+        optimizer_lib.optimize(d, minimize=optimize_target,
+                               quiet=not stream_logs)
+
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, task.best_resources, dryrun=dryrun,
+                                   stream_logs=stream_logs,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+        if dryrun:
+            return None
+    else:
+        from skypilot_tpu import backend_utils
+        handle = backend_utils.check_cluster_available(cluster_name)
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+
+    if Stage.SETUP in stages and not no_setup:
+        backend.setup(handle, task, detach_setup=detach_setup)
+
+    if Stage.PRE_EXEC in stages and idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop, down=down)
+
+    if Stage.EXEC in stages:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+
+    if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
+        backend.teardown(handle, terminate=True)
+    return job_id
+
+
+def launch(task: Union[Task, 'dag_lib.Dag'],
+           cluster_name: Optional[str] = None,
+           *,
+           dryrun: bool = False,
+           stream_logs: bool = True,
+           optimize_target=None,
+           detach_setup: bool = False,
+           detach_run: bool = False,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           retry_until_up: bool = False,
+           fast: bool = False,
+           no_setup: bool = False) -> Optional[int]:
+    """Provision (or reuse) a cluster and run the task on it.
+    Parity: sky.launch (sky/execution.py:346)."""
+    task = _to_task(task)
+    if cluster_name is None:
+        cluster_name = f'skytpu-{common.get_user_hash()[:4]}'
+        logger.info('No cluster name given; using %r.', cluster_name)
+    if not common.is_valid_cluster_name(cluster_name):
+        raise exceptions.InvalidTaskError(
+            f'Invalid cluster name {cluster_name!r}.')
+    stages = list(_ALL_STAGES)
+    if fast:
+        # Reuse an UP cluster without reprovision/setup when possible.
+        from skypilot_tpu import backend_utils
+        record = backend_utils.refresh_cluster_record(cluster_name)
+        from skypilot_tpu.status_lib import ClusterStatus
+        if record is not None and record['status'] == ClusterStatus.UP:
+            stages = [
+                Stage.SYNC_WORKDIR, Stage.SYNC_FILE_MOUNTS, Stage.PRE_EXEC,
+                Stage.EXEC, Stage.DOWN
+            ]
+    return _execute(task, cluster_name, stages, dryrun=dryrun,
+                    stream_logs=stream_logs, optimize_target=optimize_target,
+                    detach_setup=detach_setup, detach_run=detach_run,
+                    idle_minutes_to_autostop=idle_minutes_to_autostop,
+                    down=down, retry_until_up=retry_until_up,
+                    no_setup=no_setup)
+
+
+def exec_(task: Union[Task, 'dag_lib.Dag'],
+          cluster_name: str,
+          *,
+          detach_run: bool = False,
+          dryrun: bool = False) -> Optional[int]:
+    """Submit a job to an existing UP cluster (skips provision/setup).
+    Parity: sky.exec (sky/execution.py:510)."""
+    task = _to_task(task)
+    if dryrun:
+        logger.info('Dryrun: would exec %r on %r.', task.name, cluster_name)
+        return None
+    stages = [Stage.SYNC_WORKDIR, Stage.EXEC]
+    if task.workdir is None:
+        stages = [Stage.EXEC]
+    return _execute(task, cluster_name, stages, detach_run=detach_run)

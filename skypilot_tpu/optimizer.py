@@ -246,9 +246,9 @@ def _print_plan(dag, choice: Dict[object, Candidate],
                      f'${cand.cost_per_hour:.2f}/hr',
                      f'~{cand.duration_hours:.2f}h',
                      f'${cand.total_cost:.2f}'))
-    name_w = max(len(r[0]) for r in rows) + 2
-    res_w = max(len(r[1]) for r in rows) + 2
-    zone_w = max(len(r[2]) for r in rows) + 2
+    name_w = max(4, max(len(r[0]) for r in rows)) + 2
+    res_w = max(9, max(len(r[1]) for r in rows)) + 2
+    zone_w = max(4, max(len(r[2]) for r in rows)) + 2
     print(ux.emph(f'Optimizer plan (minimizing {minimize.value}):'))
     header = (f'  {"TASK":<{name_w}}{"RESOURCES":<{res_w}}'
               f'{"ZONE":<{zone_w}}{"PRICE":<12}{"EST.TIME":<10}{"EST.COST"}')

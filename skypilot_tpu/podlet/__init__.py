@@ -1,0 +1,10 @@
+"""podlet: the on-slice runtime (head-host daemon + job queue + gang driver).
+
+Parity: sky/skylet/ — but with Ray removed.  A TPU pod slice is already
+gang-scheduled by the hardware: one provisioning call yields M hosts wired
+by ICI, so Ray's placement groups solve a problem TPUs don't have
+(SURVEY.md §7).  Job execution is a direct fan-out of the run script to all
+hosts with rank/coordinator env exported; XLA collectives handle the data
+plane.
+"""
+PODLET_VERSION = 1

@@ -1,0 +1,42 @@
+"""Autostop configuration on the head host.
+
+Parity: sky/skylet/autostop_lib.py:28-78 — a small config file consulted by
+the daemon's AutostopEvent; set via codegen from the client at PRE_EXEC.
+For TPU slices autostop always means auto-DOWN (slices cannot stop).
+"""
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+_CONFIG_PATH = '~/.skytpu/podlet/autostop.json'
+
+
+@dataclasses.dataclass
+class AutostopConfig:
+    idle_minutes: int            # <0 disables autostop
+    down: bool                   # terminate (True) vs stop (False)
+    set_at: float
+
+
+def set_autostop(idle_minutes: int, down: bool) -> None:
+    path = os.path.expanduser(_CONFIG_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(
+            {
+                'idle_minutes': idle_minutes,
+                'down': down,
+                'set_at': time.time()
+            }, f)
+
+
+def get_autostop_config() -> Optional[AutostopConfig]:
+    try:
+        with open(os.path.expanduser(_CONFIG_PATH), 'r',
+                  encoding='utf-8') as f:
+            d = json.load(f)
+        return AutostopConfig(**d)
+    except (FileNotFoundError, json.JSONDecodeError, TypeError):
+        return None

@@ -1,0 +1,102 @@
+"""Client→head-host RPC by generated Python snippets.
+
+Parity: JobLibCodeGen (sky/skylet/job_lib.py:810) — the client has no
+daemon connection to the cluster; it executes short python programs on the
+head host over the command runner, with results returned on stdout between
+sentinel markers.
+"""
+import json
+import shlex
+from typing import Any, Dict, List, Optional
+
+RESULT_BEGIN = '<<<SKYTPU_RESULT>>>'
+RESULT_END = '<<<END_SKYTPU_RESULT>>>'
+
+_RUNTIME_PYTHONPATH = '~/.skytpu_runtime'
+
+_PRELUDE = """\
+import json, sys
+sys.path.insert(0, __import__('os').path.expanduser('{pythonpath}'))
+from skypilot_tpu.podlet import job_lib, log_lib, autostop_lib
+def _emit(obj):
+    print({begin!r}); print(json.dumps(obj)); print({end!r})
+"""
+
+
+def _wrap(body: str) -> str:
+    prelude = _PRELUDE.format(pythonpath=_RUNTIME_PYTHONPATH,
+                              begin=RESULT_BEGIN, end=RESULT_END)
+    return f'python3 -u -c {shlex.quote(prelude + body)}'
+
+
+def parse_result(stdout: str) -> Any:
+    begin = stdout.rfind(RESULT_BEGIN)
+    end = stdout.rfind(RESULT_END)
+    if begin == -1 or end == -1 or end < begin:
+        raise ValueError(f'No codegen result markers in output: '
+                         f'{stdout[-1000:]!r}')
+    payload = stdout[begin + len(RESULT_BEGIN):end].strip()
+    return json.loads(payload)
+
+
+class JobCodeGen:
+    """Builders returning shell commands to run on the head host."""
+
+    @staticmethod
+    def add_job(job_name: str, username: str, run_timestamp: str,
+                spec: Dict[str, Any]) -> str:
+        body = (f'job_id = job_lib.add_job({job_name!r}, {username!r}, '
+                f'{run_timestamp!r}, json.loads({json.dumps(spec)!r}))\n'
+                f'_emit({{"job_id": job_id}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def queue_job(job_id: int) -> str:
+        body = (f'job_lib.queue_job({job_id})\n'
+                f'_emit({{"ok": True}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def get_job_queue(all_jobs: bool = True) -> str:
+        body = (
+            'jobs = job_lib.get_jobs()\n'
+            'out = [dict(j, status=j["status"].value) for j in jobs]\n'
+            '_emit(out)\n')
+        return _wrap(body)
+
+    @staticmethod
+    def get_job_status(job_id: Optional[int] = None) -> str:
+        body = (
+            f'jid = {job_id!r}\n'
+            'jid = jid if jid is not None else job_lib.get_latest_job_id()\n'
+            'job = job_lib.get_job(jid) if jid is not None else None\n'
+            '_emit({"job_id": jid, '
+            '"status": job["status"].value if job else None})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def cancel_jobs(job_ids: Optional[List[int]] = None) -> str:
+        body = (f'cancelled = job_lib.cancel_jobs({job_ids!r})\n'
+                f'_emit({{"cancelled": cancelled}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def tail_logs(job_id: Optional[int] = None, follow: bool = True,
+                  lines_from_end: Optional[int] = None) -> str:
+        # Streams raw log lines (no result markers: output IS the payload).
+        body = (
+            f'for line in log_lib.tail_logs({job_id!r}, follow={follow!r}, '
+            f'lines_from_end={lines_from_end!r}):\n'
+            f'    sys.stdout.write(line); sys.stdout.flush()\n')
+        return _wrap(body)
+
+    @staticmethod
+    def set_autostop(idle_minutes: int, down: bool) -> str:
+        body = (f'autostop_lib.set_autostop({idle_minutes}, {down})\n'
+                f'_emit({{"ok": True}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def is_idle() -> str:
+        body = '_emit({"idle": job_lib.is_idle()})\n'
+        return _wrap(body)

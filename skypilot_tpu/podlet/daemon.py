@@ -1,0 +1,43 @@
+"""The podlet daemon: head-host event loop.
+
+Parity: sky/skylet/skylet.py:17-33 — a 2-second loop over registered
+events.  Started by the provisioner via nohup; restarted (with version
+check) on reprovision (parity: sky/skylet/attempt_skylet.py).
+"""
+import os
+import time
+
+from skypilot_tpu import logsys
+from skypilot_tpu.podlet import PODLET_VERSION, events, job_lib
+
+logger = logsys.init_logger(__name__)
+
+_LOOP_SECONDS = 2
+VERSION_FILE = '~/.skytpu/podlet/version'
+PID_FILE = '~/.skytpu/podlet/pid'
+
+
+def write_version() -> None:
+    path = os.path.expanduser(VERSION_FILE)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(str(PODLET_VERSION))
+
+
+def main() -> None:
+    write_version()
+    pid_path = os.path.expanduser(PID_FILE)
+    with open(pid_path, 'w', encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+    # Jobs that were mid-flight when the previous daemon died are dead.
+    job_lib.fail_all_in_progress_jobs()
+    evts = [events.JobSchedulerEvent(), events.AutostopEvent()]
+    logger.info('podlet v%s started (pid %d).', PODLET_VERSION, os.getpid())
+    while True:
+        for e in evts:
+            e.maybe_run()
+        time.sleep(_LOOP_SECONDS)
+
+
+if __name__ == '__main__':
+    main()

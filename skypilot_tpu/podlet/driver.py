@@ -1,0 +1,221 @@
+"""Per-job gang driver: fans the run script out to every host of the slice.
+
+Parity: the generated Ray driver program (RayCodeGen,
+sky/backends/cloud_vm_ray_backend.py:209-688) — redesigned without Ray:
+
+- no placement group: the slice's hosts are fixed at provision time and
+  recorded in ~/.skytpu/cluster_info.json by the provisioner;
+- per-host env export: SKYTPU_NODE_RANK (stable IP-sorted order),
+  SKYTPU_NODE_IPS, coordinator address for jax.distributed — parity with
+  the reference's rank/IP export (:494-515);
+- gang failure semantics: first host to fail triggers termination of the
+  job on all other hosts (parity: get_or_fail, :294-328);
+- log fan-in: each host's output streams back over the runner connection
+  into ~/sky_logs/<run>/tasks/host<i>.log on the head host, plus a merged
+  run.log with [hostN] prefixes (solves multi-host log fan-in without a
+  driver framework — SURVEY.md §7 hard part (d)).
+
+Runs ON the head host, spawned by job_lib.schedule_step.
+"""
+import argparse
+import concurrent.futures
+import json
+import os
+import shlex
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from skypilot_tpu.podlet import job_lib
+from skypilot_tpu.provision.common import ClusterInfo
+from skypilot_tpu.utils import common
+
+CLUSTER_INFO_PATH = '~/.skytpu/cluster_info.json'
+
+
+def load_cluster_info() -> ClusterInfo:
+    with open(os.path.expanduser(CLUSTER_INFO_PATH), 'r',
+              encoding='utf-8') as f:
+        return ClusterInfo.from_json(f.read())
+
+
+def _make_runners(info: ClusterInfo):
+    """Head-local runners to every host (including itself)."""
+    if info.provider == 'local':
+        from skypilot_tpu.utils.command_runner import LocalProcessRunner
+        return [
+            LocalProcessRunner(inst.local_dir, inst.instance_id)
+            for inst in info.instances
+        ]
+    from skypilot_tpu.utils.command_runner import SSHCommandRunner
+    # On the head host we reach workers over INTERNAL IPs with the key the
+    # provisioner placed at ~/.ssh/skytpu-key.
+    return [
+        SSHCommandRunner(ip=inst.internal_ip,
+                         ssh_user=info.ssh_user,
+                         ssh_private_key='~/.ssh/skytpu-key')
+        for inst in info.instances
+    ]
+
+
+def build_host_env(info: ClusterInfo, rank: int, job_id: int,
+                   task_id: str, user_envs: Dict[str, str],
+                   num_slices: int = 1, slice_id: int = 0) -> Dict[str, str]:
+    ips = info.internal_ips()
+    env = dict(user_envs)
+    env.update({
+        common.ENV_VAR_NODE_RANK: str(rank),
+        common.ENV_VAR_NODE_IPS: '\n'.join(ips),
+        common.ENV_VAR_NUM_NODES: str(len(ips)),
+        common.ENV_VAR_NUM_CHIPS_PER_NODE: str(info.chips_per_host),
+        common.ENV_VAR_TASK_ID: task_id,
+        common.ENV_VAR_CLUSTER_NAME: info.cluster_name,
+        common.ENV_VAR_COORDINATOR_ADDRESS:
+            f'{ips[0]}:{common.JAX_COORDINATOR_PORT}',
+        common.ENV_VAR_PROCESS_ID: str(rank),
+        common.ENV_VAR_NUM_PROCESSES: str(len(ips)),
+        common.ENV_VAR_SLICE_ID: str(slice_id),
+        common.ENV_VAR_NUM_SLICES: str(num_slices),
+        'SKYTPU_INTERNAL_JOB_ID': str(job_id),
+    })
+    return env
+
+
+def _run_on_host(runner, rank: int, job_id: int, run_script_remote: str,
+                 env: Dict[str, str], host_log: str,
+                 merged_log_lock: threading.Lock, merged_log_path: str,
+                 cancel_event: threading.Event) -> int:
+    """Run the job on one host, teeing output to per-host + merged logs."""
+    pgid_file = f'~/.skytpu/jobs/{job_id}/host{rank}.pgid'
+    # Record the remote process-group id so gang-cancel can kill it.
+    wrapped = (f'mkdir -p ~/.skytpu/jobs/{job_id} && '
+               f'echo $$ > {pgid_file} && '
+               f'exec bash {run_script_remote}')
+
+    def _hook_factory():
+        merged = open(merged_log_path, 'a', encoding='utf-8')
+
+        def hook(line: str) -> None:
+            with merged_log_lock:
+                merged.write(f'[host{rank}] {line}')
+                merged.flush()
+
+        return hook
+
+    from skypilot_tpu.utils import subprocess_utils
+    from skypilot_tpu.utils.command_runner import LocalProcessRunner
+    if isinstance(runner, LocalProcessRunner):
+        rc, _ = subprocess_utils.run_with_log(
+            ['bash', '-c', wrapped],
+            host_log,
+            env={**os.environ, 'HOME': runner.host_dir, **env},
+            line_hook=_hook_factory(),
+        )
+        return rc
+    # SSH runner: env is exported inline; output streams over the ssh pipe.
+    exports = ' '.join(
+        f'export {k}={shlex.quote(str(v))};' for k, v in env.items())
+    rc, _ = subprocess_utils.run_with_log(
+        runner._ssh_base() +  # pylint: disable=protected-access
+        ['bash', '--login', '-c',
+         shlex.quote(f'{exports} {wrapped}')],
+        host_log,
+        line_hook=_hook_factory(),
+    )
+    return rc
+
+
+def cancel_job_on_all_hosts(job_id: int) -> None:
+    """Kill the job's recorded process group on every host of the slice.
+    Called by job_lib.cancel_jobs (parity: the reference's force-cancel of
+    all gang members + subprocess_daemon grandchild reaping)."""
+    info = load_cluster_info()
+    runners = _make_runners(info)
+    for rank, runner in enumerate(runners):
+        _cancel_on_host(runner, rank, job_id)
+
+
+def _cancel_on_host(runner, rank: int, job_id: int) -> None:
+    pgid_file = f'~/.skytpu/jobs/{job_id}/host{rank}.pgid'
+    cmd = (f'if [ -f {pgid_file} ]; then '
+           f'kill -TERM -$(cat {pgid_file}) 2>/dev/null || true; fi')
+    try:
+        runner.run(cmd)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+def run_job(job_id: int) -> int:
+    job = job_lib.get_job(job_id)
+    assert job is not None, f'job {job_id} missing'
+    spec = job['spec']
+    info = load_cluster_info()
+    runners = _make_runners(info)
+    run_timestamp = job['run_timestamp']
+    task_id = spec.get('task_id') or common.make_task_id(
+        job['job_name'], job_id)
+    user_envs = spec.get('envs', {})
+
+    tasks_log_dir = os.path.join(job_lib.log_dir(run_timestamp), 'tasks')
+    os.makedirs(tasks_log_dir, exist_ok=True)
+    merged_log = os.path.join(job_lib.log_dir(run_timestamp), 'run.log')
+
+    # Distribute the run script to every worker host (head already has it).
+    run_script_local = os.path.join(job_lib.jobs_dir(job_id), 'run.sh')
+    run_script_remote = f'~/.skytpu/jobs/{job_id}/run.sh'
+    for runner in runners[1:]:
+        runner.rsync(run_script_local, run_script_remote, up=True)
+
+    job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+    cancel_event = threading.Event()
+    merged_lock = threading.Lock()
+    returncodes: List[Optional[int]] = [None] * len(runners)
+
+    def _worker(i: int) -> int:
+        env = build_host_env(info, i, job_id, task_id, user_envs,
+                             num_slices=spec.get('num_slices', 1),
+                             slice_id=spec.get('slice_id', 0))
+        host_log = os.path.join(tasks_log_dir, f'host{i}.log')
+        rc = _run_on_host(runners[i], i, job_id, run_script_remote, env,
+                          host_log, merged_lock, merged_log, cancel_event)
+        returncodes[i] = rc
+        if rc != 0 and not cancel_event.is_set():
+            # Gang semantics: first failure cancels every other host.
+            cancel_event.set()
+            for j, other in enumerate(runners):
+                if j != i:
+                    _cancel_on_host(other, j, job_id)
+        return rc
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(runners)) as pool:
+        futures = [pool.submit(_worker, i) for i in range(len(runners))]
+        for f in futures:
+            f.result()
+
+    if cancel_event.is_set():
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+        bad = [i for i, rc in enumerate(returncodes) if rc not in (0, None)]
+        with open(merged_log, 'a', encoding='utf-8') as f:
+            f.write(f'[driver] job failed on host(s) {bad}; '
+                    f'returncodes={returncodes}\n')
+        return 1
+    job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    try:
+        rc = run_job(args.job_id)
+    except Exception as e:  # pylint: disable=broad-except
+        job_lib.set_status(args.job_id, job_lib.JobStatus.FAILED)
+        print(f'[driver] exception: {e}', file=sys.stderr)
+        raise
+    sys.exit(rc)
+
+
+if __name__ == '__main__':
+    main()

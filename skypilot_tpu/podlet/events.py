@@ -1,0 +1,86 @@
+"""Daemon events: interval-gated periodic work on the head host.
+
+Parity: sky/skylet/events.py — JobSchedulerEvent + AutostopEvent; the
+managed-jobs and serve update events are registered by the respective
+controller planes when they run on a controller VM.
+"""
+import time
+
+from skypilot_tpu import logsys
+from skypilot_tpu.podlet import autostop_lib, job_lib
+
+logger = logsys.init_logger(__name__)
+
+
+class PodletEvent:
+    """Base: run() no more often than every `interval_seconds`."""
+    interval_seconds = 20
+
+    def __init__(self):
+        self._last = 0.0
+
+    def maybe_run(self) -> None:
+        now = time.time()
+        if now - self._last >= self.interval_seconds:
+            self._last = now
+            try:
+                self.run()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.error('%s failed: %s', type(self).__name__, e)
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+
+class JobSchedulerEvent(PodletEvent):
+    """Pops the next pending job when the slice is free."""
+    interval_seconds = 2
+
+    def run(self) -> None:
+        job_lib.schedule_step()
+
+
+class AutostopEvent(PodletEvent):
+    """Idle-timeout self-teardown.
+
+    The head host tears down its own cluster using the provider metadata the
+    provisioner embedded in cluster_info.json (parity:
+    sky/skylet/events.py:90 AutostopEvent, which reaches the cloud API from
+    the head node with mounted credentials).
+    """
+    interval_seconds = 20
+
+    def run(self) -> None:
+        config = autostop_lib.get_autostop_config()
+        if config is None or config.idle_minutes < 0:
+            return
+        if not job_lib.is_idle():
+            return
+        idle_since = max(job_lib.last_activity_time(), config.set_at)
+        idle_minutes = (time.time() - idle_since) / 60.0
+        if idle_minutes < config.idle_minutes:
+            return
+        logger.info('Idle for %.1f min >= %s min: tearing down.',
+                    idle_minutes, config.idle_minutes)
+        self._teardown(down=config.down)
+
+    def _teardown(self, down: bool) -> None:
+        import os
+
+        from skypilot_tpu.podlet import driver as driver_lib
+        info = driver_lib.load_cluster_info()
+        # The local provider needs the client's state root, passed through
+        # the daemon environment at start.
+        if info.provider == 'local':
+            client_home = info.custom.get('skytpu_home')
+            if client_home:
+                os.environ['SKYTPU_HOME'] = client_home
+        from skypilot_tpu import provision
+        if down or info.accelerator is not None:
+            provision.terminate_instances(info.provider, info.cluster_name)
+        else:
+            provision.stop_instances(info.provider, info.cluster_name)
+        # The cluster (including this daemon's host) is gone/stopping; exit
+        # cleanly.  SystemExit passes through maybe_run's exception guard.
+        logger.info('Autostop teardown complete; podlet exiting.')
+        raise SystemExit(0)

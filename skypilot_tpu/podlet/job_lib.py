@@ -1,0 +1,263 @@
+"""Per-slice job table + FIFO scheduler (runs on the head host).
+
+Parity: sky/skylet/job_lib.py — SQLite job table, JobStatus state machine,
+FIFO scheduling, idleness for autostop, and the client→head codegen twin
+(see podlet/codegen.py).  One job runs at a time: a job owns all the
+slice's chips (TPU chips are not shareable the way GPUs are).
+
+All paths are under '~' so the same code serves real head hosts (HOME=VM
+home) and local simulated hosts (HOME=host dir).
+"""
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH = '~/.skytpu/podlet/jobs.db'
+
+
+class JobStatus(enum.Enum):
+    """Parity: sky/skylet/job_lib.py:101."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def from_str(cls, s: str) -> 'JobStatus':
+        return cls(s)
+
+
+_TERMINAL = {
+    JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
+    JobStatus.CANCELLED
+}
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""CREATE TABLE IF NOT EXISTS jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        job_name TEXT,
+        username TEXT,
+        submitted_at REAL,
+        status TEXT,
+        run_timestamp TEXT,
+        start_at REAL,
+        end_at REAL,
+        pid INTEGER DEFAULT -1,
+        spec TEXT DEFAULT '{}')""")
+    conn.commit()
+    return conn
+
+
+def jobs_dir(job_id: int) -> str:
+    return os.path.expanduser(f'~/.skytpu/jobs/{job_id}')
+
+
+def log_dir(run_timestamp: str) -> str:
+    return os.path.expanduser(f'~/sky_logs/{run_timestamp}')
+
+
+# ------------------------------------------------------------- job lifecycle
+
+
+def add_job(job_name: str, username: str, run_timestamp: str,
+            spec: Dict[str, Any]) -> int:
+    """Create an INIT job; returns job id.  Called via codegen from the
+    client before the job bundle is uploaded."""
+    conn = _db()
+    with conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (job_name, username, submitted_at, status,'
+            ' run_timestamp, spec) VALUES (?,?,?,?,?,?)',
+            (job_name, username, time.time(), JobStatus.INIT.value,
+             run_timestamp, json.dumps(spec)))
+        job_id = cur.lastrowid
+    os.makedirs(jobs_dir(job_id), exist_ok=True)
+    os.makedirs(log_dir(run_timestamp), exist_ok=True)
+    return int(job_id)
+
+
+def queue_job(job_id: int) -> None:
+    set_status(job_id, JobStatus.PENDING)
+
+
+def set_status(job_id: int, status: JobStatus) -> None:
+    conn = _db()
+    with conn:
+        if status == JobStatus.RUNNING:
+            conn.execute(
+                'UPDATE jobs SET status=?, start_at=? WHERE job_id=?',
+                (status.value, time.time(), job_id))
+        elif status in _TERMINAL:
+            conn.execute(
+                'UPDATE jobs SET status=?, end_at=? WHERE job_id=?'
+                ' AND end_at IS NULL',
+                (status.value, time.time(), job_id))
+            conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+        else:
+            conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+
+
+def set_pid(job_id: int, pid: int) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE jobs SET pid=? WHERE job_id=?', (pid, job_id))
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM jobs WHERE job_id=?',
+                        (job_id,)).fetchone()
+    return _row_to_dict(row) if row else None
+
+
+def get_latest_job_id() -> Optional[int]:
+    row = _db().execute(
+        'SELECT job_id FROM jobs ORDER BY job_id DESC LIMIT 1').fetchone()
+    return row[0] if row else None
+
+
+def get_jobs(statuses: Optional[List[JobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    if statuses:
+        qs = ','.join('?' for _ in statuses)
+        rows = _db().execute(
+            f'SELECT * FROM jobs WHERE status IN ({qs})'
+            ' ORDER BY job_id DESC', [s.value for s in statuses]).fetchall()
+    else:
+        rows = _db().execute(
+            'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+    return [_row_to_dict(r) for r in rows]
+
+
+def _row_to_dict(row) -> Dict[str, Any]:
+    (job_id, job_name, username, submitted_at, status, run_timestamp,
+     start_at, end_at, pid, spec) = row
+    return {
+        'job_id': job_id,
+        'job_name': job_name,
+        'username': username,
+        'submitted_at': submitted_at,
+        'status': JobStatus(status),
+        'run_timestamp': run_timestamp,
+        'start_at': start_at,
+        'end_at': end_at,
+        'pid': pid,
+        'spec': json.loads(spec or '{}'),
+    }
+
+
+def cancel_jobs(job_ids: Optional[List[int]] = None) -> List[int]:
+    """Cancel specific jobs (or all non-terminal): kill the driver's
+    process tree on the head host, then kill the recorded process group on
+    EVERY host of the slice (the driver's ssh sessions dying does not stop
+    the remote workload)."""
+    from skypilot_tpu.utils import subprocess_utils
+    jobs = get_jobs()
+    cancelled = []
+    for job in jobs:
+        if job_ids is not None and job['job_id'] not in job_ids:
+            continue
+        if job['status'].is_terminal():
+            continue
+        if job['pid'] > 0:
+            subprocess_utils.kill_process_tree(job['pid'])
+        try:
+            from skypilot_tpu.podlet import driver as driver_lib
+            driver_lib.cancel_job_on_all_hosts(job['job_id'])
+        except Exception:  # pylint: disable=broad-except
+            pass  # cluster info may be missing (e.g. unit tests)
+        set_status(job['job_id'], JobStatus.CANCELLED)
+        cancelled.append(job['job_id'])
+    return cancelled
+
+
+def fail_all_in_progress_jobs() -> None:
+    """Daemon restart hook: anything non-terminal is dead (its driver died
+    with the old daemon).  Parity: job_lib reconciliation on skylet
+    restart."""
+    conn = _db()
+    with conn:
+        conn.execute(
+            'UPDATE jobs SET status=?, end_at=? WHERE status NOT IN '
+            f'({",".join(repr(s.value) for s in _TERMINAL)})',
+            (JobStatus.FAILED.value, time.time()))
+
+
+# ---------------------------------------------------------------- scheduler
+
+
+def schedule_step() -> Optional[int]:
+    """FIFO: if nothing is active, launch the oldest PENDING job's driver.
+    Returns the launched job id (or None)."""
+    import subprocess
+    import sys
+    conn = _db()
+    active = conn.execute(
+        'SELECT COUNT(*) FROM jobs WHERE status IN (?,?)',
+        (JobStatus.SETTING_UP.value, JobStatus.RUNNING.value)).fetchone()[0]
+    if active:
+        return None
+    row = conn.execute(
+        'SELECT job_id FROM jobs WHERE status=? ORDER BY job_id LIMIT 1',
+        (JobStatus.PENDING.value,)).fetchone()
+    if row is None:
+        return None
+    job_id = int(row[0])
+    set_status(job_id, JobStatus.SETTING_UP)
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.podlet.driver', '--job-id',
+         str(job_id)],
+        stdout=open(os.path.join(jobs_dir(job_id), 'driver.log'), 'a',
+                    encoding='utf-8'),
+        stderr=subprocess.STDOUT,
+        start_new_session=True,
+        env=os.environ.copy(),
+    )
+    set_pid(job_id, proc.pid)
+    return job_id
+
+
+# ----------------------------------------------------------------- idleness
+
+
+def is_idle() -> bool:
+    """True if no job is queued or running (autostop input).
+    Parity: is_cluster_idle (sky/skylet/job_lib.py:648)."""
+    conn = _db()
+    active = conn.execute(
+        'SELECT COUNT(*) FROM jobs WHERE status IN (?,?,?,?)',
+        (JobStatus.INIT.value, JobStatus.PENDING.value,
+         JobStatus.SETTING_UP.value, JobStatus.RUNNING.value)).fetchone()[0]
+    return active == 0
+
+
+def last_activity_time() -> float:
+    row = _db().execute(
+        'SELECT MAX(COALESCE(end_at, submitted_at)) FROM jobs').fetchone()
+    return row[0] or 0.0
+
+
+def format_job_queue(jobs: List[Dict[str, Any]]) -> str:
+    lines = [f'{"ID":<5}{"NAME":<22}{"SUBMITTED":<22}{"STATUS":<14}{"LOG"}']
+    for j in jobs:
+        ts = time.strftime('%Y-%m-%d %H:%M:%S',
+                           time.localtime(j['submitted_at']))
+        lines.append(f'{j["job_id"]:<5}{(j["job_name"] or "-")[:20]:<22}'
+                     f'{ts:<22}{j["status"].value:<14}'
+                     f'~/sky_logs/{j["run_timestamp"]}/')
+    return '\n'.join(lines)

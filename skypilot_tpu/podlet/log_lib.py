@@ -1,0 +1,66 @@
+"""Log tailing on the head host (merged multi-host job logs).
+
+Parity: sky/skylet/log_lib.py:381 (tail_logs with follow) — simplified: the
+driver already fans logs into one run.log per job, so tailing is a single
+file follow keyed by job status.
+"""
+import os
+import time
+from typing import Iterator, Optional
+
+from skypilot_tpu.podlet import job_lib
+
+_FOLLOW_POLL_SECONDS = 0.2
+
+
+def _log_path(job: dict) -> str:
+    return os.path.join(job_lib.log_dir(job['run_timestamp']), 'run.log')
+
+
+def tail_logs(job_id: Optional[int] = None, follow: bool = True,
+              lines_from_end: Optional[int] = None) -> Iterator[str]:
+    """Yield log lines; with follow=True, stream until the job ends."""
+    if job_id is None:
+        job_id = job_lib.get_latest_job_id()
+        if job_id is None:
+            yield '(no jobs submitted yet)\n'
+            return
+    job = job_lib.get_job(job_id)
+    if job is None:
+        yield f'(job {job_id} not found)\n'
+        return
+    path = _log_path(job)
+    # Wait for the driver to create the log file.
+    waited = 0.0
+    while not os.path.exists(path):
+        job = job_lib.get_job(job_id)
+        if job['status'].is_terminal() or not follow or waited > 30:
+            if os.path.exists(path):
+                break
+            yield f'(no logs for job {job_id}; status: '\
+                f'{job["status"].value})\n'
+            return
+        time.sleep(_FOLLOW_POLL_SECONDS)
+        waited += _FOLLOW_POLL_SECONDS
+    with open(path, 'r', encoding='utf-8', errors='replace') as f:
+        if lines_from_end is not None:
+            for line in f.readlines()[-lines_from_end:]:
+                yield line
+            if not follow:
+                return
+        while True:
+            line = f.readline()
+            if line:
+                yield line
+                continue
+            if not follow:
+                return
+            job = job_lib.get_job(job_id)
+            if job['status'].is_terminal():
+                # Drain anything written between checks.
+                rest = f.read()
+                if rest:
+                    yield rest
+                yield (f'(job {job_id} finished: {job["status"].value})\n')
+                return
+            time.sleep(_FOLLOW_POLL_SECONDS)

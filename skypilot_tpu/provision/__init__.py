@@ -1,0 +1,37 @@
+"""Per-cloud provisioning: a stateless function interface routed by provider.
+
+Parity: sky/provision/__init__.py:30-200 (_route_to_cloud_impl + the op
+set).  Each provider module exposes:
+
+    run_instances(region, zone, cluster_name, config) -> ProvisionRecord
+    wait_instances(region, zone, cluster_name, state) -> None
+    get_cluster_info(region, zone, cluster_name) -> ClusterInfo
+    query_instances(cluster_name, provider_config) -> dict[id, status]
+    stop_instances(cluster_name, provider_config) -> None
+    terminate_instances(cluster_name, provider_config) -> None
+    open_ports(cluster_name, ports, provider_config) -> None
+    get_command_runners(cluster_info) -> list[CommandRunner]
+"""
+import importlib
+from typing import Any, Callable
+
+
+def _impl(provider: str):
+    return importlib.import_module(f'skypilot_tpu.provision.{provider}')
+
+
+def __getattr__(name: str) -> Callable[..., Any]:
+    """provision.run_instances('gcp', ...) style dynamic routing."""
+    ops = {
+        'run_instances', 'wait_instances', 'get_cluster_info',
+        'query_instances', 'stop_instances', 'terminate_instances',
+        'open_ports', 'get_command_runners'
+    }
+    if name in ops:
+
+        def route(provider: str, *args, **kwargs):
+            return getattr(_impl(provider), name)(*args, **kwargs)
+
+        route.__name__ = name
+        return route
+    raise AttributeError(name)

@@ -1,0 +1,82 @@
+"""Shared provisioning data structures.
+
+Parity: sky/provision/common.py (ProvisionRecord, ClusterInfo, InstanceInfo).
+A TPU slice provisions as ONE cloud resource that yields MANY hosts; these
+structs model that directly (instances == hosts).
+"""
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One host (TPU-VM worker, controller VM, or local host dir)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    ssh_port: int = 22
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Local cloud only: the host's directory.
+    local_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Everything the backend needs to reach a provisioned cluster."""
+    cluster_name: str
+    provider: str                      # 'gcp' | 'local'
+    region: str
+    zone: Optional[str]
+    instances: List[InstanceInfo]      # host 0 is the head host
+    ssh_user: str = ''
+    ssh_private_key: str = ''
+    docker_user: Optional[str] = None
+    # Slice-level metadata (None for plain VMs).
+    accelerator: Optional[str] = None
+    chips_per_host: int = 0
+    num_slices: int = 1
+    custom: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def head(self) -> InstanceInfo:
+        return self.instances[0]
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.instances)
+
+    def internal_ips(self) -> List[str]:
+        return [i.internal_ip for i in self.instances]
+
+    def external_ips(self) -> List[str]:
+        return [i.external_ip or i.internal_ip for i in self.instances]
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        return json.dumps(d, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> 'ClusterInfo':
+        d = json.loads(s)
+        d['instances'] = [InstanceInfo(**i) for i in d['instances']]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances for one attempt."""
+    provider: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    resource_id: str                   # TPU node name / instance group id
+    is_resume: bool = False
+
+
+def metadata_dir(cluster_name: str) -> str:
+    from skypilot_tpu.utils import common
+    d = os.path.join(common.home_dir(), 'clusters', cluster_name)
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -1,0 +1,294 @@
+"""GCP provider: TPU slices (tpu_api) + controller VMs (compute_api).
+
+Implements the provision-op interface (see provision/__init__.py).  The
+deploy ``config`` dict comes from clouds.gcp.GCP.make_deploy_variables.
+
+State model: the cloud is the source of truth (no local instance cache);
+``metadata.json`` under the cluster metadata dir records only what we
+created (node id / vm name, kind, project, zone) so terminate/query can
+find it again — parity with the reference's tag-based discovery, using
+labels instead (all resources carry label skytpu-cluster=<name>).
+"""
+import json
+import os
+from typing import Dict, List, Optional
+
+from skypilot_tpu import authentication, exceptions, logsys
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionRecord)
+from skypilot_tpu.provision.gcp import compute_api, tpu_api
+from skypilot_tpu.utils import command_runner
+
+logger = logsys.init_logger(__name__)
+
+
+def _meta_path(cluster_name: str) -> str:
+    return os.path.join(provision_common.metadata_dir(cluster_name),
+                        'gcp.json')
+
+
+def _save_meta(cluster_name: str, meta: Dict) -> None:
+    with open(_meta_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+
+
+def _load_meta(cluster_name: str) -> Optional[Dict]:
+    try:
+        with open(_meta_path(cluster_name), 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _resource_name(cluster_name: str) -> str:
+    return f'skytpu-{cluster_name}'
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: Dict) -> ProvisionRecord:
+    assert zone is not None, 'GCP provisioning is zone-granular'
+    project = config.get('project_id')
+    if not project:
+        raise exceptions.ProvisionError(
+            'No GCP project configured (gcp.project_id).', retryable=False)
+    name = _resource_name(cluster_name)
+    ssh_user = authentication.default_ssh_user()
+    pubkey = authentication.public_key_openssh()
+    labels = dict(config.get('labels') or {})
+    labels['skytpu-cluster'] = cluster_name
+
+    if config['node_kind'] == 'tpu_slice':
+        existing = tpu_api.get_node(project, zone, name)
+        if existing is not None and existing.get('state') == 'READY':
+            record = ProvisionRecord('gcp', cluster_name, region, zone,
+                                     resource_id=name, is_resume=True)
+        else:
+            if existing is not None:
+                # Half-dead slice (e.g. PREEMPTED remnant): delete first —
+                # TPU slices cannot be repaired in place.
+                tpu_api.delete_node(project, zone, name)
+            body = tpu_api.build_node_body(
+                accelerator_type=config['tpu_type'],
+                runtime_version=config['runtime_version'],
+                ssh_public_key=pubkey,
+                ssh_user=ssh_user,
+                use_spot=config.get('use_spot', False),
+                reservation=config.get('reservation'),
+                network=config.get('network'),
+                subnetwork=config.get('subnetwork'),
+                labels=labels,
+            )
+            if config.get('queued_resource'):
+                qr_body = tpu_api.build_queued_resource_body(
+                    name, body, config.get('use_spot', False))
+                tpu_api.create_queued_resource(project, zone, name, qr_body)
+            else:
+                tpu_api.create_node(project, zone, name, body)
+            record = ProvisionRecord('gcp', cluster_name, region, zone,
+                                     resource_id=name)
+        _save_meta(
+            cluster_name, {
+                'kind': 'tpu_slice',
+                'project': project,
+                'zone': zone,
+                'region': region,
+                'resource_id': name,
+                'queued_resource': bool(config.get('queued_resource')),
+                'accelerator': config.get('accelerator'),
+                'chips_per_host': config.get('chips_per_host', 0),
+                'ssh_user': ssh_user,
+            })
+        return record
+
+    # Plain VM (controllers).
+    existing = compute_api.get_instance(project, zone, name)
+    if existing is not None:
+        # Resume: any non-running state (TERMINATED == stopped in GCE,
+        # SUSPENDED, STOPPING) needs an explicit start to come back up.
+        if existing.get('status') != 'RUNNING':
+            compute_api.start_instance(project, zone, name)
+        is_resume = True
+    else:
+        body = compute_api.build_instance_body(
+            name=name,
+            machine_type=config['instance_type'],
+            zone=zone,
+            ssh_user=ssh_user,
+            ssh_public_key=pubkey,
+            disk_size_gb=config.get('disk_size', 256),
+            image=config.get('image_id'),
+            use_spot=config.get('use_spot', False),
+            labels=labels,
+        )
+        compute_api.create_instance(project, zone, body)
+        is_resume = False
+    _save_meta(
+        cluster_name, {
+            'kind': 'vm',
+            'project': project,
+            'zone': zone,
+            'region': region,
+            'resource_id': name,
+            'ssh_user': ssh_user,
+        })
+    return ProvisionRecord('gcp', cluster_name, region, zone,
+                           resource_id=name, is_resume=is_resume)
+
+
+def wait_instances(region: str, zone: Optional[str], cluster_name: str,
+                   state: str = 'running') -> None:
+    del region
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    if meta['kind'] == 'tpu_slice':
+        tpu_api.wait_node_ready(meta['project'], meta['zone'],
+                                meta['resource_id'])
+
+
+def get_cluster_info(region: str, zone: Optional[str],
+                     cluster_name: str) -> ClusterInfo:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    project = meta['project']
+    private_key, _ = authentication.get_key_paths()
+    if meta['kind'] == 'tpu_slice':
+        node = tpu_api.get_node(project, meta['zone'], meta['resource_id'])
+        if node is None:
+            raise exceptions.ClusterDoesNotExist(cluster_name)
+        instances = []
+        for i, ep in enumerate(tpu_api.node_endpoints(node)):
+            instances.append(
+                InstanceInfo(
+                    instance_id=f'{meta["resource_id"]}-w{i}',
+                    internal_ip=ep['internal'] or '',
+                    external_ip=ep['external'],
+                ))
+        return ClusterInfo(cluster_name=cluster_name,
+                           provider='gcp',
+                           region=meta['region'],
+                           zone=meta['zone'],
+                           instances=instances,
+                           ssh_user=meta['ssh_user'],
+                           ssh_private_key=private_key,
+                           accelerator=meta.get('accelerator'),
+                           chips_per_host=meta.get('chips_per_host', 0))
+    inst = compute_api.get_instance(project, meta['zone'],
+                                    meta['resource_id'])
+    if inst is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    internal, external = compute_api.instance_ips(inst)
+    return ClusterInfo(cluster_name=cluster_name,
+                       provider='gcp',
+                       region=meta['region'],
+                       zone=meta['zone'],
+                       instances=[
+                           InstanceInfo(instance_id=meta['resource_id'],
+                                        internal_ip=internal or '',
+                                        external_ip=external)
+                       ],
+                       ssh_user=meta['ssh_user'],
+                       ssh_private_key=private_key)
+
+
+_TPU_STATE_MAP = {
+    'READY': 'running',
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'REPAIRING': 'pending',
+    'STOPPED': 'stopped',
+    'STOPPING': 'stopped',
+    'PREEMPTED': 'terminated',
+    'TERMINATED': 'terminated',
+    'DELETING': 'terminated',
+    'FAILED': 'terminated',
+}
+_VM_STATE_MAP = {
+    'RUNNING': 'running',
+    'PROVISIONING': 'pending',
+    'STAGING': 'pending',
+    'STOPPING': 'stopped',
+    'TERMINATED': 'stopped',   # GCE 'TERMINATED' == stopped-but-resumable
+    'SUSPENDED': 'stopped',
+}
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict] = None
+                    ) -> Dict[str, str]:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return {}
+    project = meta['project']
+    if meta['kind'] == 'tpu_slice':
+        node = tpu_api.get_node(project, meta['zone'], meta['resource_id'])
+        if node is None:
+            return {}
+        status = _TPU_STATE_MAP.get(node.get('state', ''), 'unknown')
+        n_hosts = max(len(node.get('networkEndpoints', [])), 1)
+        return {
+            f'{meta["resource_id"]}-w{i}': status for i in range(n_hosts)
+        }
+    inst = compute_api.get_instance(project, meta['zone'],
+                                    meta['resource_id'])
+    if inst is None:
+        return {}
+    return {
+        meta['resource_id']:
+            _VM_STATE_MAP.get(inst.get('status', ''), 'unknown')
+    }
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict] = None) -> None:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return
+    if meta['kind'] == 'tpu_slice':
+        raise exceptions.NotSupportedError(
+            'TPU slices cannot be stopped; terminate instead.')
+    compute_api.stop_instance(meta['project'], meta['zone'],
+                              meta['resource_id'])
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict] = None) -> None:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return
+    if meta['kind'] == 'tpu_slice':
+        if meta.get('queued_resource'):
+            tpu_api.delete_queued_resource(meta['project'], meta['zone'],
+                                           meta['resource_id'])
+        tpu_api.delete_node(meta['project'], meta['zone'],
+                            meta['resource_id'])
+    else:
+        compute_api.delete_instance(meta['project'], meta['zone'],
+                                    meta['resource_id'])
+    try:
+        os.remove(_meta_path(cluster_name))
+    except FileNotFoundError:
+        pass
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Optional[Dict] = None) -> None:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return
+    compute_api.open_firewall_ports(meta['project'], ports)
+
+
+def get_command_runners(
+        cluster_info: ClusterInfo
+) -> List[command_runner.CommandRunner]:
+    return [
+        command_runner.SSHCommandRunner(
+            ip=inst.external_ip or inst.internal_ip,
+            ssh_user=cluster_info.ssh_user,
+            ssh_private_key=cluster_info.ssh_private_key,
+            port=inst.ssh_port,
+        ) for inst in cluster_info.instances
+    ]

@@ -1,0 +1,181 @@
+"""Thin REST client for compute.googleapis.com — controller CPU VMs.
+
+Parity role: sky/provision/gcp/instance.py + config.py for plain VMs,
+reduced to what the jobs/serve controller planes need (single VM, default
+network, debian image, ssh-keys metadata, firewall for opened ports).
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, logsys
+from skypilot_tpu.provision.gcp import tpu_api
+
+logger = logsys.init_logger(__name__)
+
+_COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+_DEFAULT_IMAGE = ('projects/debian-cloud/global/images/family/debian-12')
+
+
+def instance_url(project: str, zone: str, name: str = '') -> str:
+    base = f'{_COMPUTE_API}/projects/{project}/zones/{zone}/instances'
+    return f'{base}/{name}' if name else base
+
+
+def build_instance_body(
+    *,
+    name: str,
+    machine_type: str,
+    zone: str,
+    ssh_user: str,
+    ssh_public_key: str,
+    disk_size_gb: int = 256,
+    image: Optional[str] = None,
+    use_spot: bool = False,
+    labels: Optional[Dict[str, str]] = None,
+    startup_script: Optional[str] = None,
+) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': f'zones/{zone}/machineTypes/{machine_type}',
+        'disks': [{
+            'boot': True,
+            'autoDelete': True,
+            'initializeParams': {
+                'sourceImage': image or _DEFAULT_IMAGE,
+                'diskSizeGb': str(disk_size_gb),
+            },
+        }],
+        'networkInterfaces': [{
+            'network': 'global/networks/default',
+            'accessConfigs': [{
+                'name': 'External NAT',
+                'type': 'ONE_TO_ONE_NAT'
+            }],
+        }],
+        'metadata': {
+            'items': [{
+                'key': 'ssh-keys',
+                'value': f'{ssh_user}:{ssh_public_key}'
+            }] + ([{
+                'key': 'startup-script',
+                'value': startup_script
+            }] if startup_script else []),
+        },
+        'labels': dict(labels or {}),
+        'tags': {'items': ['skytpu']},
+    }
+    if use_spot:
+        body['scheduling'] = {
+            'provisioningModel': 'SPOT',
+            'instanceTerminationAction': 'STOP',
+        }
+    return body
+
+
+def _wait_zone_op(project: str, zone: str, op: Dict[str, Any],
+                  timeout: float = 600, session=None) -> None:
+    name = op.get('name')
+    if name is None:
+        return
+    url = (f'{_COMPUTE_API}/projects/{project}/zones/{zone}/operations/'
+           f'{name}/wait')
+    deadline = time.time() + timeout
+    session = session or tpu_api._get_session()  # pylint: disable=protected-access
+    while time.time() < deadline:
+        cur = tpu_api._call('POST', url, session=session)  # pylint: disable=protected-access
+        if cur.get('status') == 'DONE':
+            if 'error' in cur:
+                raise classify_zone_op_error(cur['error'].get('errors', []))
+            return
+    raise exceptions.ApiError(f'Compute operation timed out: {name}')
+
+
+def classify_zone_op_error(errors: List[Dict[str, Any]]) -> Exception:
+    """Map GCE operation error codes onto the failover taxonomy.
+
+    ZONE_RESOURCE_POOL_EXHAUSTED (capacity) must fail over to the next
+    zone; QUOTA_EXCEEDED must skip the region; anything else is classified
+    by message so stockout phrasings are still caught.
+    """
+    codes = {e.get('code', '') for e in errors}
+    msg = '; '.join(e.get('message', '') for e in errors)
+    if codes & {'ZONE_RESOURCE_POOL_EXHAUSTED',
+                'ZONE_RESOURCE_POOL_EXHAUSTED_WITH_DETAILS',
+                'RESOURCE_POOL_EXHAUSTED'}:
+        return exceptions.TpuStockoutError(f'GCE capacity error: {msg[:400]}')
+    if codes & {'QUOTA_EXCEEDED'}:
+        return exceptions.QuotaExceededError(f'GCE quota error: {msg[:400]}')
+    return tpu_api.classify_http_error(409, msg)
+
+
+def create_instance(project: str, zone: str, body: Dict[str, Any],
+                    session=None) -> None:
+    op = tpu_api._call('POST', instance_url(project, zone), body,  # pylint: disable=protected-access
+                       session=session)
+    _wait_zone_op(project, zone, op, session=session)
+
+
+def get_instance(project: str, zone: str, name: str,
+                 session=None) -> Optional[Dict[str, Any]]:
+    try:
+        return tpu_api._call('GET', instance_url(project, zone, name),  # pylint: disable=protected-access
+                             session=session)
+    except exceptions.ProvisionError as e:
+        if '404' in str(e):
+            return None
+        raise
+
+
+def delete_instance(project: str, zone: str, name: str, session=None) -> None:
+    try:
+        op = tpu_api._call('DELETE', instance_url(project, zone, name),  # pylint: disable=protected-access
+                           session=session)
+    except exceptions.ProvisionError as e:
+        if '404' in str(e):
+            return
+        raise
+    _wait_zone_op(project, zone, op, session=session)
+
+
+def stop_instance(project: str, zone: str, name: str, session=None) -> None:
+    op = tpu_api._call(  # pylint: disable=protected-access
+        'POST', instance_url(project, zone, name) + '/stop', session=session)
+    _wait_zone_op(project, zone, op, timeout=900, session=session)
+
+
+def start_instance(project: str, zone: str, name: str, session=None) -> None:
+    op = tpu_api._call(  # pylint: disable=protected-access
+        'POST', instance_url(project, zone, name) + '/start', session=session)
+    _wait_zone_op(project, zone, op, timeout=900, session=session)
+
+
+def instance_ips(instance: Dict[str, Any]):
+    nic = (instance.get('networkInterfaces') or [{}])[0]
+    internal = nic.get('networkIP')
+    access = (nic.get('accessConfigs') or [{}])[0]
+    return internal, access.get('natIP')
+
+
+def open_firewall_ports(project: str, ports: List[str],
+                        session=None) -> None:
+    """One allow-ingress rule per port range, tagged to skytpu VMs."""
+    for port in ports:
+        rule_name = f'skytpu-allow-{port.replace("-", "to")}'
+        body = {
+            'name': rule_name,
+            'network': 'global/networks/default',
+            'direction': 'INGRESS',
+            'allowed': [{
+                'IPProtocol': 'tcp',
+                'ports': [port]
+            }],
+            'sourceRanges': ['0.0.0.0/0'],
+            'targetTags': ['skytpu'],
+        }
+        url = f'{_COMPUTE_API}/projects/{project}/global/firewalls'
+        try:
+            tpu_api._call('POST', url, body, session=session)  # pylint: disable=protected-access
+        except exceptions.ProvisionError as e:
+            if '409' in str(e):  # already exists
+                continue
+            raise

@@ -1,0 +1,256 @@
+"""Thin REST client for tpu.googleapis.com (v2) — TPU-VM slices.
+
+Parity: sky/provision/gcp/instance_utils.py:1185-1651 (GCPTPUVMInstance) —
+re-designed: the reference drives TPUs through googleapiclient discovery and
+treats them as a special node type inside a VM provisioner; here the slice
+is the only first-class object, talked to over plain REST (requests +
+google-auth), including the queued-resources API for spot/reserved capacity.
+
+Request *construction* is pure (unit-testable without credentials); only
+``_call`` touches the network.
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, logsys
+
+logger = logsys.init_logger(__name__)
+
+_TPU_API = 'https://tpu.googleapis.com/v2'
+_OP_POLL_INTERVAL = 5.0
+
+# -------------------------------------------------------------- auth layer
+
+
+def _get_session():
+    """Authorized requests session via application-default credentials."""
+    import google.auth
+    import google.auth.transport.requests
+    creds, _ = google.auth.default(
+        scopes=['https://www.googleapis.com/auth/cloud-platform'])
+    session = google.auth.transport.requests.AuthorizedSession(creds)
+    return session
+
+
+def _call(method: str, url: str, json_body: Optional[Dict] = None,
+          session=None) -> Dict[str, Any]:
+    session = session or _get_session()
+    resp = session.request(method, url, json=json_body)
+    if resp.status_code >= 400:
+        raise classify_http_error(resp.status_code, resp.text)
+    if not resp.text:
+        return {}
+    return resp.json()
+
+
+# -------------------------------------------------- error classification
+
+
+def classify_http_error(status: int, text: str) -> Exception:
+    """Map a TPU API error to the failover taxonomy.
+
+    Parity: the reference's GCP handler distinguishes quota vs capacity vs
+    config errors (sky/backends/cloud_vm_ray_backend.py:946 _gcp_handler,
+    TPU_NODE_CREATION_FAILURE in sky/provision/gcp/instance_utils.py:26).
+    Stockout must NOT be retried in the same zone; quota must skip the whole
+    region/project; config errors must abort failover entirely.
+    """
+    lower = text.lower()
+    stockout_markers = (
+        'there is no more capacity', 'not enough resources',
+        'does not have enough resources', 'resource_exhausted', 'stockout',
+        'no available capacity', 'out of capacity', 'insufficient capacity',
+        'resource pool exhausted',
+    )
+    quota_markers = ('quota', 'rate limit')
+    if status == 429 or any(m in lower for m in stockout_markers):
+        return exceptions.TpuStockoutError(f'TPU capacity error: {text[:400]}')
+    if status == 403 and any(m in lower for m in quota_markers):
+        return exceptions.QuotaExceededError(f'TPU quota error: {text[:400]}')
+    if status in (400, 404, 409):
+        return exceptions.ProvisionError(
+            f'TPU API error {status}: {text[:400]}', retryable=False)
+    return exceptions.ApiError(f'TPU API error {status}: {text[:400]}')
+
+
+# ----------------------------------------------------- request construction
+
+
+def node_url(project: str, zone: str, node_id: str = '') -> str:
+    base = f'{_TPU_API}/projects/{project}/locations/{zone}/nodes'
+    return f'{base}/{node_id}' if node_id else base
+
+
+def queued_resource_url(project: str, zone: str, qr_id: str = '') -> str:
+    base = f'{_TPU_API}/projects/{project}/locations/{zone}/queuedResources'
+    return f'{base}/{qr_id}' if qr_id else base
+
+
+def build_node_body(
+    *,
+    accelerator_type: str,           # GCP style, e.g. 'v5litepod-16'
+    runtime_version: str,
+    ssh_public_key: str,
+    ssh_user: str,
+    use_spot: bool = False,
+    reservation: Optional[str] = None,
+    network: Optional[str] = None,
+    subnetwork: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    startup_script: Optional[str] = None,
+    tags: Optional[List[str]] = None,
+) -> Dict[str, Any]:
+    """Node create body (pure)."""
+    body: Dict[str, Any] = {
+        'acceleratorType': accelerator_type,
+        'runtimeVersion': runtime_version,
+        'networkConfig': {
+            'network': network or 'default',
+            'subnetwork': subnetwork or '',
+            'enableExternalIps': True,
+        },
+        'metadata': {
+            'ssh-keys': f'{ssh_user}:{ssh_public_key}',
+        },
+        'labels': dict(labels or {}),
+        'tags': list(tags or ['skytpu']),
+    }
+    if not body['networkConfig']['subnetwork']:
+        del body['networkConfig']['subnetwork']
+    if startup_script:
+        body['metadata']['startup-script'] = startup_script
+    if use_spot:
+        body['schedulingConfig'] = {'spot': True}
+    if reservation:
+        body['schedulingConfig'] = {
+            'reserved': True,
+        }
+        body['reservationName'] = reservation
+    return body
+
+
+def build_queued_resource_body(node_id: str, node_body: Dict[str, Any],
+                               use_spot: bool,
+                               valid_until_seconds: Optional[int] = None
+                               ) -> Dict[str, Any]:
+    """Queued-resource wrapper for capacity that may take long to obtain."""
+    node = dict(node_body)
+    node.pop('schedulingConfig', None)
+    body: Dict[str, Any] = {
+        'tpu': {
+            'nodeSpec': [{
+                'nodeId': node_id,
+                'node': node,
+            }]
+        },
+    }
+    if use_spot:
+        body['spot'] = {}
+    else:
+        body['guaranteed'] = {}
+    if valid_until_seconds:
+        body['queueingPolicy'] = {
+            'validUntilDuration': {'seconds': valid_until_seconds}
+        }
+    return body
+
+
+# ----------------------------------------------------------- API operations
+
+
+def create_node(project: str, zone: str, node_id: str,
+                body: Dict[str, Any], session=None) -> Dict[str, Any]:
+    url = node_url(project, zone) + f'?nodeId={node_id}'
+    op = _call('POST', url, body, session=session)
+    return wait_operation(op, session=session)
+
+
+def create_queued_resource(project: str, zone: str, qr_id: str,
+                           body: Dict[str, Any], session=None
+                           ) -> Dict[str, Any]:
+    url = queued_resource_url(project, zone) + f'?queuedResourceId={qr_id}'
+    return _call('POST', url, body, session=session)
+
+
+def get_node(project: str, zone: str, node_id: str,
+             session=None) -> Optional[Dict[str, Any]]:
+    try:
+        return _call('GET', node_url(project, zone, node_id), session=session)
+    except exceptions.ProvisionError as e:
+        if '404' in str(e):
+            return None
+        raise
+
+
+def list_nodes(project: str, zone: str, session=None) -> List[Dict[str, Any]]:
+    out = _call('GET', node_url(project, zone), session=session)
+    return out.get('nodes', [])
+
+
+def delete_node(project: str, zone: str, node_id: str, session=None) -> None:
+    try:
+        op = _call('DELETE', node_url(project, zone, node_id), session=session)
+    except exceptions.ProvisionError as e:
+        if '404' in str(e):
+            return
+        raise
+    wait_operation(op, session=session)
+
+
+def delete_queued_resource(project: str, zone: str, qr_id: str,
+                           session=None) -> None:
+    try:
+        _call('DELETE',
+              queued_resource_url(project, zone, qr_id) + '?force=true',
+              session=session)
+    except exceptions.ProvisionError as e:
+        if '404' not in str(e):
+            raise
+
+
+def wait_operation(op: Dict[str, Any], timeout: float = 1800,
+                   session=None) -> Dict[str, Any]:
+    """Poll a long-running TPU operation until done.
+    Parity: TPU op polling (sky/provision/gcp/instance_utils.py:1211)."""
+    if 'name' not in op or op.get('done'):
+        return op.get('response', op)
+    url = f'https://tpu.googleapis.com/v2/{op["name"]}'
+    deadline = time.time() + timeout
+    session = session or _get_session()
+    while time.time() < deadline:
+        cur = _call('GET', url, session=session)
+        if cur.get('done'):
+            if 'error' in cur:
+                err = cur['error']
+                raise classify_http_error(
+                    int(err.get('code', 500)), err.get('message', str(err)))
+            return cur.get('response', cur)
+        time.sleep(_OP_POLL_INTERVAL)
+    raise exceptions.ApiError(f'TPU operation timed out: {op.get("name")}')
+
+
+def wait_node_ready(project: str, zone: str, node_id: str,
+                    timeout: float = 1800, session=None) -> Dict[str, Any]:
+    deadline = time.time() + timeout
+    session = session or _get_session()
+    while time.time() < deadline:
+        node = get_node(project, zone, node_id, session=session)
+        state = (node or {}).get('state')
+        if state == 'READY':
+            return node
+        if state in ('PREEMPTED', 'TERMINATED', 'FAILED'):
+            raise exceptions.ProvisionError(
+                f'TPU node {node_id} entered state {state}', retryable=True)
+        time.sleep(_OP_POLL_INTERVAL)
+    raise exceptions.ApiError(f'TPU node {node_id} not READY in {timeout}s')
+
+
+def node_endpoints(node: Dict[str, Any]) -> List[Dict[str, Optional[str]]]:
+    """[(internal_ip, external_ip)] per host, in worker order."""
+    out = []
+    for ep in node.get('networkEndpoints', []):
+        external = None
+        access = ep.get('accessConfig') or {}
+        external = access.get('externalIp')
+        out.append({'internal': ep.get('ipAddress'), 'external': external})
+    return out

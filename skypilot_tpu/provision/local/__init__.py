@@ -1,0 +1,182 @@
+"""Local provider: slices simulated as host directories + processes.
+
+The serious job of this module is to make every backend/podlet code path
+that a real TPU slice exercises — multi-host fan-out, head-host daemon,
+partial failure, stockout failover — testable on one machine (the
+reference's fake-cloud tier, SURVEY.md §4, but executing real jobs).
+
+Cluster layout:  $SKYTPU_HOME/local_cloud/<cluster>/
+    metadata.json          provider-level state (zone, status, num_hosts)
+    host0/ ... hostN-1/    one dir per simulated host (HOME of that host)
+"""
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import local as local_cloud
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionRecord)
+from skypilot_tpu.utils import command_runner, common, subprocess_utils
+
+
+def _root() -> str:
+    return os.path.join(common.home_dir(), 'local_cloud')
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(_root(), cluster_name)
+
+
+def _metadata_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), 'metadata.json')
+
+
+def _load_metadata(cluster_name: str) -> Optional[dict]:
+    try:
+        with open(_metadata_path(cluster_name), 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _save_metadata(cluster_name: str, meta: dict) -> None:
+    os.makedirs(_cluster_dir(cluster_name), exist_ok=True)
+    with open(_metadata_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: Dict) -> ProvisionRecord:
+    # Fault injection for failover tests: {zone: exception} or
+    # {zone: int-count-of-failures-before-success}.
+    fault = local_cloud.FAULT_INJECTION.get(zone)
+    if fault is not None:
+        if isinstance(fault, Exception):
+            raise fault
+        if isinstance(fault, int) and fault > 0:
+            local_cloud.FAULT_INJECTION[zone] = fault - 1
+            raise exceptions.TpuStockoutError(
+                f'[local fault injection] no capacity in {zone}')
+    existing = _load_metadata(cluster_name)
+    num_hosts = int(config.get('num_hosts', 1))
+    if existing is not None and existing.get('status') == 'running':
+        return ProvisionRecord('local', cluster_name, region, zone,
+                               resource_id=cluster_name, is_resume=True)
+    meta = {
+        'status': 'running',
+        'region': region,
+        'zone': zone,
+        'num_hosts': num_hosts,
+        'chips_per_host': int(config.get('chips_per_host') or 0),
+        'accelerator': config.get('accelerator'),
+        'created_at': time.time(),
+    }
+    for i in range(num_hosts):
+        os.makedirs(os.path.join(_cluster_dir(cluster_name), f'host{i}'),
+                    exist_ok=True)
+    _save_metadata(cluster_name, meta)
+    return ProvisionRecord('local', cluster_name, region, zone,
+                           resource_id=cluster_name,
+                           is_resume=existing is not None)
+
+
+def wait_instances(region: str, zone: Optional[str], cluster_name: str,
+                   state: str = 'running') -> None:
+    del region, zone, state  # local provisioning is synchronous
+
+
+def get_cluster_info(region: str, zone: Optional[str],
+                     cluster_name: str) -> ClusterInfo:
+    meta = _load_metadata(cluster_name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    instances = []
+    for i in range(meta['num_hosts']):
+        host_dir = os.path.join(_cluster_dir(cluster_name), f'host{i}')
+        instances.append(
+            InstanceInfo(instance_id=f'{cluster_name}-host{i}',
+                         internal_ip='127.0.0.1',
+                         external_ip='127.0.0.1',
+                         local_dir=host_dir))
+    return ClusterInfo(cluster_name=cluster_name,
+                       provider='local',
+                       region=meta['region'],
+                       zone=meta['zone'],
+                       instances=instances,
+                       accelerator=meta.get('accelerator'),
+                       chips_per_host=meta.get('chips_per_host', 0))
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict] = None
+                    ) -> Dict[str, str]:
+    meta = _load_metadata(cluster_name)
+    if meta is None:
+        return {}
+    status = meta.get('status', 'terminated')
+    return {
+        f'{cluster_name}-host{i}': status for i in range(meta['num_hosts'])
+    }
+
+
+def _kill_cluster_processes(cluster_name: str) -> None:
+    """Kill podlet daemons / jobs whose HOME is inside this cluster dir.
+
+    Never kills the calling process or its ancestors: on autodown this runs
+    INSIDE the podlet daemon (whose HOME is host0), which must survive long
+    enough to finish metadata cleanup — it exits on its own afterwards.
+    """
+    import psutil
+    root = _cluster_dir(cluster_name)
+    protected = set()
+    try:
+        p = psutil.Process()
+        while p is not None:
+            protected.add(p.pid)
+            p = p.parent()
+    except psutil.Error:
+        protected.add(os.getpid())
+    for proc in psutil.process_iter(['pid', 'environ']):
+        try:
+            if proc.info['pid'] in protected:
+                continue
+            env = proc.info['environ'] or {}
+            if env.get('HOME', '').startswith(root):
+                subprocess_utils.kill_process_tree(proc.info['pid'])
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            continue
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict] = None) -> None:
+    meta = _load_metadata(cluster_name)
+    if meta is None:
+        return
+    _kill_cluster_processes(cluster_name)
+    meta['status'] = 'stopped'
+    _save_metadata(cluster_name, meta)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict] = None) -> None:
+    if _load_metadata(cluster_name) is None:
+        return
+    _kill_cluster_processes(cluster_name)
+    shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Optional[Dict] = None) -> None:
+    del cluster_name, ports  # localhost: nothing to open
+
+
+def get_command_runners(
+        cluster_info: ClusterInfo
+) -> List[command_runner.CommandRunner]:
+    return [
+        command_runner.LocalProcessRunner(inst.local_dir, inst.instance_id)
+        for inst in cluster_info.instances
+    ]

@@ -1,0 +1,189 @@
+"""Cloud-neutral provisioning orchestration.
+
+Parity: sky/provision/provisioner.py — bulk_provision (create→wait with
+cleanup-on-failure) and post_provision_runtime_setup (connection wait →
+runtime sync → podlet start).  Differences for TPU-first design:
+
+- no Ray bootstrap: the runtime is just the skypilot_tpu package rsynced to
+  each host plus the podlet daemon on the head host;
+- version lockstep is by content hash of the package tree (the reference
+  builds/rsyncs a wheel, sky/backends/wheel_utils.py:136 — a hash-named
+  rsync of the source tree achieves the same invariant with less machinery);
+- idempotent per-host setup with a result cache, parity:
+  _parallel_ssh_with_cache (sky/provision/instance_setup.py:108).
+"""
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, logsys, provision
+from skypilot_tpu.podlet import driver as driver_lib
+from skypilot_tpu.provision.common import ClusterInfo, ProvisionRecord
+from skypilot_tpu.provision.common import metadata_dir
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import common, subprocess_utils, timeline
+
+logger = logsys.init_logger(__name__)
+
+_RUNTIME_DIR = '~/.skytpu_runtime'
+
+
+@timeline.event
+def bulk_provision(provider: str, region: str, zone: Optional[str],
+                   cluster_name: str, config: Dict[str, Any],
+                   log_path: str) -> ProvisionRecord:
+    """One provisioning attempt: create + wait; cleanup on failure.
+    Parity: sky/provision/provisioner.py:44-196."""
+    try:
+        record = provision.run_instances(provider, region, zone, cluster_name,
+                                         config)
+        provision.wait_instances(provider, region, zone, cluster_name)
+        return record
+    except (exceptions.ProvisionError, exceptions.ApiError):
+        # Leave no half-created slice behind: stockout handling must see a
+        # clean zone on the next attempt.
+        try:
+            provision.terminate_instances(provider, cluster_name)
+        except Exception as cleanup_err:  # pylint: disable=broad-except
+            logger.warning('Cleanup after failed provision also failed: %s',
+                           cleanup_err)
+        raise
+
+
+def _package_root() -> str:
+    import skypilot_tpu
+    return os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+
+
+def runtime_tree_hash() -> str:
+    """Content hash of the framework package (version-lockstep token)."""
+    root = _package_root()
+    h = hashlib.md5()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames[:] = sorted(d for d in dirnames if d != '__pycache__')
+        for fn in sorted(filenames):
+            if fn.endswith(('.pyc', '.lock')):
+                continue
+            path = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(path, root).encode())
+            with open(path, 'rb') as f:
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _setup_cache_path(cluster_name: str, node_id: str, step: str) -> str:
+    safe = node_id.replace('/', '_')
+    return os.path.join(metadata_dir(cluster_name), f'setup-{safe}-{step}')
+
+
+def _cached(cluster_name: str, node_id: str, step: str, token: str) -> bool:
+    try:
+        with open(_setup_cache_path(cluster_name, node_id, step), 'r',
+                  encoding='utf-8') as f:
+            return f.read().strip() == token
+    except FileNotFoundError:
+        return False
+
+
+def _mark(cluster_name: str, node_id: str, step: str, token: str) -> None:
+    with open(_setup_cache_path(cluster_name, node_id, step), 'w',
+              encoding='utf-8') as f:
+        f.write(token)
+
+
+@timeline.event
+def post_provision_runtime_setup(cluster_name: str, cluster_info: ClusterInfo,
+                                 log_path: str) -> None:
+    """Make a freshly-created (or resumed) cluster runnable:
+
+    1. wait until every host answers;
+    2. rsync the framework package to every host (hash-cached);
+    3. write cluster_info.json + ssh key to the head host;
+    4. start/restart the podlet daemon on the head host.
+    """
+    runners = provision.get_command_runners(cluster_info.provider,
+                                            cluster_info)
+    runner_lib.wait_for_connection(runners)
+
+    token = runtime_tree_hash()
+    pkg_root = _package_root()
+
+    def _sync_runtime(i: int) -> None:
+        runner = runners[i]
+        if _cached(cluster_name, runner.node_id, 'runtime', token):
+            return
+        runner.run(f'mkdir -p {_RUNTIME_DIR} ~/.skytpu', log_path=log_path)
+        runner.rsync(pkg_root + '/', f'{_RUNTIME_DIR}/skypilot_tpu/',
+                     up=True, log_path=log_path)
+        _mark(cluster_name, runner.node_id, 'runtime', token)
+
+    subprocess_utils.run_in_parallel(_sync_runtime, list(range(len(runners))))
+
+    # Head host extras: cluster info (for the gang driver + autostop) and
+    # the private key so the head can reach workers over internal IPs.
+    head = runners[0]
+    info_for_head = cluster_info
+    if cluster_info.provider == 'local':
+        info_for_head.custom['skytpu_home'] = common.home_dir()
+    info_json = info_for_head.to_json()
+    local_tmp = os.path.join(metadata_dir(cluster_name), 'cluster_info.json')
+    with open(local_tmp, 'w', encoding='utf-8') as f:
+        f.write(info_json)
+    head.rsync(local_tmp, driver_lib.CLUSTER_INFO_PATH, up=True,
+               log_path=log_path)
+    if cluster_info.provider != 'local' and cluster_info.ssh_private_key:
+        head.run('mkdir -p ~/.ssh && chmod 700 ~/.ssh', log_path=log_path)
+        head.rsync(cluster_info.ssh_private_key, '~/.ssh/skytpu-key', up=True,
+                   log_path=log_path)
+        head.run('chmod 600 ~/.ssh/skytpu-key', log_path=log_path)
+        # Provider metadata (e.g. gcp.json with project/zone/resource id) so
+        # the head host can tear down its own slice on autodown.  The head's
+        # SKYTPU_HOME defaults to ~/.skytpu, so the path layout matches.
+        meta_file = os.path.join(metadata_dir(cluster_name),
+                                 f'{cluster_info.provider}.json')
+        if os.path.exists(meta_file):
+            head.run(f'mkdir -p ~/.skytpu/clusters/{cluster_name}',
+                     log_path=log_path)
+            head.rsync(meta_file,
+                       f'~/.skytpu/clusters/{cluster_name}/'
+                       f'{cluster_info.provider}.json',
+                       up=True, log_path=log_path)
+
+    _start_podlet(cluster_name, head, token, log_path)
+
+
+def _start_podlet(cluster_name: str, head: runner_lib.CommandRunner,
+                  token: str, log_path: str) -> None:
+    """(Re)start the podlet daemon if missing or version-stale.
+    Parity: start_skylet_on_head_node + attempt_skylet restart-if-changed."""
+    env_exports = ''
+    if isinstance(head, runner_lib.LocalProcessRunner):
+        # Local cloud: the daemon needs the client state root for autostop.
+        env_exports = f'export SKYTPU_HOME={common.home_dir()}; '
+    check_and_start = (
+        f'{env_exports}'
+        f'export PYTHONPATH={_RUNTIME_DIR}:$PYTHONPATH; '
+        f'mkdir -p ~/.skytpu/podlet; '
+        f'CUR=$(cat ~/.skytpu/podlet/version.token 2>/dev/null || echo none); '
+        f'PID=$(cat ~/.skytpu/podlet/pid 2>/dev/null || true); '
+        f'ALIVE=no; '
+        f'if [ -n "$PID" ] && kill -0 "$PID" 2>/dev/null; then ALIVE=yes; fi; '
+        f'if [ "$CUR" != "{token}" ] || [ "$ALIVE" != yes ]; then '
+        f'  if [ -n "$PID" ]; then kill "$PID" 2>/dev/null || true; fi; '
+        f'  nohup python3 -m skypilot_tpu.podlet.daemon '
+        f'    >> ~/.skytpu/podlet/daemon.log 2>&1 & '
+        f'  echo {token} > ~/.skytpu/podlet/version.token; '
+        f'fi')
+    head.run_or_raise(check_and_start, log_path=log_path)
+
+
+def teardown_cluster(provider: str, cluster_name: str,
+                     terminate: bool) -> None:
+    if terminate:
+        provision.terminate_instances(provider, cluster_name)
+        # Drop the idempotency cache so a future same-name cluster re-syncs.
+        import shutil
+        shutil.rmtree(metadata_dir(cluster_name), ignore_errors=True)
+    else:
+        provision.stop_instances(provider, cluster_name)

@@ -192,11 +192,13 @@ class Resources:
                 raise exceptions.InvalidResourcesError(
                     'Cannot specify both accelerator and instance_type; the '
                     'TPU slice shape determines its host VMs.')
-            # Note: cloud='local' simulates slices with processes but still
-            # uses the real catalog, so shapes/zones are validated uniformly.
             catalog.get_slice_info(self._accelerator)  # raises if unknown
-            catalog.validate_region_zone(self._accelerator, self._region,
-                                         self._zone)
+            if self._cloud != 'local':
+                # The local cloud simulates slices in its own zones
+                # (local-a/b/c); only GCP placements validate against the
+                # catalog's zone offerings.
+                catalog.validate_region_zone(self._accelerator, self._region,
+                                             self._zone)
             bad_keys = set(self._accelerator_args) - {
                 'runtime_version', 'network', 'subnetwork', 'best_effort',
                 'queued_resource',

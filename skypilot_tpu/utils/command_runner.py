@@ -1,0 +1,254 @@
+"""Command runners: uniform command execution + file sync to cluster hosts.
+
+Parity: sky/utils/command_runner.py:158 (CommandRunner/SSHCommandRunner) —
+plus a LocalProcessRunner that treats a directory as a "host" (HOME
+override), which is how the local cloud simulates multi-host TPU slices so
+the backend/podlet code paths are identical for tests and real slices.
+"""
+import os
+import shlex
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions, logsys
+from skypilot_tpu.utils import subprocess_utils
+
+logger = logsys.init_logger(__name__)
+
+SSH_COMMON_OPTIONS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'AddKeysToAgent=yes',
+    '-o', 'ServerAliveInterval=15',
+    '-o', 'ServerAliveCountMax=3',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'LogLevel=ERROR',
+]
+_SSH_CONTROL_DIR = '/tmp/skytpu_ssh_control'
+
+RSYNC_EXCLUDES = ['.git/', '__pycache__/', '.venv/', '*.pyc', '.DS_Store']
+
+
+class CommandRunner:
+    """Executes commands / syncs files on one host."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            cwd: Optional[str] = None,
+            env: Optional[Dict[str, str]] = None,
+            ) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        rc = self.run('true', stream_logs=False)
+        return rc == 0
+
+    def run_or_raise(self, cmd: str, **kwargs) -> None:
+        rc = self.run(cmd, **kwargs)
+        if rc != 0:
+            raise exceptions.CommandError(int(rc), cmd,
+                                          f'on host {self.node_id}')
+
+
+class SSHCommandRunner(CommandRunner):
+    """Runs commands over ssh (ControlMaster-multiplexed) + rsync-over-ssh.
+
+    Used for real TPU-VM hosts; the key is injected via instance metadata at
+    provision time (see provision/gcp/).
+    """
+
+    def __init__(self,
+                 ip: str,
+                 ssh_user: str,
+                 ssh_private_key: str,
+                 port: int = 22,
+                 proxy_command: Optional[str] = None):
+        super().__init__(ip)
+        self.ip = ip
+        self.ssh_user = ssh_user
+        self.ssh_private_key = os.path.expanduser(ssh_private_key)
+        self.port = port
+        self.proxy_command = proxy_command
+
+    def _ssh_base(self) -> List[str]:
+        os.makedirs(_SSH_CONTROL_DIR, mode=0o700, exist_ok=True)
+        opts = list(SSH_COMMON_OPTIONS)
+        opts += [
+            '-o', 'ControlMaster=auto',
+            '-o', f'ControlPath={_SSH_CONTROL_DIR}/%C',
+            '-o', 'ControlPersist=120s',
+        ]
+        if self.proxy_command:
+            opts += ['-o', f'ProxyCommand={self.proxy_command}']
+        return ['ssh'] + opts + [
+            '-i', self.ssh_private_key, '-p', str(self.port),
+            f'{self.ssh_user}@{self.ip}'
+        ]
+
+    def run(self, cmd, *, log_path='/dev/null', stream_logs=False,
+            require_outputs=False, cwd=None, env=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        if env:
+            exports = ' '.join(
+                f'export {k}={shlex.quote(str(v))};' for k, v in env.items())
+            cmd = f'{exports} {cmd}'
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        full = self._ssh_base() + [
+            'bash', '--login', '-c',
+            shlex.quote(f'true && export OMP_NUM_THREADS=1; {cmd}')
+        ]
+        if require_outputs:
+            proc = subprocess.run(full, capture_output=True, text=True,
+                                  check=False)
+            with open(os.path.expanduser(log_path), 'a',
+                      encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+            return proc.returncode, proc.stdout, proc.stderr
+        rc, _ = subprocess_utils.run_with_log(full, log_path,
+                                              stream_logs=stream_logs)
+        return rc
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        import shutil as _shutil
+        if _shutil.which('rsync') is None:
+            self._tar_sync(source, target, up=up, log_path=log_path)
+            return
+        ssh_cmd = ' '.join(
+            ['ssh'] + SSH_COMMON_OPTIONS +
+            ['-i', self.ssh_private_key, '-p', str(self.port)] +
+            ([f'-o ProxyCommand={shlex.quote(self.proxy_command)}']
+             if self.proxy_command else []))
+        excludes = []
+        for pat in RSYNC_EXCLUDES:
+            excludes += ['--exclude', pat]
+        remote = f'{self.ssh_user}@{self.ip}:{target if up else source}'
+        pair = ([source, remote] if up else [remote, target])
+        cmd = ['rsync', '-az', '--delete'] + excludes + ['-e', ssh_cmd] + pair
+        rc, tail = subprocess_utils.run_with_log(cmd, log_path)
+        if rc != 0:
+            raise exceptions.CommandError(
+                rc, ' '.join(cmd), f'rsync failed: {tail[-500:]}')
+
+    def _tar_sync(self, source: str, target: str, *, up: bool,
+                  log_path: str) -> None:
+        """Fallback when the rsync binary is unavailable: tar over ssh.
+        No delete semantics (additive sync only)."""
+        ssh = ' '.join(shlex.quote(p) for p in self._ssh_base())
+        if up:
+            src = os.path.expanduser(source)
+            if os.path.isdir(src):
+                cmd = (f'tar -C {shlex.quote(src)} -cf - . | {ssh} '
+                       f'"mkdir -p {target} && tar -C {target} -xf -"')
+            else:
+                parent = shlex.quote(os.path.dirname(src) or '.')
+                base = shlex.quote(os.path.basename(src))
+                dst_dir, dst_base = os.path.split(target.rstrip('/'))
+                cmd = (f'tar -C {parent} -cf - {base} | {ssh} '
+                       f'"mkdir -p {dst_dir or "."} && '
+                       f'tar -C {dst_dir or "."} -xf - && '
+                       f'{"mv " + shlex.quote(os.path.basename(src)) + " " + shlex.quote(dst_base) if dst_base and dst_base != os.path.basename(src) else "true"}"')
+        else:
+            dst = os.path.expanduser(target)
+            os.makedirs(dst if target.endswith('/') else
+                        os.path.dirname(dst) or '.', exist_ok=True)
+            cmd = (f'{ssh} "tar -C {source} -cf - ." | '
+                   f'tar -C {shlex.quote(dst)} -xf -')
+        rc, tail = subprocess_utils.run_with_log(cmd, log_path, shell=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, cmd,
+                                          f'tar sync failed: {tail[-500:]}')
+
+
+class LocalProcessRunner(CommandRunner):
+    """A directory as a host: commands run with HOME pointed at it.
+
+    Everything the podlet writes under '~' lands inside the host dir, so N
+    host dirs behave like N isolated machines on localhost.
+    """
+
+    def __init__(self, host_dir: str, node_id: Optional[str] = None):
+        super().__init__(node_id or os.path.basename(host_dir))
+        self.host_dir = os.path.abspath(os.path.expanduser(host_dir))
+
+    def _env(self, extra: Optional[Dict[str, str]]) -> Dict[str, str]:
+        env = dict(os.environ)
+        env['HOME'] = self.host_dir
+        env.update({k: str(v) for k, v in (extra or {}).items()})
+        return env
+
+    def run(self, cmd, *, log_path='/dev/null', stream_logs=False,
+            require_outputs=False, cwd=None, env=None):
+        shell = isinstance(cmd, str)
+        if require_outputs:
+            proc = subprocess.run(cmd, shell=shell, cwd=cwd or self.host_dir,
+                                  env=self._env(env), capture_output=True,
+                                  text=True, errors='replace', check=False)
+            with open(os.path.expanduser(log_path), 'a',
+                      encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+            return proc.returncode, proc.stdout, proc.stderr
+        rc, _ = subprocess_utils.run_with_log(cmd, log_path,
+                                              stream_logs=stream_logs,
+                                              cwd=cwd or self.host_dir,
+                                              env=self._env(env), shell=shell)
+        return rc
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        from skypilot_tpu.utils import file_sync
+
+        def _host_path(p: str) -> str:
+            if p.startswith('~/'):
+                return os.path.join(self.host_dir, p[2:])
+            if p == '~':
+                return self.host_dir
+            return p
+
+        src, dst = ((source, _host_path(target)) if up else
+                    (_host_path(source), target))
+        src = os.path.expanduser(src)
+        dst = os.path.expanduser(dst)
+        try:
+            file_sync.sync_tree(src, dst, RSYNC_EXCLUDES, delete=False)
+        except OSError as e:
+            raise exceptions.CommandError(
+                1, f'sync {src} -> {dst}', f'local sync failed: {e}') from e
+
+
+def wait_for_connection(runners: List[CommandRunner],
+                        timeout: float = 600,
+                        interval: float = 5) -> None:
+    """Block until every host answers a trivial command (SSH-wait analog;
+    parity: provisioner.py:215-389)."""
+    deadline = time.time() + timeout
+    pending = list(runners)
+    while pending and time.time() < deadline:
+        still = []
+        for r in pending:
+            if not r.check_connection():
+                still.append(r)
+        pending = still
+        if pending:
+            time.sleep(interval)
+    if pending:
+        ids = [r.node_id for r in pending]
+        raise exceptions.NetworkError(
+            f'Hosts not reachable after {timeout}s: {ids}')

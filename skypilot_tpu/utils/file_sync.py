@@ -1,0 +1,83 @@
+"""Pure-Python tree synchronisation (rsync semantics subset).
+
+Used by LocalProcessRunner (and as the last-resort fallback when the rsync
+binary is missing): copy-if-changed by (size, mtime), optional delete of
+extraneous destination files, exclude patterns ('dir/' suffix matches
+directories, otherwise fnmatch on the basename or relative path).
+"""
+import fnmatch
+import os
+import shutil
+from typing import Iterable, List
+
+
+def _excluded(rel: str, is_dir: bool, excludes: Iterable[str]) -> bool:
+    base = os.path.basename(rel)
+    for pat in excludes:
+        if pat.endswith('/'):
+            if is_dir and (base == pat[:-1] or
+                           fnmatch.fnmatch(base, pat[:-1])):
+                return True
+            # Files under an excluded dir never reach here (we prune dirs).
+        else:
+            if fnmatch.fnmatch(base, pat) or fnmatch.fnmatch(rel, pat):
+                return True
+    return False
+
+
+def sync_tree(src: str, dst: str, excludes: List[str],
+              delete: bool = False) -> None:
+    """Sync file-or-tree src into dst (dst is the target path, not parent)."""
+    src = os.path.expanduser(src)
+    dst = os.path.expanduser(dst)
+    if os.path.isfile(src):
+        os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+        if dst.endswith(os.sep) or os.path.isdir(dst):
+            dst = os.path.join(dst, os.path.basename(src))
+        _copy_if_changed(src, dst)
+        return
+    if not os.path.isdir(src):
+        raise FileNotFoundError(src)
+    os.makedirs(dst, exist_ok=True)
+    kept = set()
+    for dirpath, dirnames, filenames in os.walk(src):
+        rel_dir = os.path.relpath(dirpath, src)
+        rel_dir = '' if rel_dir == '.' else rel_dir
+        dirnames[:] = [
+            d for d in dirnames
+            if not _excluded(os.path.join(rel_dir, d), True, excludes)
+        ]
+        for d in dirnames:
+            rel = os.path.join(rel_dir, d)
+            kept.add(rel)
+            os.makedirs(os.path.join(dst, rel), exist_ok=True)
+        for fn in filenames:
+            rel = os.path.join(rel_dir, fn)
+            if _excluded(rel, False, excludes):
+                continue
+            kept.add(rel)
+            _copy_if_changed(os.path.join(src, rel), os.path.join(dst, rel))
+    if delete:
+        for dirpath, dirnames, filenames in os.walk(dst, topdown=False):
+            rel_dir = os.path.relpath(dirpath, dst)
+            rel_dir = '' if rel_dir == '.' else rel_dir
+            for fn in filenames:
+                rel = os.path.join(rel_dir, fn)
+                if rel not in kept:
+                    os.remove(os.path.join(dst, rel))
+            for d in dirnames:
+                rel = os.path.join(rel_dir, d)
+                full = os.path.join(dst, rel)
+                if rel not in kept and not os.listdir(full):
+                    os.rmdir(full)
+
+
+def _copy_if_changed(src: str, dst: str) -> None:
+    try:
+        s, d = os.stat(src), os.stat(dst)
+        if s.st_size == d.st_size and int(s.st_mtime) <= int(d.st_mtime):
+            return
+    except FileNotFoundError:
+        pass
+    os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+    shutil.copy2(src, dst)
