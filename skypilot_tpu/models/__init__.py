@@ -1,0 +1,11 @@
+"""JAX-native model zoo.
+
+The reference ships GPU/torch recipes (llm/, examples/ — vLLM, DeepSpeed,
+torch DDP); these are their TPU-first equivalents: flax models annotated
+with logical sharding axes so the same code runs single-chip, FSDP, TP, or
+multi-slice by changing the MeshSpec only.
+"""
+from skypilot_tpu.models import registry
+from skypilot_tpu.models.registry import get_model_config, list_models
+
+__all__ = ['registry', 'get_model_config', 'list_models']

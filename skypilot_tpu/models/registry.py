@@ -1,0 +1,55 @@
+"""Model config registry: named configs for the BASELINE workloads.
+
+Sizes match the public architectures (Llama-2-7B, Llama-3-8B/3.1-8B), plus
+scaled-down variants for single-chip benches and CI-sized tests.
+"""
+from typing import Dict, List
+
+from skypilot_tpu.models.llama import LlamaConfig
+
+_LLAMA_CONFIGS: Dict[str, LlamaConfig] = {}
+
+
+def _register(cfg: LlamaConfig) -> LlamaConfig:
+    _LLAMA_CONFIGS[cfg.name] = cfg
+    return cfg
+
+
+# Llama 2 7B (llm/llama-2 + JetStream serve baseline, BASELINE.md rows 4-7).
+_register(
+    LlamaConfig(name='llama2-7b', vocab_size=32000, hidden_size=4096,
+                intermediate_size=11008, num_layers=32, num_heads=32,
+                num_kv_heads=32, max_seq_len=4096))
+# Llama 3 8B / 3.1 8B (the headline training metric).
+_register(
+    LlamaConfig(name='llama3-8b', vocab_size=128256, hidden_size=4096,
+                intermediate_size=14336, num_layers=32, num_heads=32,
+                num_kv_heads=8, max_seq_len=8192, rope_theta=500000.0))
+# ~1.1B config (TinyLlama-class): the graft-entry flagship forward model.
+_register(
+    LlamaConfig(name='llama-1b', vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, num_layers=22, num_heads=16,
+                num_kv_heads=8, max_seq_len=4096, tie_embeddings=True))
+# ~800M config sized so f32 params + adam state + remat activations fit a
+# single v5e chip's 16 GB HBM with headroom: the bench.py single-chip
+# model (llama-1b's 4x adam footprint is borderline; 8B doesn't fit).
+_register(
+    LlamaConfig(name='llama-800m', vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, num_layers=16, num_heads=16,
+                num_kv_heads=8, max_seq_len=4096, tie_embeddings=True))
+# CI-sized config: fast to init/compile on CPU.
+_register(
+    LlamaConfig(name='llama-debug', vocab_size=256, hidden_size=64,
+                intermediate_size=128, num_layers=2, num_heads=4,
+                num_kv_heads=2, max_seq_len=256, tie_embeddings=True))
+
+
+def get_model_config(name: str) -> LlamaConfig:
+    if name not in _LLAMA_CONFIGS:
+        raise ValueError(
+            f'Unknown model {name!r}. Available: {sorted(_LLAMA_CONFIGS)}')
+    return _LLAMA_CONFIGS[name]
+
+
+def list_models() -> List[str]:
+    return sorted(_LLAMA_CONFIGS)

@@ -1,0 +1,6 @@
+"""TPU-native ops: pallas kernels for the hot paths, with pure-jnp
+reference fallbacks (used on CPU and as numerical ground truth in tests).
+"""
+from skypilot_tpu.ops.flash_attention import flash_attention
+
+__all__ = ['flash_attention']

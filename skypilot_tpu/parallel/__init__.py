@@ -1,0 +1,10 @@
+"""Mesh parallelism: device meshes, logical sharding rules, collectives.
+
+The reference delegates all parallelism to workload recipes over NCCL
+(SURVEY.md §2.9); here it is a first-class subsystem: jax.sharding over an
+ICI/DCN-aware Mesh, with XLA emitting the collectives.
+"""
+from skypilot_tpu.parallel.mesh import (MeshSpec, make_mesh,
+                                        logical_axis_rules, mesh_context)
+
+__all__ = ['MeshSpec', 'make_mesh', 'logical_axis_rules', 'mesh_context']

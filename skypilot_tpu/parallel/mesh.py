@@ -1,0 +1,159 @@
+"""Device meshes + logical axis rules (the sharding vocabulary).
+
+Design: a 4-axis mesh ('data', 'fsdp', 'seq', 'tensor') covering the
+parallelism strategies the reference ships as NCCL recipes
+(SURVEY.md §2.9):
+
+  data   — pure data parallel; gradients all-reduce (DCN-friendly: this is
+           the axis to span slices with, megascale-style).
+  fsdp   — parameter/optimizer sharding (ZeRO-3 analog); params
+           all-gathered per layer, grads reduce-scattered. Rides ICI.
+  seq    — sequence/context parallelism (ring attention axis). Rides ICI
+           neighbors.
+  tensor — Megatron-style tensor parallel for mlp/heads. Innermost, needs
+           the fastest ICI.
+
+Model code never names mesh axes: it uses LOGICAL axes ('batch', 'embed',
+'mlp', 'heads', ...) mapped here — swapping strategies is a rules edit,
+not a model edit.
+"""
+import contextlib
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+MESH_AXES = ('data', 'fsdp', 'seq', 'tensor')
+
+# Logical axis -> mesh axis (or tuple: sharded over both, or None).
+_BASE_RULES: List[Tuple[str, object]] = [
+    ('batch', ('data', 'fsdp')),
+    ('activation_batch', ('data', 'fsdp')),
+    ('activation_seq', 'seq'),
+    ('activation_embed', None),
+    ('activation_heads', 'tensor'),
+    ('activation_kv', 'tensor'),
+    ('activation_mlp', 'tensor'),
+    ('embed', 'fsdp'),        # weight embed dim: FSDP-sharded
+    ('mlp', 'tensor'),
+    ('heads', 'tensor'),
+    ('kv_heads', 'tensor'),
+    ('qkv_embed', None),
+    ('vocab', 'tensor'),
+    ('expert', 'tensor'),
+    ('norm', None),
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Parallelism degrees.  Product must equal the device count."""
+    data: int = 1
+    fsdp: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int]:
+        return (self.data, self.fsdp, self.seq, self.tensor)
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.seq * self.tensor
+
+    @classmethod
+    def fsdp_only(cls, n: int) -> 'MeshSpec':
+        return cls(fsdp=n)
+
+    @classmethod
+    def auto(cls, n: int) -> 'MeshSpec':
+        """Sensible single-slice default: FSDP over all chips."""
+        return cls(fsdp=n)
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """Build a Mesh laying axes out so the innermost ('tensor') axis maps
+    to the closest devices in the default device order (on TPU, device
+    order follows the ICI torus — adjacent ids are physical neighbors, so
+    inner axes get the fastest links).
+
+    Multi-slice note: when spanning slices (jax.distributed over DCN), put
+    the slice dimension on 'data' — gradient all-reduce is the only
+    DCN-crossing collective in the FSDP+TP recipe.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if spec is None:
+        spec = MeshSpec.auto(len(devices))
+    if spec.num_devices != len(devices):
+        raise ValueError(
+            f'MeshSpec {spec.shape} needs {spec.num_devices} devices, got '
+            f'{len(devices)}.')
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_device_mesh(spec.shape, devices=devices)
+    except (ValueError, AssertionError):
+        arr = np.array(devices).reshape(spec.shape)
+    return jax.sharding.Mesh(arr, MESH_AXES)
+
+
+def logical_axis_rules(extra: Optional[List[Tuple[str, object]]] = None):
+    """Base rules with optional overrides.  Resolution is FIRST-match (flax
+    semantics), so user overrides are prepended."""
+    rules = list(_BASE_RULES)
+    if extra:
+        rules = list(extra) + rules
+    return rules
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: jax.sharding.Mesh,
+                 rules: Optional[List[Tuple[str, object]]] = None):
+    """Activate mesh + logical rules for flax with_logical_* APIs."""
+    import flax.linen as nn
+    with mesh, nn.logical_axis_rules(logical_axis_rules(rules)):
+        yield
+
+
+def named_sharding(mesh: jax.sharding.Mesh,
+                   *logical_axes: Optional[str]) -> jax.sharding.NamedSharding:
+    """NamedSharding from logical axis names.  First-match resolution, same
+    precedence as flax's rule lookup."""
+
+    def resolve(ax: Optional[str]):
+        if ax is None:
+            return None
+        for name, mesh_ax in logical_axis_rules():
+            if name == ax:
+                return mesh_ax
+        return None
+
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(*(resolve(a) for a in logical_axes)))
+
+
+def host_local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def initialize_distributed_from_env() -> bool:
+    """Call jax.distributed.initialize() from the env the podlet driver
+    exports (SKYTPU_COORDINATOR_ADDRESS / PROCESS_ID / NUM_PROCESSES).
+    Returns True if multi-process init happened.
+
+    Parity role: the reference's recipes hand-build torch.distributed
+    rendezvous from SKYPILOT_NODE_RANK/IPS (examples/
+    resnet_distributed_torch.yaml:19-26); here it is one call.
+    """
+    import os
+    coord = os.environ.get('SKYTPU_COORDINATOR_ADDRESS')
+    nproc = int(os.environ.get('SKYTPU_NUM_PROCESSES', '1'))
+    if coord is None or nproc <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=int(os.environ.get('SKYTPU_PROCESS_ID', '0')),
+    )
+    return True

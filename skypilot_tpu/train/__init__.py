@@ -1,0 +1,7 @@
+"""Training engine: sharded train step, data, checkpointing."""
+from skypilot_tpu.train.trainer import (Trainer, TrainConfig,
+                                        create_sharded_state,
+                                        make_train_step)
+
+__all__ = ['Trainer', 'TrainConfig', 'create_sharded_state',
+           'make_train_step']

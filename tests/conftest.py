@@ -7,12 +7,20 @@
 """
 import os
 
-# Must happen before jax is imported anywhere.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must happen before jax is imported anywhere.  Force-override: the machine
+# may have JAX_PLATFORMS pointing at real TPU hardware, but tests must run
+# on the virtual 8-device CPU platform.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 prev = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in prev:
     os.environ['XLA_FLAGS'] = (
         prev + ' --xla_force_host_platform_device_count=8').strip()
+
+# The machine may ship a site hook that re-pins JAX_PLATFORMS at jax import
+# (e.g. a TPU tunnel plugin); the config update after import always wins.
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 

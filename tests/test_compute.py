@@ -1,0 +1,95 @@
+"""Compute path tests on the virtual 8-device CPU mesh: model forward,
+sharded init, train step under dp/fsdp/tp meshes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import get_model_config
+from skypilot_tpu.models.llama import Llama
+from skypilot_tpu.parallel import MeshSpec, make_mesh, mesh_context
+from skypilot_tpu.train import TrainConfig, create_sharded_state
+from skypilot_tpu.train.trainer import make_train_step, synthetic_data
+
+
+def test_eight_cpu_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_model_forward_shape():
+    cfg = get_model_config('llama-debug')
+    model = Llama(cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)['params']
+    logits = model.apply({'params': params}, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_count_close_to_formula():
+    cfg = get_model_config('llama-debug')
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))['params']
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == pytest.approx(cfg.num_params, rel=0.02)
+
+
+@pytest.mark.parametrize('spec', [
+    MeshSpec(fsdp=8),
+    MeshSpec(data=2, fsdp=4),
+    MeshSpec(fsdp=4, tensor=2),  # tensor must divide num_kv_heads (2)
+    MeshSpec(data=2, fsdp=2, tensor=2),
+])
+def test_sharded_train_step(spec):
+    cfg = get_model_config('llama-debug')
+    mesh = make_mesh(spec)
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32,
+                       warmup_steps=2, total_steps=4)
+    state, _ = create_sharded_state(cfg, tcfg, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(mesh)
+    data = synthetic_data(8, 32, cfg.vocab_size)
+    with mesh:
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, next(data))
+            losses.append(float(metrics['loss']))
+    # Loss decreases on repeated random data? Not guaranteed — but it must
+    # be finite and the step must actually update params.
+    assert all(np.isfinite(l) for l in losses)
+    assert int(state.step) == 3
+
+
+def test_fsdp_params_are_sharded():
+    cfg = get_model_config('llama-debug')
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32)
+    state, _ = create_sharded_state(cfg, tcfg, mesh, jax.random.PRNGKey(0))
+    # The embedding's 'embed' axis (dim 64) should be sharded over fsdp=8.
+    emb = state.params['embedding']
+    shard_shape = emb.sharding.shard_shape(emb.shape)
+    assert shard_shape[1] == emb.shape[1] // 8
+
+
+def test_mesh_spec_validation():
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec(data=3, fsdp=2))  # 6 != 8
+
+
+def test_loss_decreases_on_fixed_batch():
+    """Optimization sanity: repeated steps on one batch reduce loss."""
+    cfg = get_model_config('llama-debug')
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32,
+                       learning_rate=1e-3, warmup_steps=1, total_steps=20)
+    state, _ = create_sharded_state(cfg, tcfg, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(mesh)
+    batch = next(synthetic_data(8, 32, cfg.vocab_size, seed=7))
+    with mesh:
+        first = None
+        for i in range(10):
+            state, metrics = step(state, batch)
+            if i == 0:
+                first = float(metrics['loss'])
+        last = float(metrics['loss'])
+    assert last < first

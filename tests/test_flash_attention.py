@@ -1,0 +1,79 @@
+"""Flash attention kernel vs reference (interpret mode on CPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.ops import flash_attention
+from skypilot_tpu.ops.flash_attention import reference_attention
+
+
+def _rand(b, h, s, d, key, hkv=None):
+    k1, k2, k3 = jax.random.split(key, 3)
+    hkv = hkv or h
+    q = jax.random.normal(k1, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(k2, (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(k3, (b, hkv, s, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = _rand(2, 4, 256, 64, jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_kv=128,
+                          use_pallas=True)  # interpret mode on CPU
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+def test_forward_gqa():
+    q, k, v = _rand(2, 8, 128, 64, jax.random.PRNGKey(1), hkv=2)
+    out = flash_attention(q, k, v, block_q=128, block_kv=128,
+                          use_pallas=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_gradients_match_reference(causal):
+    q, k, v = _rand(1, 2, 128, 32, jax.random.PRNGKey(2))
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=causal, block_q=64,
+                               block_kv=64, use_pallas=True).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=causal).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, 'qkv'):
+        np.testing.assert_allclose(gf, gr, atol=2e-2, rtol=2e-2,
+                                   err_msg=f'd{name} mismatch')
+
+
+def test_gradients_gqa():
+    q, k, v = _rand(1, 4, 64, 32, jax.random.PRNGKey(3), hkv=2)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, block_q=64, block_kv=64,
+                                use_pallas=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    # Note: with the squared loss the f32 REFERENCE deviates from f64 ground
+    # truth by up to ~0.07 here (the flash kernel is closer); the tolerance
+    # reflects mutual f32 noise, not kernel error.
+    for gf, gr, name in zip(g_flash, g_ref, 'qkv'):
+        np.testing.assert_allclose(gf, gr, atol=8e-2, rtol=8e-2,
+                                   err_msg=f'd{name} mismatch')
+
+
+def test_uneven_blocks():
+    q, k, v = _rand(1, 2, 256, 64, jax.random.PRNGKey(4))
+    out = flash_attention(q, k, v, block_q=128, block_kv=64, use_pallas=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
