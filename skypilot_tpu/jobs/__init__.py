@@ -1,0 +1,20 @@
+"""Managed (preemptible) jobs plane.
+
+Parity: sky/jobs/ — a per-user controller cluster supervises each managed
+job in its own long-lived process, relaunching the job's TPU slice on
+preemption/stockout with zone-level failover and a stable task id for
+checkpoint/resume.
+"""
+from skypilot_tpu.jobs.core import (cancel, controller_down, get_status,
+                                    launch, queue, tail_logs)
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = [
+    'ManagedJobStatus',
+    'cancel',
+    'controller_down',
+    'get_status',
+    'launch',
+    'queue',
+    'tail_logs',
+]

@@ -1,0 +1,31 @@
+"""Constants for the managed jobs plane."""
+import os
+
+# Seconds between controller health polls of the job cluster.
+# Parity: JOB_STATUS_CHECK_GAP_SECONDS (sky/jobs/utils.py).  Env override
+# keeps e2e tests fast.
+JOB_STATUS_CHECK_GAP_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_CHECK_GAP', '15'))
+
+# Seconds between "has the cluster started yet" polls during (re)launch.
+JOB_STARTED_CHECK_GAP_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_STARTED_GAP', '5'))
+
+# Backoff for provisioning retries inside recovery strategies.
+RETRY_INIT_GAP_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_RETRY_GAP', '30'))
+
+# Max attempts for the *initial* launch before declaring
+# FAILED_NO_RESOURCE (recovery keeps retrying forever).
+MAX_INITIAL_LAUNCH_RETRIES = 3
+
+# On-controller paths (HOME-relative: the controller host's own tree).
+JOBS_DIR = '~/.skytpu/managed_jobs'
+SIGNAL_DIR = '~/.skytpu/managed_jobs/signals'
+LOG_DIR = '~/.skytpu/managed_jobs/logs'
+DAG_DIR = '~/.skytpu/managed_jobs/dags'
+
+# Stable task id env var: survives recoveries so user code can key
+# checkpoints on it (parity: SKYPILOT_TASK_ID semantics,
+# sky/jobs/controller.py:59-87).
+TASK_ID_ENV_VAR = 'SKYTPU_TASK_ID'

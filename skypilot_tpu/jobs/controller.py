@@ -1,0 +1,256 @@
+"""The managed-jobs controller: one process per managed job.
+
+Parity: sky/jobs/controller.py — JobsController._run_one_task (:104), the
+monitor loop that classifies SUCCEEDED / user-code FAILED / preempted by
+consulting BOTH the job status on the cluster and the cloud-queried
+cluster health (:252), chain-DAG `run` (:342), signal-file cancellation
+(:419), and cleanup (:447).
+
+Runs ON the controller host as a podlet job:
+    python3 -m skypilot_tpu.jobs.controller --dag-yaml X --job-id N
+"""
+import argparse
+import os
+import threading
+import time
+import traceback
+from typing import Optional
+
+from skypilot_tpu import backend_utils, exceptions, logsys, state
+from skypilot_tpu.backends import SliceBackend
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import utils as jobs_utils
+from skypilot_tpu.podlet import job_lib
+from skypilot_tpu.status_lib import ClusterStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common
+
+logger = logsys.init_logger(__name__)
+
+
+class UserCancelledError(exceptions.SkyTpuError):
+    pass
+
+
+def _signal_path(job_id: int) -> str:
+    return os.path.join(os.path.expanduser(constants.SIGNAL_DIR),
+                        str(job_id))
+
+
+class LogStreamer:
+    """Streams the job cluster's merged run.log into the managed job's log
+    file on the controller host, so clients can tail THROUGH the
+    controller (the job cluster may be unreachable from the client).
+    Restarted after every recovery."""
+
+    def __init__(self, job_id: int):
+        self.path = os.path.join(os.path.expanduser(constants.LOG_DIR),
+                                 f'{job_id}.log')
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, cluster_name: str, cluster_job_id: int) -> None:
+        self.stop()
+
+        def _stream():
+            try:
+                record = state.get_cluster_from_name(cluster_name)
+                if record is None:
+                    return
+                backend = SliceBackend()
+                from skypilot_tpu.podlet import codegen
+                head = record['handle'].head_runner()
+                cmd = codegen.JobCodeGen.tail_logs(cluster_job_id,
+                                                   follow=True)
+                head.run(cmd, log_path=self.path)
+                del backend
+            except Exception:  # pylint: disable=broad-except
+                pass  # cluster died mid-stream; recovery restarts us
+
+        self._thread = threading.Thread(target=_stream, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        # The tail command exits when the job reaches a terminal state (or
+        # the connection dies with the cluster); nothing to kill hard.
+        self._thread = None
+
+    def write(self, line: str) -> None:
+        with open(self.path, 'a', encoding='utf-8') as f:
+            f.write(line if line.endswith('\n') else line + '\n')
+
+
+class JobsController:
+
+    def __init__(self, job_id: int, dag_yaml: str):
+        self.job_id = job_id
+        self.dag = jobs_utils.load_chain_dag_from_yaml(dag_yaml)
+        self.job_name = self.dag.name or 'managed'
+        self.backend = SliceBackend()
+        self.streamer = LogStreamer(job_id)
+
+    # --------------------------------------------------------------- helpers
+
+    def _check_signal(self) -> None:
+        path = _signal_path(self.job_id)
+        if os.path.exists(path):
+            raise UserCancelledError(f'managed job {self.job_id} cancelled')
+
+    def _cluster_name_for(self, task_id: int) -> str:
+        return jobs_utils.sanitize_cluster_name(
+            f'{self.job_name}-{self.job_id}-{task_id}')
+
+    def _cluster_healthy(self, cluster_name: str) -> bool:
+        """Cloud-truth health check (parity: jobs/controller.py:252 which
+        refreshes cluster status from the cloud to distinguish user
+        failure from preemption)."""
+        try:
+            record = backend_utils.refresh_cluster_record(cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return False
+        return record is not None and record['status'] == ClusterStatus.UP
+
+    # ------------------------------------------------------------- one task
+
+    def _run_one_task(self, task_id: int, task: Task) -> bool:
+        cluster_name = self._cluster_name_for(task_id)
+        # Stable task id across recoveries, for checkpoint keying.
+        stable_task_id = (f'{self.job_id}-{task_id}-'
+                          f'{task.name or self.job_name}')
+        task.update_envs({constants.TASK_ID_ENV_VAR: stable_task_id})
+        jobs_state.set_starting(self.job_id, task_id)
+        strategy = recovery_strategy.StrategyExecutor.make(
+            cluster_name, task,
+            should_cancel=lambda: os.path.exists(
+                _signal_path(self.job_id)))
+        self.streamer.write(
+            f'[controller] launching task {task_id} on {cluster_name!r}')
+        try:
+            strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            jobs_state.set_failed(self.job_id, task_id,
+                                  jobs_state.ManagedJobStatus.
+                                  FAILED_NO_RESOURCE, str(e))
+            return False
+        run_timestamp = common.get_run_timestamp()
+        jobs_state.set_submitted(self.job_id, task_id, cluster_name,
+                                 run_timestamp)
+        jobs_state.set_started(self.job_id, task_id)
+        cluster_job_id = self._latest_cluster_job_id(cluster_name)
+        self.streamer.start(cluster_name, cluster_job_id)
+
+        while True:
+            time.sleep(constants.JOB_STATUS_CHECK_GAP_SECONDS)
+            self._check_signal()
+            status = self._job_status(cluster_name)
+            if status == job_lib.JobStatus.SUCCEEDED:
+                jobs_state.set_succeeded(self.job_id, task_id)
+                self.streamer.write(
+                    f'[controller] task {task_id} SUCCEEDED')
+                strategy.cleanup_cluster()
+                return True
+            if status in (job_lib.JobStatus.FAILED,
+                          job_lib.JobStatus.FAILED_SETUP):
+                # User failure vs preemption: consult cloud truth.
+                if self._cluster_healthy(cluster_name):
+                    which = (jobs_state.ManagedJobStatus.FAILED_SETUP
+                             if status == job_lib.JobStatus.FAILED_SETUP
+                             else jobs_state.ManagedJobStatus.FAILED)
+                    jobs_state.set_failed(
+                        self.job_id, task_id, which,
+                        'User code failed; see job logs.')
+                    self.streamer.write(
+                        f'[controller] task {task_id} FAILED (user code)')
+                    strategy.cleanup_cluster()
+                    return False
+                status = None  # unhealthy cluster: treat as preemption
+            if status is None or status == job_lib.JobStatus.CANCELLED:
+                # Preempted / partially dead / unreachable.
+                self.streamer.write(
+                    f'[controller] task {task_id} preempted; recovering')
+                jobs_state.set_recovering(self.job_id, task_id)
+                strategy.recover()
+                jobs_state.set_recovered(self.job_id, task_id)
+                cluster_job_id = self._latest_cluster_job_id(cluster_name)
+                self.streamer.start(cluster_name, cluster_job_id)
+            # RUNNING / SETTING_UP / PENDING: keep monitoring.
+
+    def _job_status(self, cluster_name: str
+                    ) -> Optional[job_lib.JobStatus]:
+        record = state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return None
+        try:
+            status = self.backend.get_job_status(
+                record['handle'])['status']
+        except Exception:  # pylint: disable=broad-except
+            return None
+        return job_lib.JobStatus(status) if status else None
+
+    def _latest_cluster_job_id(self, cluster_name: str) -> int:
+        record = state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return 1
+        try:
+            return self.backend.get_job_status(
+                record['handle'])['job_id'] or 1
+        except Exception:  # pylint: disable=broad-except
+            return 1
+
+    # ----------------------------------------------------------------- run
+
+    def run(self) -> None:
+        """Chain-DAG execution (parity: jobs/controller.py:342)."""
+        tasks = self.dag.topological_order()
+        for task_id, task in enumerate(tasks):
+            jobs_state.set_pending(
+                self.job_id, task_id, task.name or self.job_name,
+                task.get_preferred_resources().pretty())
+        try:
+            for task_id, task in enumerate(tasks):
+                ok = self._run_one_task(task_id, task)
+                if not ok:
+                    # Downstream PENDING tasks will never run: terminalize
+                    # them so the job-level status settles.
+                    jobs_state.set_cancelling(self.job_id)
+                    jobs_state.set_cancelled(self.job_id)
+                    return
+        except (UserCancelledError,
+                recovery_strategy.JobCancelledDuringRecovery):
+            jobs_state.set_cancelling(self.job_id)
+            self._cleanup_all()
+            jobs_state.set_cancelled(self.job_id)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error('Controller failed: %s\n%s', e,
+                         traceback.format_exc())
+            jobs_state.set_failed(
+                self.job_id, None,
+                jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                f'Controller exception: {e}')
+            self._cleanup_all()
+
+    def _cleanup_all(self) -> None:
+        """Terminate any cluster this job may have left behind."""
+        for task_id, task in enumerate(self.dag.topological_order()):
+            cluster_name = self._cluster_name_for(task_id)
+            record = state.get_cluster_from_name(cluster_name)
+            if record is not None:
+                strategy = recovery_strategy.StrategyExecutor.make(
+                    cluster_name, task)
+                strategy.cleanup_cluster()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dag-yaml', required=True)
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    os.makedirs(os.path.expanduser(constants.SIGNAL_DIR), exist_ok=True)
+    controller = JobsController(args.job_id, args.dag_yaml)
+    controller.run()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,188 @@
+"""Managed jobs SDK: launch / queue / cancel / tail_logs.
+
+Parity: sky/jobs/core.py — `launch` wraps the user dag into a controller
+task (the controller-task template, sky/templates/jobs-controller.yaml.j2),
+launches or reuses the per-user controller cluster, and submits one
+long-lived controller process per managed job; queue/cancel/tail_logs are
+RPC-by-codegen to the controller host.
+"""
+import os
+import tempfile
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions, execution, logsys, state
+from skypilot_tpu.backends import SliceBackend
+from skypilot_tpu.jobs import utils as jobs_utils
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import controller_utils, ux
+
+logger = logsys.init_logger(__name__)
+
+
+def _controller_handle(refresh: bool = False):
+    """The controller cluster's handle, or None if it does not exist."""
+    name = controller_utils.controller_cluster_name(
+        controller_utils.JOBS_CONTROLLER)
+    if refresh:
+        from skypilot_tpu import backend_utils
+        record = backend_utils.refresh_cluster_record(name)
+    else:
+        record = state.get_cluster_from_name(name)
+    return record['handle'] if record else None
+
+
+def launch(task_or_dag: Union[Task, dag_lib.Dag],
+           name: Optional[str] = None,
+           *,
+           stream_logs: bool = True,
+           detach_run: bool = True) -> int:
+    """Launch a managed job; returns the managed job id.
+
+    The job runs under the controller's supervision: on preemption or
+    slice failure the recovery strategy relaunches it (eagerly moving to
+    the next zone by default), with `SKYTPU_TASK_ID` stable across
+    recoveries for checkpoint keying.
+    """
+    dag = jobs_utils.to_chain_dag(task_or_dag)
+    if name is not None:
+        dag.name = name
+    if dag.name is None:
+        dag.name = dag.tasks[0].name or 'managed'
+    dag.name = jobs_utils.sanitize_cluster_name(dag.name)
+    for task in dag.tasks:
+        if task.run is None:
+            raise exceptions.InvalidTaskError(
+                'Managed jobs require a run command.')
+
+    # Serialize the user dag; it rides to the controller via file mounts.
+    # The remote path carries a per-submission nonce: two same-named jobs
+    # must not overwrite each other's dag while queued.
+    fd, local_yaml = tempfile.mkstemp(prefix='skytpu-jobs-',
+                                      suffix='.yaml')
+    os.close(fd)
+    jobs_utils.dump_chain_dag_to_yaml(dag, local_yaml)
+    nonce = uuid.uuid4().hex[:8]
+    remote_yaml = f'~/.skytpu/managed_jobs/dags/{dag.name}-{nonce}.yaml'
+
+    task_resources = [
+        r for t in dag.tasks for r in t.resources
+    ]
+    controller_task = Task(
+        name=f'managed-{dag.name}',
+        setup=controller_utils.controller_setup_commands(),
+        run=(f'{controller_utils.CONTROLLER_ENV_PREFIX}'
+             f'python3 -u -m skypilot_tpu.jobs.controller '
+             f'--dag-yaml {remote_yaml} '
+             f'--job-id $SKYTPU_INTERNAL_JOB_ID'),
+        envs=jobs_utils.controller_envs(),
+    )
+    controller_task.set_file_mounts({
+        remote_yaml: local_yaml,
+        **controller_utils.credential_file_mounts(),
+    })
+    controller_task.set_resources(
+        controller_utils.controller_resources(
+            controller_utils.JOBS_CONTROLLER, task_resources))
+
+    controller_name = controller_utils.controller_cluster_name(
+        controller_utils.JOBS_CONTROLLER)
+    logger.info('%s Submitting managed job %r to controller %r.',
+                ux.emph('[jobs]'), dag.name, controller_name)
+    job_id = execution.launch(controller_task,
+                              cluster_name=controller_name,
+                              detach_run=True,
+                              stream_logs=stream_logs,
+                              fast=True)
+    assert job_id is not None
+    # Register job info on the controller so queue/cancel know the name
+    # even before the controller process initializes its tasks.
+    handle = _controller_handle()
+    head = handle.head_runner()
+    _register_job_info(head, job_id, dag.name, remote_yaml)
+    logger.info('%s Managed job %d (%s) submitted.', ux.ok('[jobs]'),
+                job_id, dag.name)
+    if not detach_run:
+        tail_logs(job_id=job_id, follow=True)
+    return job_id
+
+
+def _register_job_info(head, job_id: int, name: str,
+                       dag_yaml: str) -> None:
+    import shlex
+    py = ('import sys, os; '
+          "sys.path.insert(0, os.path.expanduser('~/.skytpu_runtime')); "
+          'from skypilot_tpu.jobs import state as js; '
+          f'js.set_job_info({job_id}, {name!r}, {dag_yaml!r})')
+    head.run_or_raise(f'python3 -c {shlex.quote(py)}')
+
+
+def queue(refresh: bool = False) -> List[Dict[str, Any]]:
+    """All managed jobs, one row per task (newest job first)."""
+    handle = _controller_handle(refresh=refresh)
+    if handle is None:
+        return []
+    head = handle.head_runner()
+    cmd = jobs_utils.ManagedJobCodeGen.get_queue()
+    rc, stdout, stderr = head.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'jobs queue', stderr[-800:])
+    return jobs_utils.parse_result(stdout)
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           name: Optional[str] = None, all_jobs: bool = False) -> List[int]:
+    """Request cancellation (signal file; the controller tears down)."""
+    if not (job_ids or name or all_jobs):
+        raise ValueError('Specify job_ids, name, or all_jobs=True.')
+    handle = _controller_handle()
+    if handle is None:
+        raise exceptions.ClusterNotUpError(
+            'No jobs controller cluster found.')
+    head = handle.head_runner()
+    cmd = jobs_utils.ManagedJobCodeGen.cancel(job_ids, name, all_jobs)
+    rc, stdout, stderr = head.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'jobs cancel', stderr[-800:])
+    return jobs_utils.parse_result(stdout)['cancelled']
+
+
+def get_status(job_id: int) -> Optional[str]:
+    handle = _controller_handle()
+    if handle is None:
+        return None
+    head = handle.head_runner()
+    cmd = jobs_utils.ManagedJobCodeGen.get_status(job_id)
+    rc, stdout, stderr = head.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'jobs status', stderr[-800:])
+    return jobs_utils.parse_result(stdout)['status']
+
+
+def tail_logs(name: Optional[str] = None, job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    """Stream a managed job's logs through the controller."""
+    handle = _controller_handle()
+    if handle is None:
+        raise exceptions.ClusterNotUpError(
+            'No jobs controller cluster found.')
+    head = handle.head_runner()
+    if name is not None and job_id is None:
+        rows = queue()
+        ids = [r['job_id'] for r in rows if r.get('job_name') == name]
+        if not ids:
+            raise exceptions.JobNotFoundError(f'managed job {name!r}')
+        job_id = max(ids)
+    cmd = jobs_utils.ManagedJobCodeGen.tail_logs(job_id, follow)
+    return int(head.run(cmd, stream_logs=True, log_path='/dev/null'))
+
+
+def controller_down(purge: bool = False) -> None:
+    """Tear down the per-user jobs controller cluster."""
+    name = controller_utils.controller_cluster_name(
+        controller_utils.JOBS_CONTROLLER)
+    record = state.get_cluster_from_name(name)
+    if record is None:
+        return
+    SliceBackend().teardown(record['handle'], terminate=True, purge=purge)

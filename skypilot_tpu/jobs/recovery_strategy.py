@@ -1,0 +1,217 @@
+"""Recovery strategies for managed (preemptible) jobs.
+
+Parity: sky/jobs/recovery_strategy.py — StrategyExecutor registry (:98),
+launch with retry/backoff + wait-for-RUNNING (:127,:194), FAILOVER (:395)
+and EAGER_NEXT_REGION (:483), re-cast at TPU-slice granularity:
+
+- The dominant failure is *zone stockout of a whole slice*, so the
+  default strategy is EAGER_NEXT_ZONE: after a preemption, immediately
+  deprioritize the zone that preempted us and try the optimizer's next
+  ranked placement.
+- TPU slices cannot be restarted after preemption — the remnant must be
+  *deleted* before relaunching (parity:
+  `need_cleanup_after_preemption_or_failure`, sky/resources.py:622).
+"""
+import time
+from typing import Callable, Dict, Optional, Type
+
+from skypilot_tpu import exceptions, execution, logsys, state
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.task import Task
+
+logger = logsys.init_logger(__name__)
+
+RECOVERY_STRATEGIES: Dict[str, Type['StrategyExecutor']] = {}
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_ZONE'
+
+
+class JobCancelledDuringRecovery(exceptions.SkyTpuError):
+    """Raised from launch/recover when the cancel signal arrives mid-retry
+    (a stockout-stuck recovery is exactly when users cancel)."""
+
+
+class StrategyExecutor:
+    """Handles one task's cluster lifecycle: launch, recover, cleanup."""
+
+    NAME = 'base'
+
+    def __init__(self, cluster_name: str, task: Task,
+                 should_cancel: Optional[Callable[[], bool]] = None):
+        self.cluster_name = cluster_name
+        self.task = task
+        self._should_cancel = should_cancel or (lambda: False)
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.NAME in RECOVERY_STRATEGIES:
+            raise ValueError(f'Duplicate strategy name {cls.NAME}')
+        RECOVERY_STRATEGIES[cls.NAME] = cls
+
+    @classmethod
+    def make(cls, cluster_name: str, task: Task,
+             should_cancel: Optional[Callable[[], bool]] = None
+             ) -> 'StrategyExecutor':
+        name = (task.get_preferred_resources().job_recovery or
+                DEFAULT_RECOVERY_STRATEGY).upper()
+        if name not in RECOVERY_STRATEGIES:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown job recovery strategy {name!r}; available: '
+                f'{sorted(RECOVERY_STRATEGIES)}')
+        return RECOVERY_STRATEGIES[name](cluster_name, task, should_cancel)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def launch(self, max_retries: Optional[int] =
+               constants.MAX_INITIAL_LAUNCH_RETRIES) -> float:
+        """Provision the cluster and wait until the job is RUNNING.
+        Returns the timestamp the job started.  Raises
+        ResourcesUnavailableError after ``max_retries`` failed rounds
+        (None = retry forever)."""
+        return self._launch(max_retries)
+
+    def recover(self) -> float:
+        """Relaunch after a preemption/failure; returns job start time.
+        Subclasses choose the placement order.  Retries forever."""
+        raise NotImplementedError
+
+    def cleanup_cluster(self) -> None:
+        """Delete the (possibly half-dead) cluster.  TPU remnants MUST be
+        deleted, never stopped."""
+        record = state.get_cluster_from_name(self.cluster_name)
+        if record is None:
+            return
+        from skypilot_tpu.backends import SliceBackend
+        try:
+            SliceBackend().teardown(record['handle'], terminate=True,
+                                    purge=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Cleanup of %r failed (ignored): %s',
+                           self.cluster_name, e)
+            # Last resort: drop the record so a relaunch is not blocked.
+            try:
+                state.remove_cluster(self.cluster_name, terminate=True)
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+    # -------------------------------------------------------------- internal
+
+    def _current_zone(self) -> Optional[str]:
+        record = state.get_cluster_from_name(self.cluster_name)
+        if record is None:
+            return None
+        return record['handle'].launched_resources.zone
+
+    def _deprioritize_zone(self, zone: Optional[str]) -> None:
+        """Move candidates in ``zone`` to the end of the ranked list."""
+        cands = getattr(self.task, 'candidates', None)
+        if not cands or zone is None:
+            return
+        good = [c for c in cands if c.zone != zone]
+        bad = [c for c in cands if c.zone == zone]
+        if good:
+            self.task.candidates = good + bad
+            self.task.best_resources = good[0].resources
+
+    def _prioritize_zone(self, zone: Optional[str]) -> None:
+        """Move candidates in ``zone`` to the front (same-placement retry)."""
+        cands = getattr(self.task, 'candidates', None)
+        if not cands or zone is None:
+            return
+        same = [c for c in cands if c.zone == zone]
+        rest = [c for c in cands if c.zone != zone]
+        if same:
+            self.task.candidates = same + rest
+            self.task.best_resources = same[0].resources
+
+    def _launch(self, max_retries: Optional[int]) -> float:
+        attempt = 0
+        backoff = constants.RETRY_INIT_GAP_SECONDS
+        while True:
+            if self._should_cancel():
+                raise JobCancelledDuringRecovery(self.cluster_name)
+            attempt += 1
+            try:
+                job_id = execution.launch(self.task,
+                                          cluster_name=self.cluster_name,
+                                          detach_run=True,
+                                          stream_logs=False)
+                start = self._wait_until_job_starts(job_id)
+                if start is not None:
+                    return start
+                raise exceptions.JobError(
+                    f'Job on {self.cluster_name!r} did not reach RUNNING.')
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('Launch attempt %d for %r failed: %s',
+                               attempt, self.cluster_name, e)
+                self.cleanup_cluster()
+                if max_retries is not None and attempt >= max_retries:
+                    raise exceptions.ResourcesUnavailableError(
+                        f'Failed to launch the job cluster after '
+                        f'{attempt} attempt(s): {e}') from e
+                slept = 0.0
+                while slept < backoff:  # interruptible backoff
+                    if self._should_cancel():
+                        raise JobCancelledDuringRecovery(self.cluster_name)
+                    time.sleep(min(2.0, backoff - slept))
+                    slept += 2.0
+                backoff = min(backoff * 2, 300)
+
+    def _wait_until_job_starts(self, job_id: Optional[int],
+                               timeout: float = 3600) -> Optional[float]:
+        """Poll the job cluster's podlet until the job is RUNNING (or
+        terminal).  Parity: _wait_until_job_starts_on_cluster
+        (sky/jobs/recovery_strategy.py:194)."""
+        from skypilot_tpu.backends import SliceBackend
+        from skypilot_tpu.podlet import job_lib
+        if job_id is None:
+            return None
+        backend = SliceBackend()
+        record = state.get_cluster_from_name(self.cluster_name)
+        if record is None:
+            return None
+        handle = record['handle']
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._should_cancel():
+                raise JobCancelledDuringRecovery(self.cluster_name)
+            try:
+                status = backend.get_job_status(handle, job_id)['status']
+            except Exception:  # pylint: disable=broad-except
+                return None  # cluster gone mid-wait
+            if status == job_lib.JobStatus.RUNNING.value:
+                return time.time()
+            if status is not None and job_lib.JobStatus(
+                    status).is_terminal():
+                # Finished before we saw RUNNING (very short jobs): fine.
+                return time.time()
+            time.sleep(constants.JOB_STARTED_CHECK_GAP_SECONDS)
+        return None
+
+
+class EagerNextZoneExecutor(StrategyExecutor):
+    """After preemption/stockout, immediately move to the optimizer's next
+    ranked zone (the preempting zone goes to the back of the line).
+    Parity: EAGER_NEXT_REGION (sky/jobs/recovery_strategy.py:483), at zone
+    granularity because a TPU slice lives entirely in one zone."""
+
+    NAME = 'EAGER_NEXT_ZONE'
+
+    def recover(self) -> float:
+        zone = self._current_zone()
+        self.cleanup_cluster()
+        self._deprioritize_zone(zone)
+        return self._launch(max_retries=None)
+
+
+class FailoverExecutor(StrategyExecutor):
+    """Retry the same zone first (data locality / reservation affinity),
+    then fail over.  Parity: FAILOVER
+    (sky/jobs/recovery_strategy.py:395)."""
+
+    NAME = 'FAILOVER'
+
+    def recover(self) -> float:
+        zone = self._current_zone()
+        self.cleanup_cluster()
+        self._prioritize_zone(zone)
+        return self._launch(max_retries=None)

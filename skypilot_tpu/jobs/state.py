@@ -1,0 +1,266 @@
+"""Managed-job state: SQLite on the controller host.
+
+Parity: sky/jobs/state.py — the `spot` table (one row per task of a
+managed job) + `job_info` (one row per managed job), with the
+ManagedJobStatus state machine (:151).  Paths are HOME-relative so the
+same code runs on real controller VMs and local simulated hosts.
+"""
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH = '~/.skytpu/managed_jobs/state.db'
+
+
+class ManagedJobStatus(enum.Enum):
+    """Parity: sky/jobs/state.py:151."""
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in _FAILED
+
+
+_FAILED = {
+    ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS, ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER
+}
+_TERMINAL = _FAILED | {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.CANCELLED
+}
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""CREATE TABLE IF NOT EXISTS job_info (
+        job_id INTEGER PRIMARY KEY,
+        name TEXT,
+        dag_yaml TEXT,
+        submitted_at REAL)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS tasks (
+        job_id INTEGER,
+        task_id INTEGER,
+        task_name TEXT,
+        status TEXT,
+        cluster_name TEXT,
+        resources TEXT,
+        submitted_at REAL,
+        start_at REAL,
+        end_at REAL,
+        last_recovered_at REAL DEFAULT -1,
+        recovery_count INTEGER DEFAULT 0,
+        failure_reason TEXT,
+        run_timestamp TEXT,
+        PRIMARY KEY (job_id, task_id))""")
+    conn.commit()
+    return conn
+
+
+# ----------------------------------------------------------------- job level
+
+
+def set_job_info(job_id: int, name: str, dag_yaml: str) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO job_info '
+            '(job_id, name, dag_yaml, submitted_at) VALUES (?,?,?,?)',
+            (job_id, name, dag_yaml, time.time()))
+
+
+def set_pending(job_id: int, task_id: int, task_name: str,
+                resources_str: str) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO tasks (job_id, task_id, task_name, '
+            'status, resources, submitted_at) VALUES (?,?,?,?,?,?)',
+            (job_id, task_id, task_name, ManagedJobStatus.PENDING.value,
+             resources_str, time.time()))
+
+
+# ---------------------------------------------------------------- task level
+
+
+def _update(job_id: int, task_id: int, fields: Dict[str, Any]) -> None:
+    sets = ', '.join(f'{k}=?' for k in fields)
+    with _db() as conn:
+        conn.execute(
+            f'UPDATE tasks SET {sets} WHERE job_id=? AND task_id=?',
+            list(fields.values()) + [job_id, task_id])
+
+
+def set_submitted(job_id: int, task_id: int, cluster_name: str,
+                  run_timestamp: str) -> None:
+    _update(job_id, task_id, {
+        'status': ManagedJobStatus.SUBMITTED.value,
+        'cluster_name': cluster_name,
+        'run_timestamp': run_timestamp,
+    })
+
+
+def set_starting(job_id: int, task_id: int) -> None:
+    _update(job_id, task_id, {'status': ManagedJobStatus.STARTING.value})
+
+
+def set_started(job_id: int, task_id: int) -> None:
+    _update(job_id, task_id, {
+        'status': ManagedJobStatus.RUNNING.value,
+        'start_at': time.time(),
+        'last_recovered_at': time.time(),
+    })
+
+
+def set_recovering(job_id: int, task_id: int) -> None:
+    _update(job_id, task_id, {'status': ManagedJobStatus.RECOVERING.value})
+
+
+def set_recovered(job_id: int, task_id: int) -> None:
+    conn = _db()
+    with conn:
+        conn.execute(
+            'UPDATE tasks SET status=?, recovery_count=recovery_count+1, '
+            'last_recovered_at=? WHERE job_id=? AND task_id=?',
+            (ManagedJobStatus.RUNNING.value, time.time(), job_id, task_id))
+
+
+def set_succeeded(job_id: int, task_id: int) -> None:
+    _update(job_id, task_id, {
+        'status': ManagedJobStatus.SUCCEEDED.value,
+        'end_at': time.time(),
+    })
+
+
+def set_failed(job_id: int, task_id: Optional[int],
+               status: ManagedJobStatus, reason: str) -> None:
+    assert status.is_failed(), status
+    fields = {
+        'status': status.value,
+        'failure_reason': reason[:2000],
+        'end_at': time.time(),
+    }
+    if task_id is None:
+        # Controller-level failure: mark every non-terminal task.
+        conn = _db()
+        with conn:
+            for row in conn.execute(
+                    'SELECT task_id, status FROM tasks WHERE job_id=?',
+                    (job_id,)).fetchall():
+                if not ManagedJobStatus(row[1]).is_terminal():
+                    sets = ', '.join(f'{k}=?' for k in fields)
+                    conn.execute(
+                        f'UPDATE tasks SET {sets} '
+                        'WHERE job_id=? AND task_id=?',
+                        list(fields.values()) + [job_id, row[0]])
+        return
+    _update(job_id, task_id, fields)
+
+
+def set_cancelling(job_id: int) -> None:
+    conn = _db()
+    with conn:
+        conn.execute(
+            'UPDATE tasks SET status=? WHERE job_id=? AND status NOT IN '
+            f'({",".join(repr(s.value) for s in _TERMINAL)})',
+            (ManagedJobStatus.CANCELLING.value, job_id))
+
+
+def set_cancelled(job_id: int) -> None:
+    conn = _db()
+    with conn:
+        conn.execute(
+            'UPDATE tasks SET status=?, end_at=? WHERE job_id=? '
+            'AND status=?',
+            (ManagedJobStatus.CANCELLED.value, time.time(), job_id,
+             ManagedJobStatus.CANCELLING.value))
+
+
+# ------------------------------------------------------------------- queries
+
+
+def get_status(job_id: int) -> Optional[ManagedJobStatus]:
+    """Aggregate job status = the furthest-behind non-terminal task, or the
+    first failure (parity: sky/jobs/state.py get_status)."""
+    rows = _db().execute(
+        'SELECT status FROM tasks WHERE job_id=? ORDER BY task_id',
+        (job_id,)).fetchall()
+    if not rows:
+        return None
+    statuses = [ManagedJobStatus(r[0]) for r in rows]
+    for s in statuses:
+        if not s.is_terminal():
+            return s
+    for s in statuses:
+        if s != ManagedJobStatus.SUCCEEDED:
+            return s
+    return ManagedJobStatus.SUCCEEDED
+
+
+def get_task_rows(job_id: int) -> List[Dict[str, Any]]:
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute(
+        'SELECT * FROM tasks WHERE job_id=? ORDER BY task_id',
+        (job_id,)).fetchall()
+    return [dict(r) for r in rows]
+
+
+def get_latest_task(job_id: int) -> Optional[Dict[str, Any]]:
+    """The task currently in flight (first non-terminal, else last)."""
+    rows = get_task_rows(job_id)
+    if not rows:
+        return None
+    for r in rows:
+        if not ManagedJobStatus(r['status']).is_terminal():
+            return r
+    return rows[-1]
+
+
+def get_queue() -> List[Dict[str, Any]]:
+    """All managed jobs, newest first, one row per task."""
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute(
+        'SELECT t.*, j.name AS job_name, j.submitted_at AS job_submitted_at '
+        'FROM tasks t LEFT JOIN job_info j USING (job_id) '
+        'ORDER BY t.job_id DESC, t.task_id').fetchall()
+    return [dict(r) for r in rows]
+
+
+def get_job_ids_by_name(name: str) -> List[int]:
+    rows = _db().execute(
+        'SELECT job_id FROM job_info WHERE name=? ORDER BY job_id DESC',
+        (name,)).fetchall()
+    return [r[0] for r in rows]
+
+
+def get_cluster_name(job_id: int) -> Optional[str]:
+    task = get_latest_task(job_id)
+    return task['cluster_name'] if task else None
+
+
+def queue_as_json() -> str:
+    out = []
+    for row in get_queue():
+        row = dict(row)
+        out.append(row)
+    return json.dumps(out)

@@ -1,0 +1,185 @@
+"""Client↔controller plumbing for managed jobs.
+
+Parity: sky/jobs/utils.py — the ManagedJobCodeGen twin (client executes
+short python programs on the controller host over the command runner),
+queue formatting, and dag-yaml (de)serialization (sky/utils/dag_utils).
+"""
+import re
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.podlet import codegen as podlet_codegen
+from skypilot_tpu.task import Task
+
+parse_result = podlet_codegen.parse_result
+
+_IMPORTS = ('from skypilot_tpu.jobs import state as jobs_state\n'
+            'from skypilot_tpu.jobs import constants as jobs_constants')
+
+
+def _wrap(body: str) -> str:
+    return podlet_codegen.wrap_python(body, _IMPORTS)
+
+
+class ManagedJobCodeGen:
+    """Shell commands to run on the controller host."""
+
+    @staticmethod
+    def get_queue() -> str:
+        return _wrap('_emit(json.loads(jobs_state.queue_as_json()))\n')
+
+    @staticmethod
+    def cancel(job_ids: Optional[List[int]] = None,
+               name: Optional[str] = None, all_jobs: bool = False) -> str:
+        body = (
+            f'ids = {job_ids!r}\n'
+            f'name = {name!r}\n'
+            f'if name is not None:\n'
+            f'    ids = jobs_state.get_job_ids_by_name(name)\n'
+            f'if {all_jobs!r}:\n'
+            f'    ids = sorted({{r["job_id"] for r in '
+            f'jobs_state.get_queue()}})\n'
+            f'sigdir = os.path.expanduser(jobs_constants.SIGNAL_DIR)\n'
+            f'os.makedirs(sigdir, exist_ok=True)\n'
+            f'touched = []\n'
+            f'for jid in (ids or []):\n'
+            f'    st = jobs_state.get_status(jid)\n'
+            f'    if st is not None and not st.is_terminal():\n'
+            f'        open(os.path.join(sigdir, str(jid)), "w").write('
+            f'"CANCEL")\n'
+            f'        touched.append(jid)\n'
+            f'_emit({{"cancelled": touched}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def get_status(job_id: int) -> str:
+        body = (f'st = jobs_state.get_status({job_id})\n'
+                f'_emit({{"status": st.value if st else None}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def tail_logs(job_id: Optional[int], follow: bool = True) -> str:
+        """Streams the managed log file (raw output, no markers)."""
+        body = (
+            f'jid = {job_id!r}\n'
+            f'if jid is None:\n'
+            f'    rows = jobs_state.get_queue()\n'
+            f'    jid = rows[0]["job_id"] if rows else None\n'
+            f'if jid is None:\n'
+            f'    sys.exit("No managed jobs.")\n'
+            f'path = os.path.join(os.path.expanduser('
+            f'jobs_constants.LOG_DIR), str(jid) + ".log")\n'
+            f'pos = 0\n'
+            f'quiet_after_done = 0\n'
+            f'while True:\n'
+            f'    chunk = ""\n'
+            f'    if os.path.exists(path):\n'
+            f'        with open(path, "r", errors="replace") as f:\n'
+            f'            f.seek(pos)\n'
+            f'            chunk = f.read()\n'
+            f'            pos = f.tell()\n'
+            f'        if chunk:\n'
+            f'            sys.stdout.write(chunk); sys.stdout.flush()\n'
+            f'    st = jobs_state.get_status(jid)\n'
+            f'    done = st is not None and st.is_terminal()\n'
+            # After the job is terminal the LogStreamer may still be
+            # draining the cluster's run.log; keep reading until the file
+            # has been quiet for a few polls.
+            f'    if done and not chunk:\n'
+            f'        quiet_after_done += 1\n'
+            f'        if quiet_after_done >= 4 or not {follow!r}:\n'
+            f'            break\n'
+            f'    elif not {follow!r} and not done:\n'
+            f'        break\n'
+            f'    time.sleep(0.5)\n')
+        return _wrap(body)
+
+
+# ------------------------------------------------------------- dag yaml i/o
+
+
+def sanitize_cluster_name(name: str) -> str:
+    s = re.sub(r'[^a-z0-9-]', '-', name.lower()).strip('-')
+    s = re.sub(r'-+', '-', s) or 'job'
+    if not s[0].isalpha():
+        s = 'j-' + s
+    return s[:50].rstrip('-')
+
+
+def dump_chain_dag_to_yaml(dag: dag_lib.Dag, path: str) -> None:
+    """Multi-document YAML: doc 0 = {name}, then one doc per task in
+    topological order (parity: sky/utils/dag_utils.py)."""
+    import yaml
+    configs: List[Dict[str, Any]] = [{'name': dag.name}]
+    for task in dag.topological_order():
+        configs.append(task.to_yaml_config())
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump_all(configs, f, default_flow_style=False)
+
+
+def load_chain_dag_from_yaml(path: str) -> dag_lib.Dag:
+    import yaml
+    with open(path, 'r', encoding='utf-8') as f:
+        configs = list(yaml.safe_load_all(f))
+    if not configs:
+        raise exceptions.InvalidTaskError(f'Empty dag yaml: {path}')
+    dag_name = None
+    if set(configs[0].keys()) == {'name'}:
+        dag_name = configs[0]['name']
+        configs = configs[1:]
+    with dag_lib.Dag(name=dag_name) as dag:
+        prev: Optional[Task] = None
+        for cfg in configs:
+            task = Task.from_yaml_config(cfg)
+            dag.add(task)
+            if prev is not None:
+                dag.add_edge(prev, task)
+            prev = task
+    return dag
+
+
+def to_chain_dag(task_or_dag) -> dag_lib.Dag:
+    if isinstance(task_or_dag, dag_lib.Dag):
+        if not task_or_dag.is_chain():
+            raise exceptions.NotSupportedError(
+                'Managed jobs support single tasks and linear pipelines '
+                'only.')
+        return task_or_dag
+    with dag_lib.Dag() as dag:
+        dag.add(task_or_dag)
+    dag.name = task_or_dag.name
+    return dag
+
+
+# ---------------------------------------------------------------- formatting
+
+
+def format_job_queue(rows: List[Dict[str, Any]]) -> str:
+    import time as time_lib
+    header = (f'{"ID":<5}{"TASK":<6}{"NAME":<20}{"RESOURCES":<24}'
+              f'{"SUBMITTED":<20}{"STATUS":<18}{"#RECOVERIES":<12}'
+              f'{"CLUSTER"}')
+    lines = [header]
+    for r in rows:
+        ts = r.get('job_submitted_at') or r.get('submitted_at')
+        ts_s = (time_lib.strftime('%Y-%m-%d %H:%M:%S',
+                                  time_lib.localtime(ts)) if ts else '-')
+        lines.append(
+            f'{r["job_id"]:<5}{r["task_id"]:<6}'
+            f'{(r.get("job_name") or r.get("task_name") or "-")[:18]:<20}'
+            f'{(r.get("resources") or "-")[:22]:<24}{ts_s:<20}'
+            f'{r["status"]:<18}{r.get("recovery_count", 0):<12}'
+            f'{r.get("cluster_name") or "-"}')
+    return '\n'.join(lines)
+
+
+def controller_envs() -> Dict[str, str]:
+    """Env vars forwarded from client to controller task (test knobs)."""
+    import os
+    envs = {}
+    for key in ('SKYTPU_JOBS_CHECK_GAP', 'SKYTPU_JOBS_STARTED_GAP',
+                'SKYTPU_JOBS_RETRY_GAP'):
+        if key in os.environ:
+            envs[key] = os.environ[key]
+    return envs

@@ -15,18 +15,28 @@ RESULT_END = '<<<END_SKYTPU_RESULT>>>'
 _RUNTIME_PYTHONPATH = '~/.skytpu_runtime'
 
 _PRELUDE = """\
-import json, sys
-sys.path.insert(0, __import__('os').path.expanduser('{pythonpath}'))
-from skypilot_tpu.podlet import job_lib, log_lib, autostop_lib
+import json, os, sys, time
+sys.path.insert(0, os.path.expanduser('{pythonpath}'))
+{imports}
 def _emit(obj):
     print({begin!r}); print(json.dumps(obj)); print({end!r})
 """
 
 
-def _wrap(body: str) -> str:
+def wrap_python(body: str, imports: str) -> str:
+    """Build a `python3 -c` shell command that runs ``body`` on a host with
+    the framework runtime on its path, emitting results between sentinel
+    markers (shared by the podlet, jobs, and serve codegen twins)."""
     prelude = _PRELUDE.format(pythonpath=_RUNTIME_PYTHONPATH,
+                              imports=imports,
                               begin=RESULT_BEGIN, end=RESULT_END)
     return f'python3 -u -c {shlex.quote(prelude + body)}'
+
+
+def _wrap(body: str) -> str:
+    return wrap_python(
+        body, 'from skypilot_tpu.podlet import job_lib, log_lib, '
+        'autostop_lib')
 
 
 def parse_result(stdout: str) -> Any:

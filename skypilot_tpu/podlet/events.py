@@ -33,11 +33,37 @@ class PodletEvent:
 
 
 class JobSchedulerEvent(PodletEvent):
-    """Pops the next pending job when the slice is free."""
+    """Pops the next pending job when a slot is free.
+
+    TPU slices run one job at a time (the job owns the chips); chip-less
+    controller VMs run up to ``_CONTROLLER_PARALLELISM`` jobs concurrently —
+    each managed job / serve service is one long-lived podlet job.
+    """
     interval_seconds = 2
 
+    _CONTROLLER_PARALLELISM = 16
+
+    def __init__(self):
+        super().__init__()
+        self._max_parallel = None
+
+    def _resolve_max_parallel(self) -> int:
+        if self._max_parallel is None:
+            try:
+                from skypilot_tpu.podlet import driver as driver_lib
+                info = driver_lib.load_cluster_info()
+                chips = info.chips_per_host or 0
+            except Exception:  # pylint: disable=broad-except
+                # cluster_info.json missing/corrupt (e.g. mid-rewrite):
+                # fall back to the safe serial default WITHOUT caching, and
+                # retry resolution next tick.
+                return 1
+            self._max_parallel = (1 if chips > 0 else
+                                  self._CONTROLLER_PARALLELISM)
+        return self._max_parallel
+
     def run(self) -> None:
-        job_lib.schedule_step()
+        job_lib.schedule_step(self._resolve_max_parallel())
 
 
 class AutostopEvent(PodletEvent):

@@ -201,16 +201,21 @@ def fail_all_in_progress_jobs() -> None:
 # ---------------------------------------------------------------- scheduler
 
 
-def schedule_step() -> Optional[int]:
-    """FIFO: if nothing is active, launch the oldest PENDING job's driver.
-    Returns the launched job id (or None)."""
+def schedule_step(max_parallel: int = 1) -> Optional[int]:
+    """FIFO: if a slot is free, launch the oldest PENDING job's driver.
+    Returns the launched job id (or None).
+
+    ``max_parallel`` is 1 on TPU slices (a job owns all the chips) and >1 on
+    chip-less controller VMs, which run many managed-job / serve processes
+    concurrently (parity: the reference's CPU/memory-based job scheduling on
+    controller clusters, sky/skylet/job_lib.py:183)."""
     import subprocess
     import sys
     conn = _db()
     active = conn.execute(
         'SELECT COUNT(*) FROM jobs WHERE status IN (?,?)',
         (JobStatus.SETTING_UP.value, JobStatus.RUNNING.value)).fetchone()[0]
-    if active:
+    if active >= max_parallel:
         return None
     row = conn.execute(
         'SELECT job_id FROM jobs WHERE status=? ORDER BY job_id LIMIT 1',

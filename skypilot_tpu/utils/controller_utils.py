@@ -1,0 +1,111 @@
+"""Shared helpers for the two controller planes (managed jobs + serve).
+
+Parity: sky/utils/controller_utils.py — the Controllers enum (cluster
+naming + default resources, :93), controller resource resolution (:449),
+and the setup that makes a freshly-provisioned controller host able to
+call `launch()` recursively (the reference mounts cloud credentials and
+installs cloud deps, :191; our controller hosts get the framework synced
+to ~/.skytpu_runtime by the provisioner, so setup only has to point the
+environment at it and enable clouds).
+"""
+import dataclasses
+import os
+import shlex
+from typing import Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions, state
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import common
+
+
+@dataclasses.dataclass(frozen=True)
+class ControllerSpec:
+    """One controller plane (parity: Controllers enum members)."""
+    kind: str  # 'jobs' | 'serve'
+    config_key: str
+    default_cpus: str
+
+
+JOBS_CONTROLLER = ControllerSpec(kind='jobs', config_key='jobs',
+                                 default_cpus='8+')
+SERVE_CONTROLLER = ControllerSpec(kind='serve', config_key='serve',
+                                  default_cpus='4+')
+
+# Shell prefix every controller-side command starts with: the controller
+# process must (1) use the host-local state root — NOT any SKYTPU_HOME that
+# leaked in from the client via the podlet daemon's environment — and
+# (2) import the framework from the provisioner-synced runtime tree.
+CONTROLLER_ENV_PREFIX = (
+    'export SKYTPU_HOME="$HOME/.skytpu"; '
+    'export PYTHONPATH="$HOME/.skytpu_runtime:$PYTHONPATH"; ')
+
+
+def controller_cluster_name(spec: ControllerSpec) -> str:
+    """Per-user controller cluster (parity: sky-jobs-controller-<hash>)."""
+    return f'skytpu-{spec.kind}-controller-{common.get_user_hash()[:8]}'
+
+
+def controller_resources(spec: ControllerSpec,
+                         task_resources: Optional[List[Resources]] = None
+                         ) -> Resources:
+    """Resolve the controller VM's resources.
+
+    Order: user config override (`<kind>.controller.resources`) >
+    same-cloud-as-task CPU VM > any enabled cloud.
+    """
+    override = config_lib.get_nested(
+        (spec.config_key, 'controller', 'resources'), None)
+    if override:
+        return Resources.from_yaml_config(override)
+    clouds: List[str] = []
+    for r in (task_resources or []):
+        if r.cloud and r.cloud not in clouds:
+            clouds.append(r.cloud)
+    if not clouds:
+        clouds = state.get_cached_enabled_clouds()
+    if not clouds:
+        raise exceptions.NoCloudAccessError(
+            'No enabled clouds to place the controller on; run '
+            '`skytpu check` first.')
+    cloud = clouds[0]
+    if cloud == 'local':
+        return Resources(cloud='local')
+    return Resources(cloud=cloud, cpus=spec.default_cpus)
+
+
+def enable_clouds_snippet() -> str:
+    """Shell command that enables the client's clouds on the controller.
+
+    The controller host has its own empty state DB; recursive `launch()`
+    calls there need the same cloud set the client had.  Credentials for
+    real clouds ride the file mounts (see `credential_file_mounts`).
+    """
+    clouds = state.get_cached_enabled_clouds() or ['local']
+    py = ('from skypilot_tpu import state; '
+          f'state.set_enabled_clouds({clouds!r})')
+    return f'python3 -c {shlex.quote(py)}'
+
+
+def credential_file_mounts() -> Dict[str, str]:
+    """Client credential files to mount onto the controller so it can call
+    cloud APIs (parity: sky/utils/controller_utils.py:191's credential
+    mounting).  GCP: application-default credentials + gcloud config."""
+    mounts: Dict[str, str] = {}
+    adc = os.path.expanduser(
+        '~/.config/gcloud/application_default_credentials.json')
+    if os.path.exists(adc):
+        mounts['~/.config/gcloud/application_default_credentials.json'] = adc
+    ssh_key = os.path.join(common.keys_dir(), 'skytpu-key')
+    if os.path.exists(ssh_key):
+        mounts['~/.skytpu/keys/skytpu-key'] = ssh_key
+        if os.path.exists(ssh_key + '.pub'):
+            mounts['~/.skytpu/keys/skytpu-key.pub'] = ssh_key + '.pub'
+    return mounts
+
+
+def controller_setup_commands() -> str:
+    """The controller task's `setup:` — runs once per controller host."""
+    return (f'{CONTROLLER_ENV_PREFIX}'
+            f'mkdir -p ~/.skytpu/managed_jobs ~/.skytpu/serve; '
+            f'{enable_clouds_snippet()}')

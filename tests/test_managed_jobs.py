@@ -1,0 +1,248 @@
+"""Managed jobs plane: unit tests (state machine, recovery reordering,
+dag yaml) + e2e on the local cloud (launch, preemption recovery, cancel).
+
+Parity role: tests/test_jobs.py + the managed-jobs smoke tests
+(tests/test_smoke.py spot recovery via out-of-band termination), runnable
+without clouds (SURVEY.md §4).
+"""
+import glob
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import Resources, Task, state
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs import utils as jobs_utils
+
+
+@pytest.fixture
+def jobs_home(tmp_path, monkeypatch):
+    """jobs_state uses HOME-relative paths (controller-host convention)."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    yield str(tmp_path)
+
+
+# --------------------------------------------------------------------- unit
+
+
+def test_state_machine_happy_path(jobs_home):
+    jobs_state.set_job_info(1, 'train', '/dag.yaml')
+    jobs_state.set_pending(1, 0, 'train', 'local')
+    assert jobs_state.get_status(1) == jobs_state.ManagedJobStatus.PENDING
+    jobs_state.set_starting(1, 0)
+    jobs_state.set_submitted(1, 0, 'train-1-0', 'ts')
+    jobs_state.set_started(1, 0)
+    assert jobs_state.get_status(1) == jobs_state.ManagedJobStatus.RUNNING
+    jobs_state.set_recovering(1, 0)
+    assert jobs_state.get_status(1) == (
+        jobs_state.ManagedJobStatus.RECOVERING)
+    jobs_state.set_recovered(1, 0)
+    rows = jobs_state.get_task_rows(1)
+    assert rows[0]['recovery_count'] == 1
+    jobs_state.set_succeeded(1, 0)
+    assert jobs_state.get_status(1) == (
+        jobs_state.ManagedJobStatus.SUCCEEDED)
+    assert jobs_state.get_cluster_name(1) == 'train-1-0'
+
+
+def test_state_machine_multi_task_aggregate(jobs_home):
+    jobs_state.set_job_info(2, 'pipe', '/dag.yaml')
+    jobs_state.set_pending(2, 0, 'a', 'r')
+    jobs_state.set_pending(2, 1, 'b', 'r')
+    jobs_state.set_starting(2, 0)
+    jobs_state.set_started(2, 0)
+    jobs_state.set_succeeded(2, 0)
+    # Task 1 still pending -> job-level status is PENDING (in flight).
+    assert jobs_state.get_status(2) == jobs_state.ManagedJobStatus.PENDING
+    jobs_state.set_failed(2, 1, jobs_state.ManagedJobStatus.FAILED, 'boom')
+    assert jobs_state.get_status(2) == jobs_state.ManagedJobStatus.FAILED
+
+
+def test_cancel_flow(jobs_home):
+    jobs_state.set_job_info(3, 'c', '/d.yaml')
+    jobs_state.set_pending(3, 0, 'c', 'r')
+    jobs_state.set_starting(3, 0)
+    jobs_state.set_cancelling(3)
+    jobs_state.set_cancelled(3)
+    assert jobs_state.get_status(3) == (
+        jobs_state.ManagedJobStatus.CANCELLED)
+
+
+def test_dag_yaml_roundtrip(tmp_path):
+    with dag_lib.Dag(name='pipeline') as dag:
+        t1 = Task('stage1', run='echo 1')
+        t1.set_resources(Resources(cloud='local'))
+        t2 = Task('stage2', run='echo 2')
+        t2.set_resources(Resources(cloud='local'))
+        dag.add(t1)
+        dag.add(t2)
+        dag.add_edge(t1, t2)
+    path = str(tmp_path / 'dag.yaml')
+    jobs_utils.dump_chain_dag_to_yaml(dag, path)
+    loaded = jobs_utils.load_chain_dag_from_yaml(path)
+    assert loaded.name == 'pipeline'
+    assert [t.name for t in loaded.topological_order()] == [
+        'stage1', 'stage2'
+    ]
+    assert loaded.tasks[0].run == 'echo 1'
+
+
+def test_sanitize_cluster_name():
+    assert jobs_utils.sanitize_cluster_name('My Job_1') == 'my-job-1'
+    assert jobs_utils.sanitize_cluster_name('9lives') == 'j-9lives'
+    long = jobs_utils.sanitize_cluster_name('x' * 99)
+    assert len(long) <= 50
+
+
+class _Cand:
+
+    def __init__(self, zone):
+        self.zone = zone
+        self.resources = Resources(cloud='local', zone=zone)
+
+
+def test_eager_next_zone_reordering(enable_local_cloud):
+    task = Task('t', run='true')
+    task.set_resources(Resources(cloud='local'))
+    task.candidates = [_Cand('local-a'), _Cand('local-b'), _Cand('local-c')]
+    ex = recovery_strategy.StrategyExecutor.make('c1', task)
+    assert isinstance(ex, recovery_strategy.EagerNextZoneExecutor)
+    ex._deprioritize_zone('local-a')
+    assert [c.zone for c in task.candidates] == [
+        'local-b', 'local-c', 'local-a'
+    ]
+    assert task.best_resources.zone == 'local-b'
+
+
+def test_failover_strategy_prioritizes_same_zone(enable_local_cloud):
+    task = Task('t', run='true')
+    task.set_resources(
+        Resources(cloud='local', job_recovery='failover'))
+    task.candidates = [_Cand('local-a'), _Cand('local-b'), _Cand('local-c')]
+    ex = recovery_strategy.StrategyExecutor.make('c1', task)
+    assert isinstance(ex, recovery_strategy.FailoverExecutor)
+    ex._prioritize_zone('local-b')
+    assert [c.zone for c in task.candidates] == [
+        'local-b', 'local-a', 'local-c'
+    ]
+
+
+# ---------------------------------------------------------------------- e2e
+
+
+@pytest.fixture
+def fast_controller(monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_CHECK_GAP', '1')
+    monkeypatch.setenv('SKYTPU_JOBS_STARTED_GAP', '0.5')
+    monkeypatch.setenv('SKYTPU_JOBS_RETRY_GAP', '1')
+    yield
+
+
+@pytest.fixture
+def local_jobs(skytpu_home, enable_local_cloud, fast_controller):
+    from skypilot_tpu import core, jobs
+    yield
+    # Teardown: cancel stragglers + kill all controller/cluster processes.
+    try:
+        jobs.cancel(all_jobs=True)
+        time.sleep(1)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    for rec in state.get_clusters():
+        try:
+            core.down(rec['name'], purge=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def _wait_status(jobs_mod, job_id, want, timeout=90):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = jobs_mod.get_status(job_id)
+        if last == want:
+            return last
+        if last is not None and jobs_state.ManagedJobStatus(
+                last).is_terminal() and last != want:
+            raise AssertionError(
+                f'job {job_id} reached terminal {last}, wanted {want}')
+        time.sleep(1)
+    raise TimeoutError(f'job {job_id}: last status {last}, wanted {want}')
+
+
+@pytest.mark.e2e
+def test_managed_job_end_to_end(local_jobs):
+    from skypilot_tpu import jobs
+    task = Task('mjob', run='echo "managed says hi"')
+    task.set_resources(Resources(cloud='local'))
+    job_id = jobs.launch(task, stream_logs=False)
+    assert job_id == 1
+    _wait_status(jobs, job_id, 'SUCCEEDED')
+    rows = jobs.queue()
+    assert rows[0]['job_name'] == 'mjob'
+    assert rows[0]['status'] == 'SUCCEEDED'
+    # The job cluster must have been cleaned up.
+    for rec in state.get_clusters():
+        assert 'controller' in rec['name']
+
+
+@pytest.mark.e2e
+def test_managed_job_recovery_on_preemption(local_jobs, skytpu_home):
+    from skypilot_tpu import jobs
+    task = Task('sleepy', run='sleep 6 && echo survived')
+    task.set_resources(Resources(cloud='local', use_spot=True))
+    job_id = jobs.launch(task, stream_logs=False)
+    _wait_status(jobs, job_id, 'RUNNING')
+
+    # Simulate a preemption: nuke the job cluster out-of-band (processes +
+    # provider metadata), exactly like the reference smoke tests terminate
+    # instances behind the controller's back.
+    pattern = os.path.join(skytpu_home, 'local_cloud',
+                           'skytpu-jobs-controller-*', 'host0', '.skytpu',
+                           'local_cloud', 'sleepy-*')
+    deadline = time.time() + 30
+    nested = []
+    while time.time() < deadline and not nested:
+        nested = glob.glob(pattern)
+        time.sleep(0.5)
+    assert nested, f'no nested job cluster dir matching {pattern}'
+    _kill_tree_and_remove(nested[0])
+
+    _wait_status(jobs, job_id, 'SUCCEEDED', timeout=120)
+    rows = [r for r in jobs.queue() if r['job_id'] == job_id]
+    assert rows[0]['recovery_count'] >= 1
+
+
+def _kill_tree_and_remove(cluster_dir):
+    import shutil
+
+    import psutil
+    me = psutil.Process()
+    protected = {me.pid}
+    for anc in me.parents():
+        protected.add(anc.pid)
+    for proc in psutil.process_iter(['pid', 'environ']):
+        try:
+            if proc.info['pid'] in protected:
+                continue
+            env = proc.info['environ'] or {}
+            if env.get('HOME', '').startswith(cluster_dir):
+                proc.kill()
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            continue
+    shutil.rmtree(cluster_dir, ignore_errors=True)
+
+
+@pytest.mark.e2e
+def test_managed_job_cancel(local_jobs):
+    from skypilot_tpu import jobs
+    task = Task('longjob', run='sleep 300')
+    task.set_resources(Resources(cloud='local'))
+    job_id = jobs.launch(task, stream_logs=False)
+    _wait_status(jobs, job_id, 'RUNNING')
+    cancelled = jobs.cancel(job_ids=[job_id])
+    assert cancelled == [job_id]
+    _wait_status(jobs, job_id, 'CANCELLED', timeout=60)
