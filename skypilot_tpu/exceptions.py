@@ -125,6 +125,10 @@ class ServeUserTerminatedError(SkyTpuError):
     """Service was terminated by the user mid-operation."""
 
 
+class ServeError(SkyTpuError):
+    """Serve plane operation failed."""
+
+
 class StorageError(SkyTpuError):
     """Base for storage subsystem errors."""
 

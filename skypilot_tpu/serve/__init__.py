@@ -1,0 +1,23 @@
+"""Serve plane: replica autoscaling + HTTP load balancing on TPU slices.
+
+Parity: sky/serve/__init__.py — up/update/down/status/tail_logs/
+terminate_replica + SkyTpuServiceSpec.
+"""
+from skypilot_tpu.serve.core import (controller_down, down, status,
+                                     tail_logs, terminate_replica, up,
+                                     update)
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+
+__all__ = [
+    'ReplicaStatus',
+    'ServiceStatus',
+    'SkyTpuServiceSpec',
+    'controller_down',
+    'down',
+    'status',
+    'tail_logs',
+    'terminate_replica',
+    'up',
+    'update',
+]

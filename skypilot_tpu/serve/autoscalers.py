@@ -1,0 +1,250 @@
+"""Autoscalers: turn request rates + replica state into scaling decisions.
+
+Parity: sky/serve/autoscalers.py — Autoscaler base (:57),
+RequestRateAutoscaler (:145: target = ceil(QPS / target_qps_per_replica)
+with upscale/downscale hysteresis windows :243), and
+FallbackRequestRateAutoscaler (:480: base on-demand replicas + dynamic
+fallback while spot replicas recover).
+
+Pure decision logic — no I/O — so the decision table is unit-testable
+exactly like the reference's tests/test_serve_autoscaler.py.
+"""
+import dataclasses
+import enum
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+
+
+class DecisionOperator(enum.Enum):
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    operator: DecisionOperator
+    # SCALE_UP: {'use_spot': bool}; SCALE_DOWN: {'replica_id': int}.
+    target: Dict[str, Any]
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """The slice of replica state the autoscaler needs."""
+    replica_id: int
+    status: ReplicaStatus
+    version: int
+    is_spot: bool
+
+    @property
+    def alive(self) -> bool:
+        """Counts toward capacity (launching or serving)."""
+        return not self.status.is_failed() and (
+            self.status != ReplicaStatus.SHUTTING_DOWN)
+
+
+class Autoscaler:
+    """Base: fixed replica count (spec.min_replicas)."""
+
+    def __init__(self, spec: SkyTpuServiceSpec):
+        self.spec = spec
+        self.latest_version = 1
+
+    @classmethod
+    def make(cls, spec: SkyTpuServiceSpec) -> 'Autoscaler':
+        if not spec.autoscaling_enabled:
+            return Autoscaler(spec)
+        if (spec.use_ondemand_fallback or
+                spec.base_ondemand_fallback_replicas > 0):
+            return FallbackRequestRateAutoscaler(spec)
+        return RequestRateAutoscaler(spec)
+
+    def update_spec(self, spec: SkyTpuServiceSpec, version: int) -> None:
+        self.spec = spec
+        self.latest_version = version
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        pass
+
+    def evaluate_scaling(
+            self, replicas: List[ReplicaView]) -> List[AutoscalerDecision]:
+        alive = [r for r in replicas if r.alive]
+        target = self.spec.min_replicas
+        decisions: List[AutoscalerDecision] = []
+        if len(alive) < target:
+            decisions.extend(
+                AutoscalerDecision(DecisionOperator.SCALE_UP,
+                                   {'use_spot': False})
+                for _ in range(target - len(alive)))
+        elif len(alive) > target:
+            for r in _scale_down_order(alive, self.latest_version):
+                if len(alive) - len(decisions) <= target:
+                    break
+                decisions.append(
+                    AutoscalerDecision(DecisionOperator.SCALE_DOWN,
+                                       {'replica_id': r.replica_id}))
+        # Old-version replicas beyond the target are replaced by the
+        # replica manager's rolling update, not by the autoscaler.
+        return decisions
+
+
+def _scale_down_order(replicas: List[ReplicaView],
+                      latest_version: int) -> List[ReplicaView]:
+    """Prefer terminating old versions, then unready, then newest-launched
+    (parity: sky/serve/autoscalers.py:285,317)."""
+
+    def key(r: ReplicaView):
+        return (
+            r.version >= latest_version,            # old versions first
+            r.status == ReplicaStatus.READY,        # unready before ready
+            -r.replica_id,                          # newest first
+        )
+
+    return sorted(replicas, key=key)
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """target = ceil(qps / target_qps_per_replica), clamped to
+    [min_replicas, max_replicas], applied only after the request rate has
+    stayed over/under the threshold for upscale/downscale delay seconds."""
+
+    def __init__(self, spec: SkyTpuServiceSpec):
+        super().__init__(spec)
+        self.request_timestamps: List[float] = []
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    # Test hook: timestamps are wall-clock; tests inject fake ones.
+    def _now(self) -> float:
+        return time.time()
+
+    def collect_request_information(
+            self, request_timestamps: List[float]) -> None:
+        self.request_timestamps.extend(request_timestamps)
+        cutoff = self._now() - constants.qps_window_seconds()
+        self.request_timestamps = [
+            t for t in self.request_timestamps if t > cutoff
+        ]
+
+    def current_qps(self) -> float:
+        return len(self.request_timestamps) / constants.qps_window_seconds()
+
+    def _raw_target(self) -> int:
+        assert self.spec.target_qps_per_replica is not None
+        target = math.ceil(
+            self.current_qps() / self.spec.target_qps_per_replica)
+        lo = self.spec.min_replicas
+        hi = self.spec.max_replicas
+        assert hi is not None
+        return max(lo, min(hi, target))
+
+    def _desired_with_hysteresis(self, num_alive: int) -> int:
+        """Move toward _raw_target only after the pressure has persisted."""
+        now = self._now()
+        raw = self._raw_target()
+        if raw > num_alive:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= self.spec.upscale_delay_seconds:
+                return raw
+            return num_alive
+        if raw < num_alive:
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if (now - self._downscale_since >=
+                    self.spec.downscale_delay_seconds):
+                return raw
+            return num_alive
+        self._upscale_since = None
+        self._downscale_since = None
+        return num_alive
+
+    def evaluate_scaling(
+            self, replicas: List[ReplicaView]) -> List[AutoscalerDecision]:
+        alive = [r for r in replicas if r.alive]
+        # Below min_replicas is never subject to hysteresis: replace
+        # failed/preempted replicas immediately.
+        if len(alive) < self.spec.min_replicas:
+            return [
+                AutoscalerDecision(DecisionOperator.SCALE_UP,
+                                   {'use_spot': False})
+                for _ in range(self.spec.min_replicas - len(alive))
+            ]
+        desired = self._desired_with_hysteresis(len(alive))
+        if desired > len(alive):
+            self._upscale_since = None
+            return [
+                AutoscalerDecision(DecisionOperator.SCALE_UP,
+                                   {'use_spot': False})
+                for _ in range(desired - len(alive))
+            ]
+        if desired < len(alive):
+            self._downscale_since = None
+            n_down = len(alive) - desired
+            order = _scale_down_order(alive, self.latest_version)
+            return [
+                AutoscalerDecision(DecisionOperator.SCALE_DOWN,
+                                   {'replica_id': r.replica_id})
+                for r in order[:n_down]
+            ]
+        return []
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot replicas carry the request-rate target; a fixed base of
+    on-demand replicas (base_ondemand_fallback_replicas) always runs, and
+    while spot replicas are recovering from preemption, extra on-demand
+    fallbacks fill the gap (dynamic fallback)."""
+
+    def evaluate_scaling(
+            self, replicas: List[ReplicaView]) -> List[AutoscalerDecision]:
+        alive = [r for r in replicas if r.alive]
+        spot = [r for r in alive if r.is_spot]
+        ondemand = [r for r in alive if not r.is_spot]
+        decisions: List[AutoscalerDecision] = []
+
+        # Spot fleet follows the request rate (hysteresis as in the base).
+        if len(spot) < self.spec.min_replicas:
+            desired_spot = self.spec.min_replicas
+        else:
+            desired_spot = self._desired_with_hysteresis(len(spot))
+        if desired_spot > len(spot):
+            self._upscale_since = None
+            decisions.extend(
+                AutoscalerDecision(DecisionOperator.SCALE_UP,
+                                   {'use_spot': True})
+                for _ in range(desired_spot - len(spot)))
+        elif desired_spot < len(spot):
+            self._downscale_since = None
+            order = _scale_down_order(spot, self.latest_version)
+            decisions.extend(
+                AutoscalerDecision(DecisionOperator.SCALE_DOWN,
+                                   {'replica_id': r.replica_id})
+                for r in order[:len(spot) - desired_spot])
+
+        # On-demand: base + dynamic fallback for not-yet-READY spot.
+        base = self.spec.base_ondemand_fallback_replicas
+        desired_ondemand = base
+        if self.spec.use_ondemand_fallback:
+            spot_ready = sum(
+                1 for r in spot if r.status == ReplicaStatus.READY)
+            desired_ondemand = base + max(0, desired_spot - spot_ready)
+        if desired_ondemand > len(ondemand):
+            decisions.extend(
+                AutoscalerDecision(DecisionOperator.SCALE_UP,
+                                   {'use_spot': False})
+                for _ in range(desired_ondemand - len(ondemand)))
+        elif desired_ondemand < len(ondemand):
+            order = _scale_down_order(ondemand, self.latest_version)
+            decisions.extend(
+                AutoscalerDecision(DecisionOperator.SCALE_DOWN,
+                                   {'replica_id': r.replica_id})
+                for r in order[:len(ondemand) - desired_ondemand])
+        return decisions

@@ -1,0 +1,61 @@
+"""Serve plane constants (parity: sky/serve/constants.py).
+
+Every interval has an env knob so e2e tests on the local cloud can run the
+whole control loop in seconds instead of minutes.
+"""
+import os
+
+
+def _f(env: str, default: float) -> float:
+    return float(os.environ.get(env, default))
+
+
+# Controller-host directory layout (HOME-relative: same code runs on real
+# controller VMs and simulated local hosts).
+SERVE_DIR = '~/.skytpu/serve'
+SIGNAL_DIR = '~/.skytpu/serve/signals'
+
+# Port ranges on the controller host.  Each service gets one controller
+# port (autoscaler/replica-manager HTTP API) and one load-balancer port
+# (user traffic).  Parity: sky/serve/constants.py CONTROLLER_PORT_START /
+# LOAD_BALANCER_PORT_START.
+CONTROLLER_PORT_START = 20001
+LOAD_BALANCER_PORT_START = 30001
+
+# Default replica port when the service spec does not give one.
+DEFAULT_REPLICA_PORT = 8080
+
+# Loop intervals (seconds).
+def autoscaler_interval() -> float:
+    return _f('SKYTPU_SERVE_AUTOSCALER_INTERVAL', 20.0)
+
+
+def probe_interval() -> float:
+    return _f('SKYTPU_SERVE_PROBE_INTERVAL', 10.0)
+
+
+def lb_sync_interval() -> float:
+    return _f('SKYTPU_SERVE_LB_SYNC_INTERVAL', 20.0)
+
+
+def job_status_interval() -> float:
+    return _f('SKYTPU_SERVE_JOB_STATUS_INTERVAL', 30.0)
+
+
+def readiness_timeout() -> float:
+    return _f('SKYTPU_SERVE_READINESS_TIMEOUT', 15.0)
+
+
+# Consecutive probe failures after a replica has been READY before we mark
+# it NOT_READY and replace it.
+PROBE_FAILURE_THRESHOLD = 3
+
+# How long `serve up` waits for the service record to appear / endpoint to
+# come up before returning.
+def up_wait_timeout() -> float:
+    return _f('SKYTPU_SERVE_UP_TIMEOUT', 300.0)
+
+
+# QPS window the autoscaler evaluates over.
+def qps_window_seconds() -> float:
+    return _f('SKYTPU_SERVE_QPS_WINDOW', 60.0)

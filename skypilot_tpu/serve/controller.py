@@ -1,0 +1,201 @@
+"""Per-service controller: autoscaler loop + HTTP API for the LB.
+
+Parity: sky/serve/controller.py — a FastAPI app with
+/controller/load_balancer_sync (:100), /controller/update_service (:116),
+/controller/terminate_replica (:162) and the autoscaler thread (:64).
+Ours is a stdlib ThreadingHTTPServer (no FastAPI on TPU hosts) plus a
+deterministic `run_once` tick so tests can drive the control loop.
+"""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from skypilot_tpu import logsys
+from skypilot_tpu.serve import autoscalers, constants, serve_state
+from skypilot_tpu.serve.autoscalers import DecisionOperator
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+
+logger = logsys.init_logger(__name__)
+
+
+class ServeController:
+    """One per service; owns the autoscaler + replica manager."""
+
+    def __init__(self, service_name: str, spec: SkyTpuServiceSpec,
+                 task_yaml: str, port: int):
+        self.service_name = service_name
+        self.spec = spec
+        self.port = port
+        self.version = 1
+        self.autoscaler = autoscalers.Autoscaler.make(spec)
+        self.replica_manager = ReplicaManager(service_name, spec, task_yaml)
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._last_probe = 0.0
+        self._last_cluster_check = 0.0
+
+    # ----------------------------------------------------------- HTTP API
+
+    def _handle(self, path: str, payload: dict) -> dict:
+        if path == '/controller/load_balancer_sync':
+            ts: List[float] = payload.get('request_timestamps', [])
+            self.autoscaler.collect_request_information(ts)
+            return {
+                'ready_replica_urls':
+                    serve_state.ready_replica_endpoints(self.service_name)
+            }
+        if path == '/controller/update_service':
+            spec = SkyTpuServiceSpec.from_json(payload['spec'])
+            task_yaml = payload['task_yaml']
+            serve_state.set_service_spec(self.service_name, spec.to_json(),
+                                         task_yaml)
+            svc = serve_state.get_service(self.service_name)
+            self.version = svc['version']
+            self.spec = spec
+            # Re-make the autoscaler: the update may switch between fixed
+            # and request-rate scaling.  Carry the QPS window over so an
+            # in-place spec tweak does not forget the current load.
+            new_autoscaler = autoscalers.Autoscaler.make(spec)
+            if (isinstance(new_autoscaler,
+                           autoscalers.RequestRateAutoscaler) and
+                    isinstance(self.autoscaler,
+                               autoscalers.RequestRateAutoscaler)):
+                new_autoscaler.request_timestamps = list(
+                    self.autoscaler.request_timestamps)
+            new_autoscaler.latest_version = self.version
+            self.autoscaler = new_autoscaler
+            self.replica_manager.update_version(spec, task_yaml,
+                                                self.version)
+            logger.info('[%s] updated to version %d', self.service_name,
+                        self.version)
+            return {'version': self.version}
+        if path == '/controller/terminate_replica':
+            rid = int(payload['replica_id'])
+            self.replica_manager.scale_down(rid,
+                                            purge=payload.get('purge', True))
+            return {'terminated': rid}
+        raise KeyError(path)
+
+    def _serve_http(self) -> None:
+        controller = self
+
+        class Handler(BaseHTTPRequestHandler):
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get('Content-Length', 0))
+                try:
+                    payload = json.loads(
+                        self.rfile.read(length) or b'{}')
+                    result = controller._handle(self.path, payload)
+                    body = json.dumps(result).encode()
+                    self.send_response(200)
+                except KeyError:
+                    body = b'{"error": "not found"}'
+                    self.send_response(404)
+                except Exception as e:  # pylint: disable=broad-except
+                    body = json.dumps({'error': str(e)}).encode()
+                    self.send_response(500)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(('0.0.0.0', self.port), Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.serve_forever(poll_interval=0.2)
+
+    # ------------------------------------------------------- control loop
+
+    def run_once(self) -> None:
+        """One control tick: probe, reconcile clusters, autoscale."""
+        now = time.time()
+        if now - self._last_probe >= constants.probe_interval():
+            self._last_probe = now
+            self.replica_manager.probe_all()
+        if (now - self._last_cluster_check >=
+                constants.job_status_interval()):
+            self._last_cluster_check = now
+            self.replica_manager.check_replica_clusters()
+
+        replicas = [
+            autoscalers.ReplicaView(
+                replica_id=r['replica_id'],
+                status=ReplicaStatus(r['status']),
+                version=r['version'],
+                is_spot=bool(r['is_spot']),
+            ) for r in serve_state.get_replicas(self.service_name)
+        ]
+        for decision in self.autoscaler.evaluate_scaling(replicas):
+            if decision.operator == DecisionOperator.SCALE_UP:
+                self.replica_manager.scale_up(
+                    use_spot=decision.target.get('use_spot', False))
+            else:
+                self.replica_manager.scale_down(
+                    decision.target['replica_id'])
+
+        self._rolling_update(replicas)
+        self._refresh_service_status(replicas)
+
+    def _rolling_update(
+            self, replicas: List[autoscalers.ReplicaView]) -> None:
+        """Replace old-version replicas once enough latest-version replicas
+        are READY (parity: rolling UpdateMode,
+        replica_managers.py:1176)."""
+        old = [r for r in replicas if r.version < self.version and r.alive]
+        if not old:
+            return
+        latest_ready = sum(
+            1 for r in replicas if r.version >= self.version and
+            r.status == ReplicaStatus.READY)
+        latest_alive = sum(
+            1 for r in replicas if r.version >= self.version and r.alive)
+        # Launch replacements first, then drain old ones.
+        if latest_alive < self.spec.min_replicas:
+            for _ in range(self.spec.min_replicas - latest_alive):
+                self.replica_manager.scale_up()
+        if latest_ready >= self.spec.min_replicas:
+            for r in old:
+                self.replica_manager.scale_down(r.replica_id)
+
+    def _refresh_service_status(
+            self, replicas: List[autoscalers.ReplicaView]) -> None:
+        svc = serve_state.get_service(self.service_name)
+        if svc is None or svc['status'] == (
+                ServiceStatus.SHUTTING_DOWN.value):
+            return
+        status = ServiceStatus.from_replica_statuses(
+            [r.status for r in replicas])
+        # FAILED only counts while we cannot serve at all; a failed record
+        # next to READY replicas is degraded-but-serving.
+        if (status == ServiceStatus.FAILED and
+                any(not r.status.is_failed() for r in replicas)):
+            status = ServiceStatus.REPLICA_INIT
+        if svc['status'] != status.value:
+            serve_state.set_service_status(self.service_name, status)
+
+    def run(self) -> None:
+        http_thread = threading.Thread(target=self._serve_http,
+                                       daemon=True,
+                                       name=f'http-{self.service_name}')
+        http_thread.start()
+        logger.info('[%s] controller listening on :%d', self.service_name,
+                    self.port)
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.error('[%s] control tick error: %s',
+                             self.service_name, e, exc_info=True)
+            self._stop.wait(constants.autoscaler_interval())
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()

@@ -1,0 +1,264 @@
+"""Serve SDK: up / update / down / status / terminate_replica / tail_logs.
+
+Parity: sky/serve/core.py — `up` (:95) validates the service YAML,
+launches or reuses the per-user serve controller cluster, submits one
+service job per service, and waits for the endpoint; the other calls are
+RPC-by-codegen to the controller host.
+"""
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions, execution, logsys, state
+from skypilot_tpu.backends import SliceBackend
+from skypilot_tpu.serve import constants, serve_utils
+from skypilot_tpu.serve.load_balancing_policies import DEFAULT_POLICY
+from skypilot_tpu.serve.serve_utils import ServeCodeGen
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import controller_utils, ux
+
+logger = logsys.init_logger(__name__)
+
+
+def _controller_handle(refresh: bool = False):
+    name = controller_utils.controller_cluster_name(
+        controller_utils.SERVE_CONTROLLER)
+    if refresh:
+        from skypilot_tpu import backend_utils
+        record = backend_utils.refresh_cluster_record(name)
+    else:
+        record = state.get_cluster_from_name(name)
+    return record['handle'] if record else None
+
+
+def _head(required: bool = True):
+    handle = _controller_handle()
+    if handle is None:
+        if required:
+            raise exceptions.ClusterNotUpError(
+                'No serve controller cluster found; is any service up?')
+        return None
+    return handle.head_runner()
+
+
+def _dump_task_yaml(task: Task) -> str:
+    import yaml
+    fd, path = tempfile.mkstemp(prefix='skytpu-serve-', suffix='.yaml')
+    os.close(fd)
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(task.to_yaml_config(), f, default_flow_style=False)
+    return path
+
+
+def _validate_service_task(task: Task) -> SkyTpuServiceSpec:
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task must have a `service:` section for `serve.up`.')
+    if task.run is None:
+        raise exceptions.InvalidTaskError(
+            'Service tasks require a run command.')
+    return task.service
+
+
+def up(task: Task,
+       service_name: Optional[str] = None,
+       *,
+       policy: Optional[str] = None) -> Tuple[str, str]:
+    """Bring a service up; returns (service_name, endpoint URL)."""
+    spec = _validate_service_task(task)
+    if policy is None:
+        policy = spec.load_balancing_policy or DEFAULT_POLICY
+    if service_name is None:
+        service_name = serve_utils.generate_service_name(task.name)
+    serve_utils.validate_service_name(service_name)
+    # Duplicate-name check up front: the service job on the controller
+    # would crash while wait_service_registration happily found the OLD
+    # service's row and reported its endpoint as ours.
+    if _controller_handle() is not None and any(
+            s['name'] == service_name for s in status([service_name])):
+        raise exceptions.ServeError(
+            f'Service {service_name!r} already exists; use '
+            f'serve.update() or pick another name.')
+
+    local_yaml = _dump_task_yaml(task)
+    remote_yaml = f'~/.skytpu/serve/tasks/{service_name}.yaml'
+    task_resources = list(task.resources)
+    controller_task = Task(
+        name=f'serve-{service_name}',
+        setup=controller_utils.controller_setup_commands(),
+        run=(f'{controller_utils.CONTROLLER_ENV_PREFIX}'
+             f'python3 -u -m skypilot_tpu.serve.service '
+             f'--service-name {service_name} --task-yaml {remote_yaml} '
+             f'--policy {policy}'),
+        envs=_controller_envs(),
+    )
+    controller_task.set_file_mounts({
+        remote_yaml: local_yaml,
+        **controller_utils.credential_file_mounts(),
+    })
+    controller_task.set_resources(
+        controller_utils.controller_resources(
+            controller_utils.SERVE_CONTROLLER, task_resources))
+
+    controller_name = controller_utils.controller_cluster_name(
+        controller_utils.SERVE_CONTROLLER)
+    logger.info('%s Launching service %r on controller %r.',
+                ux.emph('[serve]'), service_name, controller_name)
+    try:
+        execution.launch(controller_task, cluster_name=controller_name,
+                         detach_run=True, stream_logs=False, fast=True)
+    finally:
+        os.remove(local_yaml)
+
+    # Wait for the service process to register itself, then report the
+    # endpoint (controller head IP + LB port).
+    handle = _controller_handle()
+    head = handle.head_runner()
+    cmd = ServeCodeGen.wait_service_registration(
+        service_name, constants.up_wait_timeout())
+    rc, stdout, stderr = head.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve up', stderr[-800:])
+    result = serve_utils.parse_result(stdout)
+    if 'error' in result:
+        raise exceptions.ServeError(
+            f'Service {service_name!r} failed to start: {result["error"]}. '
+            f'Check `serve.tail_logs({service_name!r})`.')
+    info = handle.cluster_info()
+    ip = info.head.external_ip or info.head.internal_ip
+    endpoint = f'http://{ip}:{result["load_balancer_port"]}'
+    logger.info('%s Service %r registered; endpoint: %s', ux.ok('[serve]'),
+                service_name, endpoint)
+    return service_name, endpoint
+
+
+def _controller_envs() -> Dict[str, str]:
+    envs = {}
+    for key in os.environ:
+        if key.startswith('SKYTPU_SERVE_'):
+            envs[key] = os.environ[key]
+    return envs
+
+
+def update(task: Task, service_name: str) -> int:
+    """Rolling update to a new task/spec; returns the new version."""
+    spec = _validate_service_task(task)
+    local_yaml = _dump_task_yaml(task)
+    remote_yaml = (f'~/.skytpu/serve/tasks/{service_name}-'
+                   f'v{int(time.time())}.yaml')
+    handle = _controller_handle()
+    if handle is None:
+        raise exceptions.ClusterNotUpError(
+            'No serve controller cluster found.')
+    head = handle.head_runner()
+    try:
+        head.rsync(local_yaml, remote_yaml, up=True)
+    finally:
+        os.remove(local_yaml)
+    cmd = ServeCodeGen.update_service(service_name, spec.to_json(),
+                                      remote_yaml)
+    rc, stdout, stderr = head.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve update', stderr[-800:])
+    result = serve_utils.parse_result(stdout)
+    if 'error' in result:
+        raise exceptions.ServeError(result['error'])
+    logger.info('%s Service %r updating to version %d.', ux.ok('[serve]'),
+                service_name, result['version'])
+    return result['version']
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    """Service records (with replica details and endpoint)."""
+    head = _head(required=False)
+    if head is None:
+        return []
+    rc, stdout, stderr = head.run(ServeCodeGen.get_service_status(),
+                                  require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve status', stderr[-800:])
+    services = serve_utils.parse_result(stdout)
+    handle = _controller_handle()
+    info = handle.cluster_info()
+    ip = info.head.external_ip or info.head.internal_ip
+    for svc in services:
+        svc['endpoint'] = f'http://{ip}:{svc["load_balancer_port"]}'
+    if service_names is not None:
+        services = [s for s in services if s['name'] in service_names]
+    return services
+
+
+def down(service_names: Optional[List[str]] = None,
+         all_services: bool = False,
+         purge: bool = False) -> List[str]:
+    """Terminate services (their replicas tear down asynchronously)."""
+    if service_names is None and not all_services:
+        raise ValueError('Specify service_names or all_services=True.')
+    head = _head()
+    cmd = ServeCodeGen.terminate_services(
+        None if all_services else service_names, purge=purge)
+    rc, stdout, stderr = head.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve down', stderr[-800:])
+    terminated = serve_utils.parse_result(stdout)['terminated']
+    logger.info('%s Terminating service(s): %s', ux.emph('[serve]'),
+                ', '.join(terminated) or '(none)')
+    return terminated
+
+
+def terminate_replica(service_name: str, replica_id: int,
+                      purge: bool = False) -> None:
+    head = _head()
+    cmd = ServeCodeGen.terminate_replica(service_name, replica_id, purge)
+    rc, stdout, stderr = head.run(cmd, require_outputs=True)
+    if rc != 0:
+        raise exceptions.CommandError(rc, 'serve terminate-replica',
+                                      stderr[-800:])
+    result = serve_utils.parse_result(stdout)
+    if 'error' in result:
+        raise exceptions.ServeError(result['error'])
+
+
+def tail_logs(service_name: str,
+              *,
+              target: str = 'controller',
+              replica_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    """Stream logs: the service process ('controller') or one replica."""
+    handle = _controller_handle()
+    if handle is None:
+        raise exceptions.ClusterNotUpError(
+            'No serve controller cluster found.')
+    head = handle.head_runner()
+    if replica_id is not None or target == 'replica':
+        if replica_id is None:
+            raise ValueError('replica target needs replica_id')
+        cmd = ServeCodeGen.stream_replica_logs(service_name, replica_id,
+                                               follow)
+        return int(head.run(cmd, stream_logs=True, log_path='/dev/null'))
+    # Controller/LB logs = the service job's log on the controller cluster.
+    from skypilot_tpu import core as core_lib
+    jobs = core_lib.queue(
+        controller_utils.controller_cluster_name(
+            controller_utils.SERVE_CONTROLLER))
+    for job in jobs:
+        if job.get('job_name') == f'serve-{service_name}':
+            return core_lib.tail_logs(
+                controller_utils.controller_cluster_name(
+                    controller_utils.SERVE_CONTROLLER),
+                job_id=job['job_id'], follow=follow)
+    raise exceptions.ServeError(
+        f'No service job found for {service_name!r}.')
+
+
+def controller_down(purge: bool = False) -> None:
+    """Tear down the per-user serve controller cluster."""
+    name = controller_utils.controller_cluster_name(
+        controller_utils.SERVE_CONTROLLER)
+    record = state.get_cluster_from_name(name)
+    if record is None:
+        return
+    SliceBackend().teardown(record['handle'], terminate=True, purge=purge)

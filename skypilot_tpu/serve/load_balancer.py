@@ -1,0 +1,182 @@
+"""HTTP load balancer: streams user traffic to ready replicas.
+
+Parity: sky/serve/load_balancer.py:22-229 (FastAPI/httpx reverse proxy
+with controller sync + retry across replicas).  Built on stdlib
+ThreadingHTTPServer + http.client so replica responses stream through in
+chunks (LLM serving needs streaming) without extra dependencies.
+"""
+import json
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from skypilot_tpu import logsys
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+
+logger = logsys.init_logger(__name__)
+
+_HOP_BY_HOP = {
+    'connection', 'keep-alive', 'proxy-authenticate',
+    'proxy-authorization', 'te', 'trailers', 'transfer-encoding', 'upgrade'
+}
+_MAX_ATTEMPTS = 3
+
+
+class SkyTpuLoadBalancer:
+
+    def __init__(self, controller_url: str, port: int,
+                 policy: LoadBalancingPolicy):
+        self.controller_url = controller_url
+        self.port = port
+        self.policy = policy
+        self._request_timestamps: List[float] = []
+        self._ts_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------ controller sync
+
+    def _sync_with_controller_once(self) -> None:
+        with self._ts_lock:
+            timestamps, self._request_timestamps = (
+                self._request_timestamps, [])
+        body = json.dumps({'request_timestamps': timestamps}).encode()
+        req = urllib.request.Request(
+            self.controller_url + '/controller/load_balancer_sync',
+            data=body, headers={'Content-Type': 'application/json'})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                payload = json.loads(r.read())
+            self.policy.set_ready_replicas(
+                payload.get('ready_replica_urls', []))
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('LB sync with controller failed: %s', e)
+            # Keep serving the last known replica set.
+
+    def _sync_loop(self) -> None:
+        while not self._stop.is_set():
+            self._sync_with_controller_once()
+            self._stop.wait(constants.lb_sync_interval())
+
+    # --------------------------------------------------------- proxy path
+
+    def _record_request(self) -> None:
+        with self._ts_lock:
+            self._request_timestamps.append(time.time())
+
+    def _proxy_once(self, handler: BaseHTTPRequestHandler, replica: str,
+                    body: Optional[bytes]) -> bool:
+        """Stream one request to one replica. Returns False if the replica
+        could not be reached (retryable); True once any response line has
+        been forwarded (after which errors are no longer retryable)."""
+        parsed = urllib.parse.urlsplit(replica)
+        conn = HTTPConnection(parsed.hostname, parsed.port, timeout=120)
+        headers = {
+            k: v for k, v in handler.headers.items()
+            if k.lower() not in _HOP_BY_HOP and k.lower() != 'host'
+        }
+        headers['Host'] = parsed.netloc
+        headers['Connection'] = 'close'
+        try:
+            conn.request(handler.command, handler.path, body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+        except (OSError, socket.timeout):
+            conn.close()
+            return False
+        try:
+            handler.send_response(resp.status, resp.reason)
+            has_length = False
+            for k, v in resp.getheaders():
+                if k.lower() not in _HOP_BY_HOP:
+                    handler.send_header(k, v)
+                    has_length |= k.lower() == 'content-length'
+            if not has_length:
+                # Chunked replica response: http.client de-chunks on read,
+                # so the body goes out raw — close-delimited framing is the
+                # only way the client can find the end of it.
+                handler.send_header('Connection', 'close')
+                handler.close_connection = True
+            handler.end_headers()
+            while True:
+                chunk = resp.read(64 * 1024)
+                if not chunk:
+                    break
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+        except (OSError, socket.timeout) as e:
+            logger.warning('LB: client/replica stream broke mid-response: '
+                           '%s', e)
+        finally:
+            conn.close()
+        return True
+
+    def handle_request(self, handler: BaseHTTPRequestHandler) -> None:
+        self._record_request()
+        length = int(handler.headers.get('Content-Length', 0) or 0)
+        body = handler.rfile.read(length) if length else None
+        tried = set()
+        for _ in range(_MAX_ATTEMPTS):
+            replica = self.policy.select_replica()
+            if replica is None or replica in tried:
+                break
+            tried.add(replica)
+            try:
+                if self._proxy_once(handler, replica, body):
+                    return
+                logger.warning('LB: replica %s unreachable, retrying',
+                               replica)
+            finally:
+                self.policy.request_done(replica)
+        handler.send_response(503)
+        msg = b'{"error": "no ready replicas"}'
+        handler.send_header('Content-Type', 'application/json')
+        handler.send_header('Content-Length', str(len(msg)))
+        handler.end_headers()
+        handler.wfile.write(msg)
+
+    # -------------------------------------------------------------- server
+
+    def run(self) -> None:
+        lb = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *args):
+                pass
+
+            def _any(self):
+                try:
+                    lb.handle_request(self)
+                except (OSError, socket.timeout):
+                    pass
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _any
+            do_HEAD = do_OPTIONS = _any
+
+        sync_thread = threading.Thread(target=self._sync_loop, daemon=True,
+                                       name='lb-sync')
+        sync_thread.start()
+        self._httpd = ThreadingHTTPServer(('0.0.0.0', self.port), Handler)
+        self._httpd.daemon_threads = True
+        logger.info('Load balancer listening on :%d -> controller %s',
+                    self.port, self.controller_url)
+        self._httpd.serve_forever(poll_interval=0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+
+def run_load_balancer(controller_url: str, port: int,
+                      policy_name: str) -> None:
+    policy = LoadBalancingPolicy.make(policy_name)
+    SkyTpuLoadBalancer(controller_url, port, policy).run()

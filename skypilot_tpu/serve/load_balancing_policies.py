@@ -1,0 +1,103 @@
+"""Load-balancing policies.
+
+Parity: sky/serve/load_balancing_policies.py:22,47 — pluggable policy with
+a ready-replica set pushed from the controller sync; we also ship a
+least-outstanding-requests policy (the reference only has round-robin).
+"""
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+    """Tracks ready replicas and picks one per request."""
+
+    NAME = 'base'
+    _REGISTRY: Dict[str, type] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        LoadBalancingPolicy._REGISTRY[cls.NAME] = cls
+
+    @classmethod
+    def make(cls, name: str) -> 'LoadBalancingPolicy':
+        try:
+            return cls._REGISTRY[name]()
+        except KeyError:
+            raise ValueError(
+                f'Unknown load balancing policy {name!r}; '
+                f'available: {sorted(cls._REGISTRY)}') from None
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready_replicas: List[str] = []
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            if set(replicas) != set(self.ready_replicas):
+                self._on_replica_change(replicas)
+            self.ready_replicas = list(replicas)
+
+    def _on_replica_change(self, replicas: List[str]) -> None:
+        pass
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def request_done(self, replica: str) -> None:
+        """Called when a proxied request finishes (success or not)."""
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    """Parity: sky/serve/load_balancing_policies.py:47."""
+
+    NAME = 'round_robin'
+
+    def __init__(self):
+        super().__init__()
+        self._index = 0
+
+    def _on_replica_change(self, replicas: List[str]) -> None:
+        self._index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = self.ready_replicas[self._index %
+                                          len(self.ready_replicas)]
+            self._index += 1
+            return replica
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Pick the replica with the fewest outstanding proxied requests."""
+
+    NAME = 'least_load'
+
+    def __init__(self):
+        super().__init__()
+        self._outstanding: Dict[str, int] = {}
+
+    def _on_replica_change(self, replicas: List[str]) -> None:
+        self._outstanding = {
+            r: self._outstanding.get(r, 0) for r in replicas
+        }
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = min(self.ready_replicas,
+                          key=lambda r: self._outstanding.get(r, 0))
+            self._outstanding[replica] = (
+                self._outstanding.get(replica, 0) + 1)
+            return replica
+
+    def request_done(self, replica: str) -> None:
+        with self._lock:
+            if replica in self._outstanding:
+                self._outstanding[replica] = max(
+                    0, self._outstanding[replica] - 1)
+
+
+DEFAULT_POLICY = RoundRobinPolicy.NAME

@@ -1,0 +1,289 @@
+"""Replica manager: launches, probes, and terminates replica clusters.
+
+Parity: sky/serve/replica_managers.py — SkyPilotReplicaManager (:610) with
+scale_up → recursive `launch()` (:58), scale_down → cluster teardown
+(:140), and the three daemon loops (process-pool refresher :951, job
+status fetcher :967, readiness prober :1030 with consecutive-failure
+counting :493) folded into the controller's tick (run_once) so the control
+flow is deterministic and testable.
+"""
+import concurrent.futures
+import json
+import os
+import time
+import traceback
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from skypilot_tpu import logsys
+from skypilot_tpu.serve import constants, serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+from skypilot_tpu.task import Task
+
+logger = logsys.init_logger(__name__)
+
+
+def replica_cluster_name(service_name: str, replica_id: int) -> str:
+    return f'{service_name}-{replica_id}'
+
+
+class ReplicaManager:
+    """Owns every replica of one service (runs on the controller host)."""
+
+    def __init__(self, service_name: str, spec: SkyTpuServiceSpec,
+                 task_yaml: str, version: int = 1):
+        self.service_name = service_name
+        self.spec = spec
+        self.task_yaml = task_yaml
+        self.version = version
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f'replica-{service_name}')
+        self._inflight: Dict[int, concurrent.futures.Future] = {}
+
+    def update_version(self, spec: SkyTpuServiceSpec, task_yaml: str,
+                       version: int) -> None:
+        self.spec = spec
+        self.task_yaml = task_yaml
+        self.version = version
+
+    # ------------------------------------------------------------- scaling
+
+    def scale_up(self, use_spot: bool = False) -> int:
+        rid = serve_state.next_replica_id(self.service_name)
+        cluster = replica_cluster_name(self.service_name, rid)
+        serve_state.add_replica(self.service_name, rid, self.version,
+                                cluster, use_spot)
+        self._inflight[rid] = self._pool.submit(self._launch_replica, rid,
+                                                cluster, use_spot)
+        logger.info('[%s] scale_up -> replica %d (%s, spot=%s)',
+                    self.service_name, rid, cluster, use_spot)
+        return rid
+
+    def scale_down(self, replica_id: int, purge: bool = True,
+                   final_status: Optional[ReplicaStatus] = None) -> None:
+        """Tear the replica cluster down.  With purge=True the record is
+        removed; otherwise it is kept and left in ``final_status`` (a
+        failed status) so `serve status` shows why the replica died."""
+        rec = serve_state.get_replica(self.service_name, replica_id)
+        if rec is None or rec['status'] == (
+                ReplicaStatus.SHUTTING_DOWN.value):
+            return
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.SHUTTING_DOWN)
+        self._inflight[replica_id] = self._pool.submit(
+            self._terminate_replica, replica_id, rec['cluster_name'], purge,
+            final_status)
+        logger.info('[%s] scale_down replica %d', self.service_name,
+                    replica_id)
+
+    def _replica_port(self, replica_id: int, cloud: Optional[str]) -> int:
+        # On the local provider every replica shares 127.0.0.1, so ports
+        # must be unique per replica; real clouds give unique IPs.
+        if cloud == 'local':
+            return self.spec.port + replica_id
+        return self.spec.port
+
+    def _build_replica_task(self, replica_id: int, use_spot: bool) -> Task:
+        import yaml
+        with open(os.path.expanduser(self.task_yaml),
+                  encoding='utf-8') as f:
+            cfg = yaml.safe_load(f)
+        cfg.pop('service', None)
+        task = Task.from_yaml_config(cfg)
+        resources = list(task.resources)
+        if use_spot:
+            task.set_resources([r.copy(use_spot=True) for r in resources])
+        cloud = resources[0].cloud if resources else None
+        port = self._replica_port(replica_id, cloud)
+        task.update_envs({
+            'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
+            'SKYTPU_SERVE_REPLICA_PORT': str(port),
+        })
+        return task
+
+    def _launch_replica(self, replica_id: int, cluster: str,
+                        use_spot: bool) -> None:
+        from skypilot_tpu import execution, state
+        try:
+            task = self._build_replica_task(replica_id, use_spot)
+            execution.launch(task, cluster_name=cluster, detach_run=True,
+                             stream_logs=False, retry_until_up=False)
+            record = state.get_cluster_from_name(cluster)
+            assert record is not None, cluster
+            info = record['handle'].cluster_info()
+            resources = list(task.resources)
+            cloud = resources[0].cloud if resources else None
+            port = self._replica_port(replica_id, cloud)
+            ip = info.head.external_ip or info.head.internal_ip
+            serve_state.set_replica_endpoint(self.service_name, replica_id,
+                                            f'http://{ip}:{port}')
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.STARTING)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error('[%s] replica %d launch failed: %s',
+                         self.service_name, replica_id, e)
+            logger.debug('%s', traceback.format_exc())
+            serve_state.set_replica_status(
+                self.service_name, replica_id,
+                ReplicaStatus.FAILED_PROVISION, str(e))
+
+    def _terminate_replica(self, replica_id: int, cluster: str,
+                           purge: bool,
+                           final_status: Optional[ReplicaStatus] = None
+                           ) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(cluster, purge=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('[%s] teardown of %s: %s', self.service_name,
+                           cluster, e)
+        if purge:
+            serve_state.remove_replica(self.service_name, replica_id)
+        elif final_status is not None:
+            # Restore the failure status that triggered the teardown (the
+            # failure_reason column was set before scale_down and is kept).
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           final_status)
+
+    def terminate_all(self) -> None:
+        """Service teardown: bring down every replica cluster."""
+        for rec in serve_state.get_replicas(self.service_name):
+            if rec['status'] != ReplicaStatus.SHUTTING_DOWN.value:
+                self.scale_down(rec['replica_id'], purge=True)
+        self._pool.shutdown(wait=True)
+
+    def busy(self) -> bool:
+        self._reap()
+        return bool(self._inflight)
+
+    def _reap(self) -> None:
+        done = [rid for rid, f in self._inflight.items() if f.done()]
+        for rid in done:
+            self._inflight.pop(rid)
+
+    # ------------------------------------------------------------- probing
+
+    def probe_replica(self, rec: dict) -> bool:
+        """One readiness probe; returns probe success."""
+        endpoint = rec.get('endpoint')
+        if not endpoint:
+            return False
+        url = endpoint + self.spec.readiness_path
+        data = None
+        headers = dict(self.spec.readiness_headers or {})
+        if self.spec.post_data is not None:
+            data = (self.spec.post_data if isinstance(
+                self.spec.post_data, (bytes, str)) else json.dumps(
+                    self.spec.post_data))
+            if isinstance(data, str):
+                data = data.encode()
+            headers.setdefault('Content-Type', 'application/json')
+        req = urllib.request.Request(url, data=data, headers=headers)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.spec.readiness_timeout_seconds) as r:
+                return 200 <= r.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def probe_all(self) -> None:
+        """Probe STARTING/READY/NOT_READY replicas, advance their status.
+
+        Parity: the _replica_prober loop (replica_managers.py:1030).
+        """
+        now = time.time()
+        for rec in serve_state.get_replicas(self.service_name):
+            status = ReplicaStatus(rec['status'])
+            if status not in (ReplicaStatus.STARTING, ReplicaStatus.READY,
+                              ReplicaStatus.NOT_READY):
+                continue
+            ok = self.probe_replica(rec)
+            rid = rec['replica_id']
+            if ok:
+                if status != ReplicaStatus.READY:
+                    logger.info('[%s] replica %d is READY',
+                                self.service_name, rid)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.READY)
+                continue
+            if status == ReplicaStatus.STARTING:
+                launched = rec.get('launched_at') or now
+                if now - launched > self.spec.initial_delay_seconds:
+                    logger.warning(
+                        '[%s] replica %d failed initial delay (%ds)',
+                        self.service_name, rid,
+                        self.spec.initial_delay_seconds)
+                    serve_state.set_replica_status(
+                        self.service_name, rid,
+                        ReplicaStatus.FAILED_INITIAL_DELAY,
+                        'readiness probe never passed within '
+                        'initial_delay_seconds')
+                    self.scale_down(
+                        rid, purge=False,
+                        final_status=ReplicaStatus.FAILED_INITIAL_DELAY)
+                continue
+            failures = serve_state.bump_replica_failures(
+                self.service_name, rid)
+            if failures >= 2 * constants.PROBE_FAILURE_THRESHOLD:
+                # NOT_READY never recovered: give up and replace it (the
+                # failed record is no longer `alive`, so the autoscaler
+                # launches a replacement on its next tick).
+                logger.warning('[%s] replica %d failed probing (%d '
+                               'consecutive failures); replacing',
+                               self.service_name, rid, failures)
+                serve_state.set_replica_status(
+                    self.service_name, rid, ReplicaStatus.FAILED_PROBING,
+                    f'readiness probe failed {failures} times in a row '
+                    'after the replica had been READY')
+                self.scale_down(rid, purge=False,
+                                final_status=ReplicaStatus.FAILED_PROBING)
+            elif failures >= constants.PROBE_FAILURE_THRESHOLD:
+                logger.warning('[%s] replica %d NOT_READY (%d failures)',
+                               self.service_name, rid, failures)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.NOT_READY)
+
+    # ---------------------------------------------------------- job status
+
+    def check_replica_clusters(self) -> None:
+        """Detect preempted/externally-terminated replica clusters and
+        failed replica jobs (parity: _job_status_fetcher :967 +
+        _handle_preemption :784)."""
+        from skypilot_tpu import backend_utils, core, state
+        from skypilot_tpu.status_lib import ClusterStatus
+        for rec in serve_state.get_replicas(self.service_name):
+            status = ReplicaStatus(rec['status'])
+            if status in (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                          ReplicaStatus.SHUTTING_DOWN) or \
+                    status.is_terminal():
+                continue
+            cluster = rec['cluster_name']
+            rid = rec['replica_id']
+            try:
+                record = backend_utils.refresh_cluster_record(cluster)
+            except Exception:  # pylint: disable=broad-except
+                record = state.get_cluster_from_name(cluster)
+            if record is None or record['status'] != ClusterStatus.UP:
+                logger.warning('[%s] replica %d cluster %s is gone '
+                               '(preempted?)', self.service_name, rid,
+                               cluster)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.PREEMPTED)
+                self.scale_down(rid, purge=True)
+                continue
+            # Replica job failed => replica FAILED (kept for status).
+            try:
+                jobs = core.queue(cluster)
+            except Exception:  # pylint: disable=broad-except
+                continue
+            if any(j['status'] in ('FAILED', 'FAILED_SETUP')
+                   for j in jobs):
+                logger.warning('[%s] replica %d job failed',
+                               self.service_name, rid)
+                serve_state.set_replica_status(
+                    self.service_name, rid, ReplicaStatus.FAILED,
+                    'replica job failed')
+                self.scale_down(rid, purge=False,
+                                final_status=ReplicaStatus.FAILED)

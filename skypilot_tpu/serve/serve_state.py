@@ -1,0 +1,272 @@
+"""Serve-plane state: SQLite on the controller host.
+
+Parity: sky/serve/serve_state.py — `services` + `replicas` tables with the
+ReplicaStatus (:83) and ServiceStatus (:175) machines.  Replica records are
+JSON (not pickles): the row must be readable by codegen snippets running
+under a different interpreter than the controller process.
+"""
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH = '~/.skytpu/serve/state.db'
+
+
+class ReplicaStatus(enum.Enum):
+    """Parity: sky/serve/serve_state.py:83."""
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'            # provisioned, probe not yet passing
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'          # probe failing after having been READY
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'                # job failed on the replica
+    FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
+    FAILED_PROBING = 'FAILED_PROBING'
+    FAILED_PROVISION = 'FAILED_PROVISION'
+    PREEMPTED = 'PREEMPTED'
+
+    def is_failed(self) -> bool:
+        return self in _REPLICA_FAILED
+
+    def is_terminal(self) -> bool:
+        return self in _REPLICA_FAILED
+
+    def is_scale_down_candidate(self) -> bool:
+        return self not in (ReplicaStatus.SHUTTING_DOWN,)
+
+
+_REPLICA_FAILED = {
+    ReplicaStatus.FAILED, ReplicaStatus.FAILED_INITIAL_DELAY,
+    ReplicaStatus.FAILED_PROBING, ReplicaStatus.FAILED_PROVISION
+}
+
+
+class ServiceStatus(enum.Enum):
+    """Parity: sky/serve/serve_state.py:175."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'    # no READY replica yet, some in flight
+    CONTROLLER_FAILED = 'CONTROLLER_FAILED'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    NO_REPLICA = 'NO_REPLICA'
+
+    @classmethod
+    def from_replica_statuses(
+            cls, statuses: List[ReplicaStatus]) -> 'ServiceStatus':
+        if any(s == ReplicaStatus.READY for s in statuses):
+            return cls.READY
+        if any(s.is_failed() for s in statuses):
+            return cls.FAILED
+        if not statuses:
+            return cls.NO_REPLICA
+        return cls.REPLICA_INIT
+
+
+def _db() -> sqlite3.Connection:
+    path = os.path.expanduser(_DB_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""CREATE TABLE IF NOT EXISTS services (
+        name TEXT PRIMARY KEY,
+        status TEXT,
+        controller_port INTEGER,
+        load_balancer_port INTEGER,
+        policy TEXT,
+        spec TEXT,
+        task_yaml TEXT,
+        version INTEGER DEFAULT 1,
+        controller_pid INTEGER,
+        created_at REAL)""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS replicas (
+        service_name TEXT,
+        replica_id INTEGER,
+        status TEXT,
+        version INTEGER,
+        cluster_name TEXT,
+        endpoint TEXT,
+        is_spot INTEGER DEFAULT 0,
+        launched_at REAL,
+        ready_at REAL,
+        consecutive_failures INTEGER DEFAULT 0,
+        failure_reason TEXT,
+        PRIMARY KEY (service_name, replica_id))""")
+    conn.commit()
+    return conn
+
+
+# ------------------------------------------------------------------ services
+
+
+def add_service(name: str, controller_port: int, lb_port: int,
+                policy: str, spec_json: str, task_yaml: str,
+                controller_pid: int) -> bool:
+    """Returns False if the service already exists."""
+    try:
+        with _db() as conn:
+            conn.execute(
+                'INSERT INTO services (name, status, controller_port, '
+                'load_balancer_port, policy, spec, task_yaml, '
+                'controller_pid, created_at) VALUES (?,?,?,?,?,?,?,?,?)',
+                (name, ServiceStatus.CONTROLLER_INIT.value, controller_port,
+                 lb_port, policy, spec_json, task_yaml, controller_pid,
+                 time.time()))
+        return True
+    except sqlite3.IntegrityError:
+        return False
+
+
+def remove_service(name: str) -> None:
+    with _db() as conn:
+        conn.execute('DELETE FROM services WHERE name=?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE services SET status=? WHERE name=?',
+                     (status.value, name))
+
+
+def set_service_spec(name: str, spec_json: str, task_yaml: str) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE services SET spec=?, task_yaml=?, '
+            'version=version+1 WHERE name=?', (spec_json, task_yaml, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    row = conn.execute('SELECT * FROM services WHERE name=?',
+                       (name,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute('SELECT * FROM services ORDER BY name').fetchall()
+    return [dict(r) for r in rows]
+
+
+# ------------------------------------------------------------------ replicas
+
+
+def add_replica(service_name: str, replica_id: int, version: int,
+                cluster_name: str, is_spot: bool) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
+            'status, version, cluster_name, is_spot, launched_at, '
+            'consecutive_failures) VALUES (?,?,?,?,?,?,?,0)',
+            (service_name, replica_id, ReplicaStatus.PROVISIONING.value,
+             version, cluster_name, int(is_spot), time.time()))
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _db() as conn:
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus,
+                       failure_reason: Optional[str] = None) -> None:
+    fields: Dict[str, Any] = {'status': status.value}
+    if status == ReplicaStatus.READY:
+        fields['ready_at'] = time.time()
+        fields['consecutive_failures'] = 0
+    if failure_reason is not None:
+        fields['failure_reason'] = failure_reason[:2000]
+    sets = ', '.join(f'{k}=?' for k in fields)
+    with _db() as conn:
+        conn.execute(
+            f'UPDATE replicas SET {sets} '
+            'WHERE service_name=? AND replica_id=?',
+            list(fields.values()) + [service_name, replica_id])
+
+
+def set_replica_endpoint(service_name: str, replica_id: int,
+                         endpoint: str) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE replicas SET endpoint=? '
+            'WHERE service_name=? AND replica_id=?',
+            (endpoint, service_name, replica_id))
+
+
+def bump_replica_failures(service_name: str, replica_id: int) -> int:
+    """Increment and return the consecutive probe failure count."""
+    conn = _db()
+    with conn:
+        conn.execute(
+            'UPDATE replicas SET consecutive_failures='
+            'consecutive_failures+1 WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+        row = conn.execute(
+            'SELECT consecutive_failures FROM replicas '
+            'WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id)).fetchone()
+    return row[0] if row else 0
+
+
+def reset_replica_failures(service_name: str, replica_id: int) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE replicas SET consecutive_failures=0 '
+            'WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute(
+        'SELECT * FROM replicas WHERE service_name=? ORDER BY replica_id',
+        (service_name,)).fetchall()
+    return [dict(r) for r in rows]
+
+
+def get_replica(service_name: str,
+                replica_id: int) -> Optional[Dict[str, Any]]:
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    row = conn.execute(
+        'SELECT * FROM replicas WHERE service_name=? AND replica_id=?',
+        (service_name, replica_id)).fetchone()
+    return dict(row) if row else None
+
+
+def next_replica_id(service_name: str) -> int:
+    row = _db().execute(
+        'SELECT MAX(replica_id) FROM replicas WHERE service_name=?',
+        (service_name,)).fetchone()
+    return (row[0] or 0) + 1
+
+
+def ready_replica_endpoints(service_name: str) -> List[str]:
+    rows = _db().execute(
+        'SELECT endpoint FROM replicas WHERE service_name=? AND status=? '
+        'AND endpoint IS NOT NULL ORDER BY replica_id',
+        (service_name, ReplicaStatus.READY.value)).fetchall()
+    return [r[0] for r in rows]
+
+
+# ----------------------------------------------------- status table as JSON
+
+
+def services_as_json() -> str:
+    out = []
+    for svc in get_services():
+        replicas = get_replicas(svc['name'])
+        svc['replica_statuses'] = [r['status'] for r in replicas]
+        svc['replicas'] = replicas
+        out.append(svc)
+    return json.dumps(out)

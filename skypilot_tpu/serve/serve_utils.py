@@ -1,0 +1,165 @@
+"""Client↔controller plumbing for the serve plane.
+
+Parity: sky/serve/serve_utils.py — the ServeCodeGen twin (client executes
+short python programs on the serve-controller host), service name
+validation, and status formatting.
+"""
+import re
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.podlet import codegen as podlet_codegen
+
+parse_result = podlet_codegen.parse_result
+
+_IMPORTS = ('from skypilot_tpu.serve import serve_state\n'
+            'from skypilot_tpu.serve import constants as serve_constants')
+
+
+def _wrap(body: str) -> str:
+    return podlet_codegen.wrap_python(body, _IMPORTS)
+
+
+_SERVICE_NAME_RE = re.compile(r'^[a-z]([a-z0-9-]{0,38}[a-z0-9])?$')
+
+
+def validate_service_name(name: str) -> None:
+    if not _SERVICE_NAME_RE.match(name):
+        raise exceptions.InvalidTaskError(
+            f'Service name {name!r} is invalid: must match '
+            f'{_SERVICE_NAME_RE.pattern} (it prefixes replica cluster '
+            'names).')
+
+
+def generate_service_name(task_name: Optional[str]) -> str:
+    import uuid
+    base = re.sub(r'[^a-z0-9-]', '-', (task_name or 'service').lower())
+    base = re.sub(r'-+', '-', base).strip('-') or 'service'
+    if not base[0].isalpha():
+        base = 's-' + base
+    return f'{base[:20]}-{uuid.uuid4().hex[:4]}'
+
+
+class ServeCodeGen:
+    """Shell commands to run on the serve-controller host."""
+
+    @staticmethod
+    def get_service_status() -> str:
+        return _wrap(
+            '_emit(json.loads(serve_state.services_as_json()))\n')
+
+    @staticmethod
+    def terminate_services(names: Optional[List[str]],
+                           purge: bool = False) -> str:
+        """None => all services.  Writes terminate signal files; with
+        purge, services whose controller process is dead (e.g.
+        CONTROLLER_FAILED — nothing left to consume the signal) have their
+        rows removed directly."""
+        body = (
+            f'import signal as _sig\n'
+            f'names = {names!r}\n'
+            f'if names is None:\n'
+            f'    names = [s["name"] for s in serve_state.get_services()]\n'
+            f'sigdir = os.path.expanduser(serve_constants.SIGNAL_DIR)\n'
+            f'os.makedirs(sigdir, exist_ok=True)\n'
+            f'touched = []\n'
+            f'for n in names:\n'
+            f'    svc = serve_state.get_service(n)\n'
+            f'    if svc is None:\n'
+            f'        continue\n'
+            f'    pid_alive = True\n'
+            f'    try:\n'
+            f'        os.kill(svc["controller_pid"], 0)\n'
+            f'    except (OSError, TypeError):\n'
+            f'        pid_alive = False\n'
+            f'    if {purge!r} and not pid_alive:\n'
+            f'        serve_state.remove_service(n)\n'
+            f'    else:\n'
+            f'        open(os.path.join(sigdir, n), "w").write('
+            f'"TERMINATE")\n'
+            f'    touched.append(n)\n'
+            f'_emit({{"terminated": touched}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def wait_service_registration(name: str, timeout: float) -> str:
+        """Block until the service row exists (the service job has started)
+        and report its ports, or time out."""
+        body = (
+            f'deadline = time.time() + {timeout}\n'
+            f'svc = None\n'
+            f'while time.time() < deadline:\n'
+            f'    svc = serve_state.get_service({name!r})\n'
+            f'    if svc is not None:\n'
+            f'        break\n'
+            f'    time.sleep(0.5)\n'
+            f'if svc is None:\n'
+            f'    _emit({{"error": "service not registered in time"}})\n'
+            f'else:\n'
+            f'    _emit({{"controller_port": svc["controller_port"],\n'
+            f'           "load_balancer_port": '
+            f'svc["load_balancer_port"]}})\n')
+        return _wrap(body)
+
+    @staticmethod
+    def update_service(name: str, spec_json: str, task_yaml: str) -> str:
+        """POST the new spec to the service's controller API."""
+        body = (
+            f'import urllib.request\n'
+            f'svc = serve_state.get_service({name!r})\n'
+            f'if svc is None:\n'
+            f'    _emit({{"error": "no such service"}})\n'
+            f'else:\n'
+            f'    req = urllib.request.Request(\n'
+            f'        "http://127.0.0.1:%d/controller/update_service" '
+            f'% svc["controller_port"],\n'
+            f'        data=json.dumps({{"spec": {spec_json!r}, '
+            f'"task_yaml": {task_yaml!r}}}).encode(),\n'
+            f'        headers={{"Content-Type": "application/json"}})\n'
+            f'    with urllib.request.urlopen(req, timeout=10) as r:\n'
+            f'        _emit(json.loads(r.read()))\n')
+        return _wrap(body)
+
+    @staticmethod
+    def terminate_replica(name: str, replica_id: int, purge: bool) -> str:
+        body = (
+            f'import urllib.request\n'
+            f'svc = serve_state.get_service({name!r})\n'
+            f'if svc is None:\n'
+            f'    _emit({{"error": "no such service"}})\n'
+            f'else:\n'
+            f'    req = urllib.request.Request(\n'
+            f'        "http://127.0.0.1:%d/controller/terminate_replica" '
+            f'% svc["controller_port"],\n'
+            f'        data=json.dumps({{"replica_id": {replica_id}, '
+            f'"purge": {purge!r}}}).encode(),\n'
+            f'        headers={{"Content-Type": "application/json"}})\n'
+            f'    with urllib.request.urlopen(req, timeout=10) as r:\n'
+            f'        _emit(json.loads(r.read()))\n')
+        return _wrap(body)
+
+    @staticmethod
+    def stream_replica_logs(name: str, replica_id: int,
+                            follow: bool) -> str:
+        """Stream a replica cluster's job logs through the controller."""
+        body = (
+            f'from skypilot_tpu import core\n'
+            f'from skypilot_tpu.serve import replica_managers\n'
+            f'cluster = replica_managers.replica_cluster_name('
+            f'{name!r}, {replica_id})\n'
+            f'sys.exit(core.tail_logs(cluster, follow={follow!r}))\n')
+        return _wrap(body)
+
+
+def format_service_table(services: List[Dict[str, Any]]) -> str:
+    header = (f'{"NAME":<24}{"VERSION":<9}{"STATUS":<18}{"REPLICAS":<10}'
+              f'{"ENDPOINT"}')
+    lines = [header]
+    for svc in services:
+        ready = sum(1 for r in svc.get('replicas', [])
+                    if r['status'] == 'READY')
+        total = len(svc.get('replicas', []))
+        lines.append(f'{svc["name"]:<24}{svc.get("version", 1):<9}'
+                     f'{svc["status"]:<18}{f"{ready}/{total}":<10}'
+                     f'{svc.get("endpoint") or "-"}')
+    return '\n'.join(lines)

@@ -1,0 +1,127 @@
+"""Per-service entrypoint running on the serve controller host.
+
+Parity: sky/serve/service.py:133 (_start) — launched as a job on the
+controller cluster by `serve.up`; brings up the controller (autoscaler +
+replica manager + HTTP API) and the load balancer, then waits on the
+terminate signal file, tearing everything down on exit.  The reference
+forks two processes; we run two daemon threads (both are stdlib HTTP
+servers) and keep the main thread as the signal watcher.
+"""
+import argparse
+import os
+import threading
+import time
+import traceback
+
+from skypilot_tpu import logsys
+from skypilot_tpu.serve import constants, load_balancer, serve_state
+from skypilot_tpu.serve.controller import ServeController
+from skypilot_tpu.serve.load_balancing_policies import (DEFAULT_POLICY,
+                                                        LoadBalancingPolicy)
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+from skypilot_tpu.utils import locks
+
+logger = logsys.init_logger(__name__)
+
+
+def _allocate_ports() -> tuple:
+    """Pick (controller_port, lb_port) unused by other services on this
+    controller host (parity: the port-selection lock,
+    sky/serve/service.py:187)."""
+    used = set()
+    for svc in serve_state.get_services():
+        used.add(svc['controller_port'])
+        used.add(svc['load_balancer_port'])
+    cport = constants.CONTROLLER_PORT_START
+    while cport in used:
+        cport += 1
+    lport = constants.LOAD_BALANCER_PORT_START
+    while lport in used:
+        lport += 1
+    return cport, lport
+
+
+def _signal_path(service_name: str) -> str:
+    return os.path.join(os.path.expanduser(constants.SIGNAL_DIR),
+                        service_name)
+
+
+def _cleanup(service_name: str, controller: ServeController) -> None:
+    serve_state.set_service_status(service_name, ServiceStatus.SHUTTING_DOWN)
+    controller.replica_manager.terminate_all()
+    serve_state.remove_service(service_name)
+    try:
+        os.remove(_signal_path(service_name))
+    except FileNotFoundError:
+        pass
+
+
+def _start(service_name: str, task_yaml: str, policy_name: str) -> None:
+    import yaml
+    with open(os.path.expanduser(task_yaml), encoding='utf-8') as f:
+        task_cfg = yaml.safe_load(f)
+    if 'service' not in task_cfg:
+        raise ValueError(f'No `service:` section in {task_yaml}')
+    spec = SkyTpuServiceSpec.from_yaml_config(task_cfg['service'])
+    LoadBalancingPolicy.make(policy_name)  # validate early
+
+    with locks.named_lock('serve-ports'):
+        controller_port, lb_port = _allocate_ports()
+        ok = serve_state.add_service(service_name, controller_port, lb_port,
+                                     policy_name, spec.to_json(), task_yaml,
+                                     os.getpid())
+    if not ok:
+        raise RuntimeError(f'Service {service_name!r} already exists.')
+
+    os.makedirs(os.path.expanduser(constants.SIGNAL_DIR), exist_ok=True)
+    controller = ServeController(service_name, spec, task_yaml,
+                                 controller_port)
+    lb = load_balancer.SkyTpuLoadBalancer(
+        f'http://127.0.0.1:{controller_port}', lb_port,
+        LoadBalancingPolicy.make(policy_name))
+
+    threading.Thread(target=controller.run, daemon=True,
+                     name='controller').start()
+    threading.Thread(target=lb.run, daemon=True, name='lb').start()
+    serve_state.set_service_status(service_name, ServiceStatus.REPLICA_INIT)
+    logger.info('Service %r up: controller :%d, load balancer :%d',
+                service_name, controller_port, lb_port)
+
+    signal = _signal_path(service_name)
+    try:
+        while True:
+            if os.path.exists(signal):
+                logger.info('Terminate signal received for %r.',
+                            service_name)
+                break
+            time.sleep(1)
+    finally:
+        lb.stop()
+        controller.stop()
+        _cleanup(service_name, controller)
+    logger.info('Service %r torn down.', service_name)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser('skypilot_tpu.serve.service')
+    parser.add_argument('--service-name', required=True)
+    parser.add_argument('--task-yaml', required=True)
+    parser.add_argument('--policy', default=DEFAULT_POLICY)
+    args = parser.parse_args()
+    try:
+        _start(args.service_name, args.task_yaml, args.policy)
+    except Exception:
+        logger.error('Service %r crashed:\n%s', args.service_name,
+                     traceback.format_exc())
+        svc = serve_state.get_service(args.service_name)
+        # Only mark failed if the crashing process owns the record (a
+        # duplicate-name `up` must not poison the live service).
+        if svc is not None and svc['controller_pid'] == os.getpid():
+            serve_state.set_service_status(args.service_name,
+                                           ServiceStatus.CONTROLLER_FAILED)
+        raise
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,155 @@
+"""The `service:` section of a task YAML.
+
+Parity: sky/serve/service_spec.py:15 (SkyServiceSpec) — readiness probe
+(path/post_data/headers/initial delay/timeout), replica policy (min/max
+replicas, target QPS per replica, hysteresis delays, spot + on-demand
+fallback), and the replica port.
+"""
+import dataclasses
+import json
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import constants
+
+
+@dataclasses.dataclass
+class SkyTpuServiceSpec:
+    """Validated service specification."""
+    # Readiness probe.
+    readiness_path: str = '/'
+    initial_delay_seconds: float = 1200.0
+    readiness_timeout_seconds: float = 15.0
+    post_data: Optional[Any] = None
+    readiness_headers: Optional[Dict[str, str]] = None
+    # Replica policy.
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None      # None => fixed at min_replicas
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: float = 300.0
+    downscale_delay_seconds: float = 1200.0
+    # Spot policy (FallbackRequestRateAutoscaler parity).
+    use_ondemand_fallback: bool = False
+    base_ondemand_fallback_replicas: int = 0
+    # Traffic.
+    port: int = constants.DEFAULT_REPLICA_PORT
+    load_balancing_policy: Optional[str] = None
+
+    def __post_init__(self):
+        if self.min_replicas < 0:
+            raise exceptions.InvalidTaskError('min_replicas must be >= 0')
+        if (self.max_replicas is not None and
+                self.max_replicas < self.min_replicas):
+            raise exceptions.InvalidTaskError(
+                'max_replicas must be >= min_replicas')
+        if self.target_qps_per_replica is not None:
+            if self.target_qps_per_replica <= 0:
+                raise exceptions.InvalidTaskError(
+                    'target_qps_per_replica must be > 0')
+            if self.max_replicas is None:
+                raise exceptions.InvalidTaskError(
+                    'target_qps_per_replica requires max_replicas')
+        if not self.readiness_path.startswith('/'):
+            raise exceptions.InvalidTaskError(
+                f'readiness path must start with "/": '
+                f'{self.readiness_path!r}')
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return self.target_qps_per_replica is not None
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyTpuServiceSpec':
+        """Accepts both the nested reference schema
+        (`readiness_probe: {...}, replica_policy: {...}`) and flat keys."""
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'service section must be a mapping, got {config!r}')
+        kwargs: Dict[str, Any] = {}
+        probe = config.get('readiness_probe', {})
+        if isinstance(probe, str):
+            probe = {'path': probe}
+        if 'path' in probe:
+            kwargs['readiness_path'] = probe['path']
+        if 'initial_delay_seconds' in probe:
+            kwargs['initial_delay_seconds'] = float(
+                probe['initial_delay_seconds'])
+        if 'timeout_seconds' in probe:
+            kwargs['readiness_timeout_seconds'] = float(
+                probe['timeout_seconds'])
+        if 'post_data' in probe:
+            kwargs['post_data'] = probe['post_data']
+        if 'headers' in probe:
+            kwargs['readiness_headers'] = dict(probe['headers'])
+
+        policy = config.get('replica_policy', {})
+        if 'replicas' in config:            # static shorthand
+            kwargs['min_replicas'] = int(config['replicas'])
+        if 'min_replicas' in policy:
+            kwargs['min_replicas'] = int(policy['min_replicas'])
+        if 'max_replicas' in policy:
+            kwargs['max_replicas'] = int(policy['max_replicas'])
+        if 'target_qps_per_replica' in policy:
+            kwargs['target_qps_per_replica'] = float(
+                policy['target_qps_per_replica'])
+        if 'upscale_delay_seconds' in policy:
+            kwargs['upscale_delay_seconds'] = float(
+                policy['upscale_delay_seconds'])
+        if 'downscale_delay_seconds' in policy:
+            kwargs['downscale_delay_seconds'] = float(
+                policy['downscale_delay_seconds'])
+        if 'base_ondemand_fallback_replicas' in policy:
+            kwargs['base_ondemand_fallback_replicas'] = int(
+                policy['base_ondemand_fallback_replicas'])
+            kwargs['use_ondemand_fallback'] = True
+        if 'dynamic_ondemand_fallback' in policy:
+            kwargs['use_ondemand_fallback'] = bool(
+                policy['dynamic_ondemand_fallback'])
+        if 'port' in config:
+            kwargs['port'] = int(config['port'])
+        if 'load_balancing_policy' in config:
+            kwargs['load_balancing_policy'] = config[
+                'load_balancing_policy']
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {
+            'path': self.readiness_path,
+            'initial_delay_seconds': self.initial_delay_seconds,
+            'timeout_seconds': self.readiness_timeout_seconds,
+        }
+        if self.post_data is not None:
+            probe['post_data'] = self.post_data
+        if self.readiness_headers is not None:
+            probe['headers'] = self.readiness_headers
+        policy: Dict[str, Any] = {'min_replicas': self.min_replicas}
+        if self.max_replicas is not None:
+            policy['max_replicas'] = self.max_replicas
+        if self.target_qps_per_replica is not None:
+            policy['target_qps_per_replica'] = self.target_qps_per_replica
+            policy['upscale_delay_seconds'] = self.upscale_delay_seconds
+            policy['downscale_delay_seconds'] = self.downscale_delay_seconds
+        if self.use_ondemand_fallback:
+            policy['base_ondemand_fallback_replicas'] = (
+                self.base_ondemand_fallback_replicas)
+        cfg: Dict[str, Any] = {
+            'readiness_probe': probe,
+            'replica_policy': policy,
+            'port': self.port,
+        }
+        if self.load_balancing_policy is not None:
+            cfg['load_balancing_policy'] = self.load_balancing_policy
+        return cfg
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_yaml_config())
+
+    @classmethod
+    def from_json(cls, s: str) -> 'SkyTpuServiceSpec':
+        return cls.from_yaml_config(json.loads(s))
+
+    def __repr__(self) -> str:
+        scale = (f'{self.min_replicas}..{self.max_replicas}'
+                 if self.autoscaling_enabled else str(self.min_replicas))
+        return (f'ServiceSpec(replicas={scale}, port={self.port}, '
+                f'probe={self.readiness_path!r})')

@@ -94,6 +94,7 @@ _SERVICE_SCHEMA = {
         },
         'replicas': {'type': 'integer'},
         'load_balancing_policy': {'type': 'string'},
+        'port': {'type': 'integer'},
     },
 }
 

@@ -1,0 +1,522 @@
+"""Serve plane: unit tests (spec parsing, autoscaler decision table, state
+machine, LB policies, LB proxy) + e2e on the local cloud (up -> READY ->
+traffic through the LB -> autoscale -> down).
+
+Parity role: tests/test_serve_autoscaler.py + tests/skyserve/ smoke
+scenarios, runnable without clouds (SURVEY.md §4).
+"""
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu import Resources, Task, exceptions, state
+from skypilot_tpu.serve import autoscalers, load_balancer, serve_state
+from skypilot_tpu.serve.autoscalers import (AutoscalerDecision,
+                                            DecisionOperator, ReplicaView)
+from skypilot_tpu.serve.load_balancing_policies import (LeastLoadPolicy,
+                                                        LoadBalancingPolicy,
+                                                        RoundRobinPolicy)
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+
+
+# ---------------------------------------------------------------- spec
+
+
+def test_service_spec_yaml_roundtrip():
+    cfg = {
+        'readiness_probe': {
+            'path': '/health',
+            'initial_delay_seconds': 30,
+            'post_data': {'prompt': 'hi'},
+        },
+        'replica_policy': {
+            'min_replicas': 2,
+            'max_replicas': 5,
+            'target_qps_per_replica': 2.0,
+            'upscale_delay_seconds': 10,
+            'downscale_delay_seconds': 20,
+        },
+        'port': 9000,
+    }
+    spec = SkyTpuServiceSpec.from_yaml_config(cfg)
+    assert spec.readiness_path == '/health'
+    assert spec.autoscaling_enabled
+    assert spec.port == 9000
+    spec2 = SkyTpuServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert spec2 == spec
+    spec3 = SkyTpuServiceSpec.from_json(spec.to_json())
+    assert spec3 == spec
+
+
+def test_service_spec_shorthand_and_validation():
+    spec = SkyTpuServiceSpec.from_yaml_config({
+        'readiness_probe': '/healthz', 'replicas': 3
+    })
+    assert spec.readiness_path == '/healthz'
+    assert spec.min_replicas == 3
+    assert not spec.autoscaling_enabled
+
+    with pytest.raises(exceptions.InvalidTaskError):
+        SkyTpuServiceSpec(min_replicas=3, max_replicas=1)
+    with pytest.raises(exceptions.InvalidTaskError):
+        SkyTpuServiceSpec(target_qps_per_replica=1.0)  # no max_replicas
+    with pytest.raises(exceptions.InvalidTaskError):
+        SkyTpuServiceSpec(readiness_path='health')
+
+
+def test_task_yaml_service_section_roundtrip():
+    task = Task('svc', run='python server.py')
+    task.set_resources(Resources(cloud='local'))
+    task.set_service(SkyTpuServiceSpec(min_replicas=2, port=9001))
+    cfg = task.to_yaml_config()
+    task2 = Task.from_yaml_config(cfg)
+    assert task2.service.min_replicas == 2
+    assert task2.service.port == 9001
+
+
+# ----------------------------------------------------------- autoscalers
+
+
+def _views(*entries):
+    out = []
+    for i, e in enumerate(entries):
+        status, *rest = e if isinstance(e, tuple) else (e,)
+        version = rest[0] if rest else 1
+        spot = rest[1] if len(rest) > 1 else False
+        out.append(ReplicaView(replica_id=i + 1, status=status,
+                               version=version, is_spot=spot))
+    return out
+
+
+def test_fixed_autoscaler_replaces_failures():
+    spec = SkyTpuServiceSpec(min_replicas=2)
+    a = autoscalers.Autoscaler.make(spec)
+    assert type(a) is autoscalers.Autoscaler
+    # Empty -> two scale ups.
+    ups = a.evaluate_scaling([])
+    assert [d.operator for d in ups] == [DecisionOperator.SCALE_UP] * 2
+    # One alive + one failed -> one more.
+    decisions = a.evaluate_scaling(
+        _views(ReplicaStatus.READY, ReplicaStatus.FAILED_PROVISION))
+    assert [d.operator for d in decisions] == [DecisionOperator.SCALE_UP]
+    # At target -> nothing.
+    assert a.evaluate_scaling(
+        _views(ReplicaStatus.READY, ReplicaStatus.STARTING)) == []
+    # Above target -> scale down, preferring unready/newest.
+    decisions = a.evaluate_scaling(
+        _views(ReplicaStatus.READY, ReplicaStatus.READY,
+               ReplicaStatus.STARTING))
+    assert len(decisions) == 1
+    assert decisions[0].operator == DecisionOperator.SCALE_DOWN
+    assert decisions[0].target['replica_id'] == 3
+
+
+class _Clock:
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _rate_autoscaler(monkeypatch, clock, **spec_kw):
+    defaults = dict(min_replicas=1, max_replicas=4,
+                    target_qps_per_replica=1.0, upscale_delay_seconds=10,
+                    downscale_delay_seconds=20)
+    defaults.update(spec_kw)
+    spec = SkyTpuServiceSpec(**defaults)
+    a = autoscalers.Autoscaler.make(spec)
+    monkeypatch.setattr(type(a), '_now', lambda self: clock.t)
+    return a
+
+
+def test_request_rate_autoscaler_upscale_hysteresis(monkeypatch):
+    clock = _Clock()
+    a = _rate_autoscaler(monkeypatch, clock)
+    assert isinstance(a, autoscalers.RequestRateAutoscaler)
+    replicas = _views(ReplicaStatus.READY)
+    # 3 QPS over the 60s window => raw target 3; but upscale only after
+    # the pressure persists for upscale_delay_seconds.
+    a.collect_request_information(
+        [clock.t - i * 0.3 for i in range(180)])
+    assert a.evaluate_scaling(replicas) == []          # starts the timer
+    clock.advance(5)
+    assert a.evaluate_scaling(replicas) == []          # still within delay
+    clock.advance(6)
+    decisions = a.evaluate_scaling(replicas)
+    assert [d.operator for d in decisions] == (
+        [DecisionOperator.SCALE_UP] * 2)
+
+
+def test_request_rate_autoscaler_downscale_hysteresis(monkeypatch):
+    clock = _Clock()
+    a = _rate_autoscaler(monkeypatch, clock)
+    replicas = _views(ReplicaStatus.READY, ReplicaStatus.READY,
+                      ReplicaStatus.READY)
+    # Zero traffic => raw target = min_replicas = 1.
+    assert a.evaluate_scaling(replicas) == []
+    clock.advance(21)
+    decisions = a.evaluate_scaling(replicas)
+    assert [d.operator for d in decisions] == (
+        [DecisionOperator.SCALE_DOWN] * 2)
+    # Old timestamps age out of the QPS window.
+    a.collect_request_information([clock.t - 120] * 50)
+    assert a.current_qps() == 0.0
+
+
+def test_request_rate_autoscaler_min_replicas_no_hysteresis(monkeypatch):
+    clock = _Clock()
+    a = _rate_autoscaler(monkeypatch, clock, min_replicas=2)
+    # A failed replica leaves 1 alive < min 2: replacement is immediate.
+    decisions = a.evaluate_scaling(
+        _views(ReplicaStatus.READY, ReplicaStatus.FAILED_PROBING))
+    assert [d.operator for d in decisions] == [DecisionOperator.SCALE_UP]
+
+
+def test_scale_down_prefers_old_versions():
+    order = autoscalers._scale_down_order(
+        _views((ReplicaStatus.READY, 2), (ReplicaStatus.READY, 1),
+               (ReplicaStatus.STARTING, 2)), latest_version=2)
+    # Old version first, then unready, then newest id.
+    assert [r.replica_id for r in order] == [2, 3, 1]
+
+
+def test_fallback_autoscaler_spot_with_ondemand_base(monkeypatch):
+    clock = _Clock()
+    spec = SkyTpuServiceSpec(min_replicas=2, max_replicas=4,
+                             target_qps_per_replica=1.0,
+                             upscale_delay_seconds=10,
+                             downscale_delay_seconds=20,
+                             base_ondemand_fallback_replicas=1,
+                             use_ondemand_fallback=True)
+    a = autoscalers.Autoscaler.make(spec)
+    assert isinstance(a, autoscalers.FallbackRequestRateAutoscaler)
+    monkeypatch.setattr(type(a), '_now', lambda self: clock.t)
+    # Nothing running: 2 spot + 1 base on-demand + 2 dynamic fallback
+    # (no spot READY yet).
+    decisions = a.evaluate_scaling([])
+    ups = [d.target['use_spot'] for d in decisions
+           if d.operator == DecisionOperator.SCALE_UP]
+    assert ups.count(True) == 2
+    assert ups.count(False) == 3
+    # Both spot READY: dynamic fallback drains to the base of 1.
+    replicas = _views((ReplicaStatus.READY, 1, True),
+                      (ReplicaStatus.READY, 1, True),
+                      (ReplicaStatus.READY, 1, False),
+                      (ReplicaStatus.READY, 1, False),
+                      (ReplicaStatus.READY, 1, False))
+    decisions = a.evaluate_scaling(replicas)
+    downs = [d for d in decisions
+             if d.operator == DecisionOperator.SCALE_DOWN]
+    assert len(downs) == 2
+
+
+# ------------------------------------------------------------ serve state
+
+
+@pytest.fixture
+def serve_home(tmp_path, monkeypatch):
+    monkeypatch.setenv('HOME', str(tmp_path))
+    yield str(tmp_path)
+
+
+def test_serve_state_machine(serve_home):
+    assert serve_state.add_service('svc', 20001, 30001, 'round_robin',
+                                   '{}', '/t.yaml', 123)
+    assert not serve_state.add_service('svc', 20002, 30002, 'round_robin',
+                                       '{}', '/t.yaml', 124)
+    serve_state.add_replica('svc', 1, 1, 'svc-1', False)
+    serve_state.set_replica_endpoint('svc', 1, 'http://127.0.0.1:9000')
+    serve_state.set_replica_status('svc', 1, ReplicaStatus.STARTING)
+    assert serve_state.ready_replica_endpoints('svc') == []
+    serve_state.set_replica_status('svc', 1, ReplicaStatus.READY)
+    assert serve_state.ready_replica_endpoints('svc') == [
+        'http://127.0.0.1:9000'
+    ]
+    assert serve_state.next_replica_id('svc') == 2
+    for _ in range(3):
+        n = serve_state.bump_replica_failures('svc', 1)
+    assert n == 3
+    serve_state.set_replica_status('svc', 1, ReplicaStatus.READY)
+    assert serve_state.get_replica('svc', 1)['consecutive_failures'] == 0
+    svc = serve_state.get_service('svc')
+    assert svc['load_balancer_port'] == 30001
+    serve_state.remove_service('svc')
+    assert serve_state.get_service('svc') is None
+    assert serve_state.get_replicas('svc') == []
+
+
+def test_service_status_aggregation():
+    f = ServiceStatus.from_replica_statuses
+    assert f([]) == ServiceStatus.NO_REPLICA
+    assert f([ReplicaStatus.STARTING]) == ServiceStatus.REPLICA_INIT
+    assert f([ReplicaStatus.READY,
+              ReplicaStatus.FAILED]) == ServiceStatus.READY
+    assert f([ReplicaStatus.FAILED_PROVISION]) == ServiceStatus.FAILED
+
+
+def test_probe_failure_escalation_replaces_replica(serve_home):
+    """READY -> NOT_READY at the failure threshold, FAILED_PROBING (and
+    teardown) at 2x the threshold, after which the replica no longer
+    counts as capacity so the autoscaler replaces it."""
+    from skypilot_tpu.serve import constants as sc
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    spec = SkyTpuServiceSpec(min_replicas=1, initial_delay_seconds=1)
+    serve_state.add_service('svc', 20001, 30001, 'round_robin',
+                            spec.to_json(), '/t.yaml', 1)
+    mgr = ReplicaManager('svc', spec, '/t.yaml')
+    serve_state.add_replica('svc', 1, 1, 'svc-1', False)
+    # Endpoint nothing listens on => every probe fails.
+    serve_state.set_replica_endpoint('svc', 1, 'http://127.0.0.1:1')
+    serve_state.set_replica_status('svc', 1, ReplicaStatus.READY)
+    for _ in range(sc.PROBE_FAILURE_THRESHOLD):
+        mgr.probe_all()
+    assert serve_state.get_replica('svc', 1)['status'] == (
+        ReplicaStatus.NOT_READY.value)
+    for _ in range(sc.PROBE_FAILURE_THRESHOLD):
+        mgr.probe_all()
+    mgr._pool.shutdown(wait=True)
+    rec = serve_state.get_replica('svc', 1)
+    assert rec['status'] == ReplicaStatus.FAILED_PROBING.value
+    assert 'probe failed' in rec['failure_reason']
+    # Terminal-failed replica is not alive => autoscaler relaunches.
+    view = ReplicaView(1, ReplicaStatus.FAILED_PROBING, 1, False)
+    assert not view.alive
+    a = autoscalers.Autoscaler.make(spec)
+    assert [d.operator for d in a.evaluate_scaling([view])] == [
+        DecisionOperator.SCALE_UP
+    ]
+
+
+def test_controller_update_remakes_autoscaler(serve_home, tmp_path):
+    from skypilot_tpu.serve.controller import ServeController
+    yaml_path = str(tmp_path / 't.yaml')
+    open(yaml_path, 'w').write('run: echo hi\n')
+    spec = SkyTpuServiceSpec(min_replicas=1)
+    serve_state.add_service('svc', 20001, 30001, 'round_robin',
+                            spec.to_json(), yaml_path, 1)
+    c = ServeController('svc', spec, yaml_path, 20001)
+    assert type(c.autoscaler) is autoscalers.Autoscaler
+    new_spec = SkyTpuServiceSpec(min_replicas=1, max_replicas=3,
+                                 target_qps_per_replica=1.0)
+    c._handle('/controller/update_service', {
+        'spec': new_spec.to_json(), 'task_yaml': yaml_path
+    })
+    assert isinstance(c.autoscaler, autoscalers.RequestRateAutoscaler)
+    assert c.version == 2
+    # And back to fixed scaling without crashing the tick.
+    fixed = SkyTpuServiceSpec(min_replicas=2)
+    c._handle('/controller/update_service', {
+        'spec': fixed.to_json(), 'task_yaml': yaml_path
+    })
+    assert type(c.autoscaler) is autoscalers.Autoscaler
+    assert c.autoscaler.latest_version == 3
+
+
+# ------------------------------------------------------------ LB policies
+
+
+def test_round_robin_policy():
+    p = LoadBalancingPolicy.make('round_robin')
+    assert isinstance(p, RoundRobinPolicy)
+    assert p.select_replica() is None
+    p.set_ready_replicas(['a', 'b', 'c'])
+    assert [p.select_replica() for _ in range(4)] == ['a', 'b', 'c', 'a']
+    p.set_ready_replicas(['a', 'b', 'c'])     # same set: index kept
+    assert p.select_replica() == 'b'
+    p.set_ready_replicas(['x', 'y'])          # new set: index reset
+    assert p.select_replica() == 'x'
+
+
+def test_least_load_policy():
+    p = LoadBalancingPolicy.make('least_load')
+    assert isinstance(p, LeastLoadPolicy)
+    p.set_ready_replicas(['a', 'b'])
+    r1 = p.select_replica()
+    r2 = p.select_replica()
+    assert {r1, r2} == {'a', 'b'}
+    p.request_done(r1)
+    assert p.select_replica() == r1
+    with pytest.raises(ValueError):
+        LoadBalancingPolicy.make('nope')
+
+
+# --------------------------------------------------------------- LB proxy
+
+
+class _Echo(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({
+            'port': self.server.server_address[1], 'path': self.path
+        }).encode()
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get('Content-Length', 0))
+        body = self.rfile.read(n)
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def two_replicas():
+    servers = []
+    for _ in range(2):
+        s = ThreadingHTTPServer(('127.0.0.1', 0), _Echo)
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+        servers.append(s)
+    yield [f'http://127.0.0.1:{s.server_address[1]}' for s in servers]
+    for s in servers:
+        s.shutdown()
+
+
+def test_load_balancer_proxies_and_retries(two_replicas):
+    policy = RoundRobinPolicy()
+    # One live replica + one dead endpoint: the LB must retry onto the
+    # live one without surfacing an error.
+    policy.set_ready_replicas([two_replicas[0], 'http://127.0.0.1:1'])
+    lb = load_balancer.SkyTpuLoadBalancer('http://unused', 0, policy)
+    srv = ThreadingHTTPServer(('127.0.0.1', 0), type(
+        'H', (BaseHTTPRequestHandler,), {
+            'protocol_version': 'HTTP/1.1',
+            'log_message': lambda self, *a: None,
+            'do_GET': lambda self: lb.handle_request(self),
+            'do_POST': lambda self: lb.handle_request(self),
+        }))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    try:
+        for _ in range(4):
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/x?q=1', timeout=10) as r:
+                assert json.loads(r.read())['path'] == '/x?q=1'
+        # POST body round-trips.
+        req = urllib.request.Request(f'http://127.0.0.1:{port}/echo',
+                                     data=b'payload-bytes')
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.read() == b'payload-bytes'
+        # Requests were recorded for the autoscaler sync.
+        assert len(lb._request_timestamps) == 5
+        # No replicas at all -> 503.
+        policy.set_ready_replicas([])
+        try:
+            urllib.request.urlopen(f'http://127.0.0.1:{port}/x',
+                                   timeout=10)
+            raise AssertionError('expected 503')
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------- e2e
+
+
+@pytest.fixture
+def fast_serve(monkeypatch):
+    for k, v in {
+            'SKYTPU_SERVE_AUTOSCALER_INTERVAL': '1',
+            'SKYTPU_SERVE_PROBE_INTERVAL': '1',
+            'SKYTPU_SERVE_LB_SYNC_INTERVAL': '1',
+            'SKYTPU_SERVE_JOB_STATUS_INTERVAL': '5',
+            'SKYTPU_SERVE_UP_TIMEOUT': '120',
+    }.items():
+        monkeypatch.setenv(k, v)
+    yield
+
+
+@pytest.fixture
+def local_serve(skytpu_home, enable_local_cloud, fast_serve):
+    from skypilot_tpu import core, serve
+    yield serve
+    try:
+        serve.down(all_services=True)
+        time.sleep(2)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    for rec in state.get_clusters():
+        try:
+            core.down(rec['name'], purge=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def _service_task(min_replicas=1):
+    # A replica is a tiny stdlib HTTP server on the assigned port.
+    task = Task(
+        'echo',
+        run='python3 -m http.server $SKYTPU_SERVE_REPLICA_PORT '
+            '--bind 0.0.0.0')
+    task.set_resources(Resources(cloud='local'))
+    task.set_service(
+        SkyTpuServiceSpec(readiness_path='/', initial_delay_seconds=60,
+                          readiness_timeout_seconds=5,
+                          min_replicas=min_replicas))
+    return task
+
+
+def _wait_ready(serve, name, n, timeout=90):
+    """Wait until n replicas are READY and the service row caught up."""
+    deadline = time.time() + timeout
+    svcs = []
+    while time.time() < deadline:
+        svcs = serve.status([name])
+        if svcs:
+            ready = [r for r in svcs[0]['replicas']
+                     if r['status'] == 'READY']
+            if (len(ready) >= n and
+                    svcs[0]['status'] == ServiceStatus.READY.value):
+                return svcs[0]
+        time.sleep(1)
+    raise TimeoutError(f'{name}: {n} READY replicas not reached; '
+                       f'last: {svcs}')
+
+
+@pytest.mark.e2e
+def test_serve_end_to_end(local_serve):
+    serve = local_serve
+    name, endpoint = serve.up(_service_task(), service_name='echo-svc')
+    assert name == 'echo-svc'
+    svc = _wait_ready(serve, name, 1)
+    assert svc['status'] == ServiceStatus.READY.value
+    # Traffic flows through the LB to the replica.
+    deadline = time.time() + 30
+    while True:
+        try:
+            with urllib.request.urlopen(endpoint + '/', timeout=5) as r:
+                assert r.status == 200
+            break
+        except Exception:  # pylint: disable=broad-except
+            if time.time() > deadline:
+                raise
+            time.sleep(1)
+    # Terminate-replica is replaced by the autoscaler (service self-heals).
+    rid = svc['replicas'][0]['replica_id']
+    serve.terminate_replica(name, rid, purge=True)
+    svc = _wait_ready(serve, name, 1, timeout=90)
+    assert all(r['replica_id'] != rid or r['status'] != 'READY'
+               for r in svc['replicas'])
+    serve.down([name])
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if not serve.status([name]):
+            break
+        time.sleep(1)
+    assert not serve.status([name])
