@@ -1,0 +1,630 @@
+"""The `skytpu` command-line interface.
+
+Parity: sky/cli.py — launch/exec/status/start/stop/down/autostop/queue/
+logs/cancel/check/show-tpus/cost-report/optimize plus the `storage`,
+`jobs`, and `serve` sub-groups.  Same shape (click groups, natural
+ordering, -y confirmation bypass, CLI-flag -> Resources overrides,
+entrypoint = YAML path or inline command), TPU-first surface (`show-tpus`
+lists pod-slice shapes instead of GPU counts).
+"""
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import click
+
+from skypilot_tpu import exceptions
+
+
+class _NaturalOrderGroup(click.Group):
+    """Commands listed in definition order (parity: sky/cli.py)."""
+
+    def list_commands(self, ctx):
+        return self.commands.keys()
+
+
+def _fmt_duration(seconds: Optional[float]) -> str:
+    if not seconds:
+        return '-'
+    seconds = int(seconds)
+    if seconds < 60:
+        return f'{seconds}s'
+    if seconds < 3600:
+        return f'{seconds // 60}m'
+    if seconds < 86400:
+        return f'{seconds // 3600}h {seconds % 3600 // 60}m'
+    return f'{seconds // 86400}d {seconds % 86400 // 3600}h'
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if not ts:
+        return '-'
+    return time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(ts))
+
+
+def _table(headers: List[str], rows: List[List[Any]]) -> str:
+    cells = [[str(c) for c in row] for row in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in cells)) if cells else len(h)
+        for i, h in enumerate(headers)
+    ]
+    lines = ['  '.join(h.ljust(w) for h, w in zip(headers, widths))]
+    for row in cells:
+        lines.append('  '.join(c.ljust(w) for c, w in zip(row, widths)))
+    return '\n'.join(lines)
+
+
+def _make_task(entrypoint: tuple, name: Optional[str],
+               workdir: Optional[str], cloud: Optional[str],
+               tpus: Optional[str], cpus: Optional[str],
+               memory: Optional[str], use_spot: Optional[bool],
+               region: Optional[str], zone: Optional[str],
+               num_nodes: Optional[int], env: tuple):
+    """Entrypoint = a task YAML path or an inline command, with CLI flags
+    overriding the YAML (parity: sky/cli.py:475,704)."""
+    from skypilot_tpu import Resources, Task
+    entry = ' '.join(entrypoint).strip()
+    if entry.endswith(('.yaml', '.yml')):
+        # YAML-looking entrypoints must exist: a typo'd path silently
+        # running as a shell command would provision a cluster for it.
+        path = os.path.expanduser(entry)
+        if not os.path.isfile(path):
+            raise click.UsageError(f'Task YAML not found: {entry}')
+        task = Task.from_yaml(path)
+    else:
+        if not entry:
+            raise click.UsageError(
+                'ENTRYPOINT must be a task YAML or an inline command.')
+        task = Task(run=entry)
+    if name is not None:
+        task.name = name
+    if workdir is not None:
+        task.workdir = workdir
+    if num_nodes is not None:
+        task.num_nodes = num_nodes
+    if env:
+        task.update_envs(list(env))
+
+    override: Dict[str, Any] = {}
+    if cloud is not None:
+        override['cloud'] = cloud
+    if tpus is not None:
+        override['accelerator'] = tpus
+    if cpus is not None:
+        override['cpus'] = cpus
+    if memory is not None:
+        override['memory'] = memory
+    if use_spot is not None:
+        override['use_spot'] = use_spot
+    if region is not None:
+        override['region'] = region
+    if zone is not None:
+        override['zone'] = zone
+    if override:
+        base = list(task.resources)
+        if len(base) == 1:
+            task.set_resources(base[0].copy(**override))
+        else:
+            task.set_resources([r.copy(**override) for r in base])
+    return task
+
+
+def _resource_flags(f=None, *, include_name=True):
+    if f is None:
+        return lambda g: _resource_flags(g, include_name=include_name)
+    opts = [
+        click.option('--workdir', default=None,
+                     help='Directory synced to every host.'),
+        click.option('--cloud', default=None, help='Cloud (gcp|local).'),
+        click.option('--tpus', '--gpus', 'tpus', default=None,
+                     help='TPU slice, e.g. tpu-v5e-8, v6e-64.'),
+        click.option('--cpus', default=None, help="vCPUs, e.g. '8+'."),
+        click.option('--memory', default=None, help="GiB, e.g. '32+'."),
+        click.option('--use-spot/--no-use-spot', 'use_spot', default=None,
+                     help='Preemptible capacity.'),
+        click.option('--region', default=None),
+        click.option('--zone', default=None),
+        click.option('--num-nodes', type=int, default=None,
+                     help='Number of slices (gang width multiplier).'),
+        click.option('--env', multiple=True, help='KEY=VALUE (repeat).'),
+    ]
+    if include_name:
+        opts.insert(0, click.option('--name', '-n', default=None,
+                                    help='Task name (overrides YAML).'))
+    for opt in reversed(opts):
+        f = opt(f)
+    return f
+
+
+@click.group(cls=_NaturalOrderGroup)
+@click.version_option(None, '--version', '-v', package_name=None,
+                      message='%(prog)s %(version)s',
+                      prog_name='skytpu')
+def cli():
+    """skytpu: launch and manage tasks on TPU pod slices."""
+
+
+# ------------------------------------------------------------------ launch
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@_resource_flags
+@click.option('--detach-run', '-d', is_flag=True, default=False,
+              help='Return after job submission without tailing logs.')
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Autodown (terminate) when idle (requires -i).')
+@click.option('--retry-until-up', '-r', is_flag=True, default=False)
+@click.option('--fast', is_flag=True, default=False,
+              help='Skip provisioning/setup if the cluster is UP.')
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def launch(entrypoint, cluster, name, workdir, cloud, tpus, cpus, memory,
+           use_spot, region, zone, num_nodes, env, detach_run,
+           idle_minutes_to_autostop, down, retry_until_up, fast, dryrun,
+           yes):
+    """Provision (or reuse) a cluster and run ENTRYPOINT on it."""
+    from skypilot_tpu import execution
+    task = _make_task(entrypoint, name, workdir, cloud, tpus, cpus, memory,
+                      use_spot, region, zone, num_nodes, env)
+    if not yes and not dryrun:
+        plan = next(iter(task.resources))
+        click.confirm(
+            f'Launching task {task.name or "(unnamed)"!r} on '
+            f'{cluster or "a new cluster"} ({plan}). Proceed?',
+            default=True, abort=True)
+    job_id = execution.launch(
+        task, cluster_name=cluster, dryrun=dryrun, detach_run=detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        retry_until_up=retry_until_up, fast=fast)
+    if job_id is not None:
+        click.echo(f'Job submitted: {job_id}')
+
+
+@cli.command('exec')
+@click.argument('cluster')
+@click.argument('entrypoint', nargs=-1, required=True)
+@_resource_flags
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+def exec_cmd(cluster, entrypoint, name, workdir, cloud, tpus, cpus, memory,
+             use_spot, region, zone, num_nodes, env, detach_run):
+    """Submit a job to an existing cluster (skips provision/setup)."""
+    from skypilot_tpu import execution
+    task = _make_task(entrypoint, name, workdir, cloud, tpus, cpus, memory,
+                      use_spot, region, zone, num_nodes, env)
+    job_id = execution.exec_(task, cluster, detach_run=detach_run)
+    if job_id is not None:
+        click.echo(f'Job submitted: {job_id}')
+
+
+# ------------------------------------------------------------------ status
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True, default=False,
+              help='Reconcile against live cloud state first.')
+def status(refresh):
+    """Show clusters."""
+    from skypilot_tpu import core
+    records = core.status(refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    rows = []
+    for r in records:
+        handle = r.get('handle')
+        resources = '-'
+        if handle is not None and handle.launched_resources is not None:
+            resources = str(handle.launched_resources)
+        autostop = r.get('autostop', -1)
+        rows.append([
+            r['name'], resources,
+            r['status'].value if hasattr(r['status'], 'value') else
+            r['status'],
+            _fmt_ts(r.get('launched_at')),
+            f'{autostop}m' + ('(down)' if r.get('to_down') else '')
+            if autostop and autostop >= 0 else '-',
+        ])
+    click.echo(_table(['NAME', 'RESOURCES', 'STATUS', 'LAUNCHED',
+                       'AUTOSTOP'], rows))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--retry-until-up', '-r', is_flag=True, default=False)
+def start(cluster, retry_until_up):
+    """Restart a stopped cluster."""
+    from skypilot_tpu import core
+    core.start(cluster, retry_until_up=retry_until_up)
+    click.echo(f'Cluster {cluster!r} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters, yes):
+    """Stop cluster(s) (restartable; TPU slices usually cannot stop)."""
+    from skypilot_tpu import core
+    for name in clusters:
+        if not yes:
+            click.confirm(f'Stop cluster {name!r}?', default=True,
+                          abort=True)
+        core.stop(name)
+        click.echo(f'Cluster {name!r} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--purge', is_flag=True, default=False,
+              help='Remove local state even if cloud teardown fails.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def down(clusters, purge, yes):
+    """Terminate cluster(s)."""
+    from skypilot_tpu import core
+    for name in clusters:
+        if not yes:
+            click.confirm(f'Terminate cluster {name!r}?', default=True,
+                          abort=True)
+        core.down(name, purge=purge)
+        click.echo(f'Cluster {name!r} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, default=None,
+              help='Idle minutes before autostop; -1 cancels.')
+@click.option('--cancel', 'cancel_flag', is_flag=True, default=False)
+@click.option('--down', is_flag=True, default=False,
+              help='Terminate instead of stop when idle.')
+def autostop(cluster, idle_minutes, cancel_flag, down):
+    """Schedule stop/terminate-when-idle for a cluster."""
+    from skypilot_tpu import core
+    if cancel_flag:
+        idle_minutes = -1
+    if idle_minutes is None:
+        raise click.UsageError('Provide --idle-minutes or --cancel.')
+    core.autostop(cluster, idle_minutes, down_after_idle=down)
+    if idle_minutes < 0:
+        click.echo(f'Autostop cancelled on {cluster!r}.')
+    else:
+        click.echo(f'{cluster!r} will auto{"down" if down else "stop"} '
+                   f'after {idle_minutes} idle minutes.')
+
+
+# -------------------------------------------------------------------- jobs
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Show a cluster's job queue."""
+    from skypilot_tpu import core
+    jobs = core.queue(cluster)
+    if not jobs:
+        click.echo('No jobs.')
+        return
+    rows = [[
+        j['job_id'],
+        j.get('job_name') or '-',
+        j.get('username') or '-',
+        _fmt_ts(j.get('submitted_at')),
+        j['status'],
+        _fmt_duration((j.get('end_at') or time.time()) -
+                      j['start_at'] if j.get('start_at') else None),
+    ] for j in jobs]
+    click.echo(_table(['ID', 'NAME', 'USER', 'SUBMITTED', 'STATUS',
+                       'DURATION'], rows))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int, required=False, default=None)
+@click.option('--no-follow', is_flag=True, default=False)
+@click.option('--sync-down', '-s', is_flag=True, default=False,
+              help='Download logs instead of streaming.')
+def logs(cluster, job_id, no_follow, sync_down):
+    """Tail (or download) a job's logs."""
+    from skypilot_tpu import core
+    if sync_down:
+        path = core.download_logs(cluster, job_id)
+        click.echo(f'Logs synced to {path}')
+        return
+    raise SystemExit(
+        core.tail_logs(cluster, job_id=job_id, follow=not no_follow))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs, yes):
+    """Cancel job(s) on a cluster."""
+    from skypilot_tpu import core
+    if not job_ids and not all_jobs:
+        raise click.UsageError('Provide JOB_IDS or --all.')
+    if not yes:
+        what = 'all jobs' if all_jobs else f'job(s) {list(job_ids)}'
+        click.confirm(f'Cancel {what} on {cluster!r}?', default=True,
+                      abort=True)
+    cancelled = core.cancel(cluster, job_ids=list(job_ids) or None,
+                            all_jobs=all_jobs)
+    click.echo(f'Cancelled: {cancelled or "none"}')
+
+
+# ----------------------------------------------------------- environment
+
+
+@cli.command()
+def check():
+    """Verify cloud credentials and enable clouds."""
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check()
+    if not enabled:
+        raise SystemExit(1)
+
+
+@cli.command('show-tpus')
+@click.argument('accelerator', required=False, default=None)
+@click.option('--all-regions', is_flag=True, default=False,
+              help='Show per-zone availability and pricing.')
+def show_tpus(accelerator, all_regions):
+    """List TPU slice shapes, chips, and $/hr (analog of show-gpus)."""
+    from skypilot_tpu import catalog
+    if accelerator and all_regions:
+        rows = []
+        for region, zone in catalog.get_regions_zones(accelerator):
+            od = catalog.get_hourly_cost(accelerator, use_spot=False,
+                                         region=region, zone=zone)
+            try:
+                spot = catalog.get_hourly_cost(accelerator, use_spot=True,
+                                               region=region, zone=zone)
+                spot_s = f'{spot:.2f}'
+            except exceptions.SkyTpuError:
+                spot_s = '-'
+            rows.append([accelerator, region, zone, f'{od:.2f}', spot_s])
+        click.echo(_table(['TPU', 'REGION', 'ZONE', '$/HR', 'SPOT $/HR'],
+                          rows))
+        return
+    listing = catalog.list_accelerators(name_filter=accelerator)
+    rows = []
+    for gen in sorted(listing):
+        for info in listing[gen]:
+            od = catalog.get_hourly_cost(info.accelerator, use_spot=False)
+            rows.append([
+                info.accelerator, info.chips, info.hosts,
+                f'{info.total_tflops_bf16:.0f}', f'{od:.2f}'
+            ])
+    click.echo(_table(['TPU', 'CHIPS', 'HOSTS', 'BF16 TFLOPS', '$/HR'],
+                      rows))
+
+
+@cli.command('cost-report')
+def cost_report():
+    """Accumulated cost per cluster (including terminated ones)."""
+    from skypilot_tpu import core
+    rows = [[
+        r['name'],
+        str(r['resources']),
+        _fmt_duration(r['duration_seconds']),
+        f'${r["cost"]:.2f}',
+    ] for r in core.cost_report()]
+    if not rows:
+        click.echo('No usage recorded.')
+        return
+    click.echo(_table(['NAME', 'RESOURCES', 'DURATION', 'COST'], rows))
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--minimize', type=click.Choice(['cost', 'time']),
+              default='cost')
+@_resource_flags
+def optimize(entrypoint, minimize, name, workdir, cloud, tpus, cpus,
+             memory, use_spot, region, zone, num_nodes, env):
+    """Show the placement plan for a task without launching it."""
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import optimizer
+    task = _make_task(entrypoint, name, workdir, cloud, tpus, cpus, memory,
+                      use_spot, region, zone, num_nodes, env)
+    with dag_lib.Dag() as dag:
+        dag.add(task)
+    optimizer.optimize(
+        dag, minimize=optimizer.OptimizeTarget(minimize))
+
+
+# ------------------------------------------------------------------ storage
+
+
+@cli.group(cls=_NaturalOrderGroup)
+def storage():
+    """Manage framework-created buckets."""
+
+
+@storage.command('ls')
+def storage_ls():
+    from skypilot_tpu import core
+    rows = [[s['name'], s.get('source') or '-', s['mode'],
+             _fmt_ts(s.get('launched_at'))] for s in core.storage_ls()]
+    if not rows:
+        click.echo('No storage.')
+        return
+    click.echo(_table(['NAME', 'SOURCE', 'MODE', 'CREATED'], rows))
+
+
+@storage.command('delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(names, yes):
+    from skypilot_tpu import core
+    for n in names:
+        if not yes:
+            click.confirm(f'Delete storage {n!r}?', default=True,
+                          abort=True)
+        core.storage_delete(n)
+        click.echo(f'Storage {n!r} deleted.')
+
+
+# --------------------------------------------------------------- jobs group
+
+
+@cli.group(cls=_NaturalOrderGroup)
+def jobs():
+    """Managed jobs with automatic preemption recovery."""
+
+
+@jobs.command('launch')
+@click.argument('entrypoint', nargs=-1, required=True)
+@_resource_flags
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_launch(entrypoint, name, workdir, cloud, tpus, cpus, memory,
+                use_spot, region, zone, num_nodes, env, detach_run, yes):
+    """Launch a managed job (controller supervises + recovers it)."""
+    from skypilot_tpu import jobs as jobs_lib
+    task = _make_task(entrypoint, name, workdir, cloud, tpus, cpus, memory,
+                      use_spot, region, zone, num_nodes, env)
+    if not yes:
+        click.confirm(f'Launch managed job {task.name or "(unnamed)"!r}?',
+                      default=True, abort=True)
+    job_id = jobs_lib.launch(task, name=name, detach_run=detach_run)
+    click.echo(f'Managed job submitted: {job_id}')
+
+
+@jobs.command('queue')
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def jobs_queue(refresh):
+    """Show all managed jobs."""
+    from skypilot_tpu import jobs as jobs_lib
+    from skypilot_tpu.jobs import utils as jobs_utils
+    rows = jobs_lib.queue(refresh=refresh)
+    if not rows:
+        click.echo('No managed jobs.')
+        return
+    click.echo(jobs_utils.format_job_queue(rows))
+
+
+@jobs.command('cancel')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--name', '-n', default=None)
+@click.option('--all', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_cancel(job_ids, name, all_jobs, yes):
+    from skypilot_tpu import jobs as jobs_lib
+    if not job_ids and name is None and not all_jobs:
+        raise click.UsageError('Provide JOB_IDS, --name, or --all.')
+    if not yes:
+        click.confirm('Cancel managed job(s)?', default=True, abort=True)
+    cancelled = jobs_lib.cancel(job_ids=list(job_ids) or None, name=name,
+                                all_jobs=all_jobs)
+    click.echo(f'Cancelled: {cancelled or "none"}')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int, required=False, default=None)
+@click.option('--name', '-n', default=None)
+@click.option('--no-follow', is_flag=True, default=False)
+def jobs_logs(job_id, name, no_follow):
+    from skypilot_tpu import jobs as jobs_lib
+    raise SystemExit(
+        jobs_lib.tail_logs(name=name, job_id=job_id,
+                           follow=not no_follow))
+
+
+# -------------------------------------------------------------- serve group
+
+
+@cli.group(cls=_NaturalOrderGroup)
+def serve():
+    """Autoscaled serving with HTTP load balancing."""
+
+
+@serve.command('up')
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--service-name', '-n', default=None)
+@_resource_flags(include_name=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_up(entrypoint, service_name, workdir, cloud, tpus, cpus,
+             memory, use_spot, region, zone, num_nodes, env, yes):
+    """Bring up a service from a task YAML with a `service:` section."""
+    from skypilot_tpu import serve as serve_lib
+    task = _make_task(entrypoint, None, workdir, cloud, tpus, cpus, memory,
+                      use_spot, region, zone, num_nodes, env)
+    if not yes:
+        click.confirm(f'Bring up service {service_name or task.name!r}?',
+                      default=True, abort=True)
+    svc_name, endpoint = serve_lib.up(task, service_name)
+    click.echo(f'Service {svc_name!r} is initializing; endpoint: '
+               f'{endpoint}')
+
+
+@serve.command('status')
+def serve_status():
+    from skypilot_tpu import serve as serve_lib
+    from skypilot_tpu.serve import serve_utils
+    services = serve_lib.status()
+    if not services:
+        click.echo('No services.')
+        return
+    click.echo(serve_utils.format_service_table(services))
+
+
+@serve.command('update')
+@click.argument('service_name')
+@click.argument('entrypoint', nargs=-1, required=True)
+@_resource_flags(include_name=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_update(service_name, entrypoint, workdir, cloud, tpus,
+                 cpus, memory, use_spot, region, zone, num_nodes, env,
+                 yes):
+    """Rolling-update a service to a new task/spec."""
+    from skypilot_tpu import serve as serve_lib
+    task = _make_task(entrypoint, None, workdir, cloud, tpus, cpus, memory,
+                      use_spot, region, zone, num_nodes, env)
+    if not yes:
+        click.confirm(f'Update service {service_name!r}?', default=True,
+                      abort=True)
+    version = serve_lib.update(task, service_name)
+    click.echo(f'Service {service_name!r} rolling to version {version}.')
+
+
+@serve.command('down')
+@click.argument('service_names', nargs=-1)
+@click.option('--all', 'all_services', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_names, all_services, purge, yes):
+    from skypilot_tpu import serve as serve_lib
+    if not service_names and not all_services:
+        raise click.UsageError('Provide SERVICE_NAMES or --all.')
+    if not yes:
+        what = 'ALL services' if all_services else ', '.join(service_names)
+        click.confirm(f'Terminate {what}?', default=True, abort=True)
+    terminated = serve_lib.down(list(service_names) or None,
+                                all_services=all_services, purge=purge)
+    click.echo(f'Terminating: {", ".join(terminated) or "none"}')
+
+
+@serve.command('logs')
+@click.argument('service_name')
+@click.option('--replica-id', type=int, default=None,
+              help='Stream one replica instead of the controller.')
+@click.option('--no-follow', is_flag=True, default=False)
+def serve_logs(service_name, replica_id, no_follow):
+    from skypilot_tpu import serve as serve_lib
+    raise SystemExit(
+        serve_lib.tail_logs(service_name, replica_id=replica_id,
+                            follow=not no_follow))
+
+
+def main() -> None:
+    try:
+        cli.main(standalone_mode=True)
+    except exceptions.SkyTpuError as e:
+        raise SystemExit(f'skytpu: {e}') from e
+
+
+if __name__ == '__main__':
+    main()

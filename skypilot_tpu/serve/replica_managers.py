@@ -72,9 +72,13 @@ class ReplicaManager:
             return
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.SHUTTING_DOWN)
+        # If this replica's launch is still in flight, the teardown must
+        # wait for it: tearing down mid-provision would leave the cluster
+        # the launch thread finishes creating untracked and running.
+        launch_future = self._inflight.get(replica_id)
         self._inflight[replica_id] = self._pool.submit(
             self._terminate_replica, replica_id, rec['cluster_name'], purge,
-            final_status)
+            final_status, launch_future)
         logger.info('[%s] scale_down replica %d', self.service_name,
                     replica_id)
 
@@ -131,9 +135,12 @@ class ReplicaManager:
 
     def _terminate_replica(self, replica_id: int, cluster: str,
                            purge: bool,
-                           final_status: Optional[ReplicaStatus] = None
-                           ) -> None:
+                           final_status: Optional[ReplicaStatus] = None,
+                           launch_future: Optional[
+                               concurrent.futures.Future] = None) -> None:
         from skypilot_tpu import core
+        if launch_future is not None:
+            concurrent.futures.wait([launch_future])
         try:
             core.down(cluster, purge=True)
         except Exception as e:  # pylint: disable=broad-except

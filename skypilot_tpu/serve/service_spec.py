@@ -101,7 +101,6 @@ class SkyTpuServiceSpec:
         if 'base_ondemand_fallback_replicas' in policy:
             kwargs['base_ondemand_fallback_replicas'] = int(
                 policy['base_ondemand_fallback_replicas'])
-            kwargs['use_ondemand_fallback'] = True
         if 'dynamic_ondemand_fallback' in policy:
             kwargs['use_ondemand_fallback'] = bool(
                 policy['dynamic_ondemand_fallback'])
@@ -129,9 +128,11 @@ class SkyTpuServiceSpec:
             policy['target_qps_per_replica'] = self.target_qps_per_replica
             policy['upscale_delay_seconds'] = self.upscale_delay_seconds
             policy['downscale_delay_seconds'] = self.downscale_delay_seconds
-        if self.use_ondemand_fallback:
+        if self.base_ondemand_fallback_replicas > 0:
             policy['base_ondemand_fallback_replicas'] = (
                 self.base_ondemand_fallback_replicas)
+        if self.use_ondemand_fallback:
+            policy['dynamic_ondemand_fallback'] = True
         cfg: Dict[str, Any] = {
             'readiness_probe': probe,
             'replica_policy': policy,

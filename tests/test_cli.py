@@ -1,0 +1,135 @@
+"""CLI surface tests (parity role: tests/test_cli.py — argument surface +
+dryrun flows, no clouds), plus one e2e launch→queue→logs→down flow on the
+local cloud through the real CLI entrypoints.
+"""
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from skypilot_tpu import cli, state
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_help_lists_all_commands(runner):
+    result = runner.invoke(cli.cli, ['--help'])
+    assert result.exit_code == 0
+    for cmd in ('launch', 'exec', 'status', 'start', 'stop', 'down',
+                'autostop', 'queue', 'logs', 'cancel', 'check',
+                'show-tpus', 'cost-report', 'optimize', 'storage', 'jobs',
+                'serve'):
+        assert cmd in result.output
+
+
+def test_show_tpus(runner):
+    result = runner.invoke(cli.cli, ['show-tpus'])
+    assert result.exit_code == 0
+    assert 'tpu-v5e-8' in result.output
+    assert 'CHIPS' in result.output
+    result = runner.invoke(cli.cli, ['show-tpus', 'v6e'])
+    assert result.exit_code == 0
+    assert 'tpu-v6e-64' in result.output
+    assert 'tpu-v2-8' not in result.output
+    result = runner.invoke(cli.cli,
+                           ['show-tpus', 'tpu-v5e-8', '--all-regions'])
+    assert result.exit_code == 0
+    assert 'SPOT $/HR' in result.output
+
+
+def test_status_empty(runner):
+    result = runner.invoke(cli.cli, ['status'])
+    assert result.exit_code == 0
+    assert 'No existing clusters' in result.output
+
+
+def test_launch_dryrun_yaml(runner, tmp_path, enable_local_cloud):
+    yaml_path = tmp_path / 'task.yaml'
+    yaml_path.write_text('name: t\nrun: echo hi\n'
+                         'resources:\n  cloud: local\n')
+    result = runner.invoke(cli.cli,
+                           ['launch', str(yaml_path), '--dryrun', '-y'])
+    assert result.exit_code == 0, result.output
+
+
+def test_launch_flag_overrides_resources(runner, tmp_path,
+                                         enable_local_cloud):
+    yaml_path = tmp_path / 'task.yaml'
+    yaml_path.write_text('run: echo hi\nresources:\n  cloud: gcp\n'
+                         '  accelerator: tpu-v5e-8\n')
+    # --tpus flag overrides the YAML's accelerator; --dryrun prints plan.
+    result = runner.invoke(cli.cli, [
+        'launch', str(yaml_path), '--tpus', 'tpu-v6e-8', '--use-spot',
+        '--dryrun', '-y'
+    ])
+    assert result.exit_code == 0, result.output
+
+
+def test_launch_requires_entrypoint(runner):
+    result = runner.invoke(cli.cli, ['launch'])
+    assert result.exit_code != 0
+
+
+def test_optimize_prints_plan(runner, tmp_path, enable_local_cloud):
+    yaml_path = tmp_path / 'task.yaml'
+    yaml_path.write_text('run: echo hi\nresources:\n'
+                         '  accelerator: tpu-v5e-8\n')
+    result = runner.invoke(cli.cli, ['optimize', str(yaml_path)])
+    assert result.exit_code == 0, result.output
+    assert 'tpu-v5e-8' in result.output
+
+
+def test_queue_missing_cluster_fails_cleanly(runner):
+    result = runner.invoke(cli.cli, ['queue', 'nope'])
+    assert result.exit_code != 0
+
+
+def test_cancel_requires_ids_or_all(runner):
+    result = runner.invoke(cli.cli, ['cancel', 'c'])
+    assert result.exit_code != 0
+    assert '--all' in result.output
+
+
+def test_autostop_requires_minutes_or_cancel(runner):
+    result = runner.invoke(cli.cli, ['autostop', 'c'])
+    assert result.exit_code != 0
+
+
+def test_storage_ls_empty(runner):
+    result = runner.invoke(cli.cli, ['storage', 'ls'])
+    assert result.exit_code == 0
+    assert 'No storage' in result.output
+
+
+@pytest.mark.e2e
+def test_cli_end_to_end_local(runner, enable_local_cloud):
+    try:
+        result = runner.invoke(cli.cli, [
+            'launch', 'echo cli-says-hi', '-c', 'clit', '--cloud', 'local',
+            '-y', '-d'
+        ])
+        assert result.exit_code == 0, result.output
+        assert 'Job submitted: 1' in result.output
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            result = runner.invoke(cli.cli, ['queue', 'clit'])
+            if 'SUCCEEDED' in result.output:
+                break
+            time.sleep(0.5)
+        assert 'SUCCEEDED' in result.output
+        result = runner.invoke(cli.cli, ['logs', 'clit', '1', '--no-follow'])
+        assert 'cli-says-hi' in result.output
+        result = runner.invoke(cli.cli, ['status'])
+        assert 'clit' in result.output and 'UP' in result.output
+        result = runner.invoke(cli.cli, ['autostop', 'clit', '-i', '30'])
+        assert result.exit_code == 0, result.output
+        result = runner.invoke(cli.cli, ['autostop', 'clit', '--cancel'])
+        assert result.exit_code == 0, result.output
+        result = runner.invoke(cli.cli, ['cost-report'])
+        assert 'clit' in result.output
+    finally:
+        runner.invoke(cli.cli, ['down', 'clit', '-y', '--purge'])
+    assert state.get_cluster_from_name('clit') is None

@@ -53,6 +53,27 @@ def test_service_spec_yaml_roundtrip():
     assert spec3 == spec
 
 
+def test_service_spec_fallback_roundtrip():
+    """base_ondemand_fallback_replicas without dynamic fallback must
+    survive to_json/from_json (serve.update ships the spec as JSON)."""
+    spec = SkyTpuServiceSpec.from_yaml_config({
+        'readiness_probe': '/',
+        'replica_policy': {
+            'min_replicas': 1, 'max_replicas': 3,
+            'target_qps_per_replica': 1.0,
+            'base_ondemand_fallback_replicas': 2,
+            'dynamic_ondemand_fallback': False,
+        },
+    })
+    assert spec.base_ondemand_fallback_replicas == 2
+    assert not spec.use_ondemand_fallback
+    spec2 = SkyTpuServiceSpec.from_json(spec.to_json())
+    assert spec2 == spec
+    # Still routes to the fallback autoscaler (base > 0).
+    assert isinstance(autoscalers.Autoscaler.make(spec2),
+                      autoscalers.FallbackRequestRateAutoscaler)
+
+
 def test_service_spec_shorthand_and_validation():
     spec = SkyTpuServiceSpec.from_yaml_config({
         'readiness_probe': '/healthz', 'replicas': 3
