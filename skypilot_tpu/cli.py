@@ -225,7 +225,7 @@ def status(refresh):
             r['status'],
             _fmt_ts(r.get('launched_at')),
             f'{autostop}m' + ('(down)' if r.get('to_down') else '')
-            if autostop and autostop >= 0 else '-',
+            if autostop is not None and autostop >= 0 else '-',
         ])
     click.echo(_table(['NAME', 'RESOURCES', 'STATUS', 'LAUNCHED',
                        'AUTOSTOP'], rows))

@@ -20,7 +20,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from skypilot_tpu.ops import flash_attention
+from skypilot_tpu.ops import sequence_parallel_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,7 +124,10 @@ class Attention(nn.Module):
             k, ('activation_batch', 'activation_kv', 'activation_seq', None))
         v = nn.with_logical_constraint(
             v, ('activation_batch', 'activation_kv', 'activation_seq', None))
-        out = flash_attention(q, k, v, causal=True)
+        # Transparently sequence-parallel: when the active mesh has a
+        # 'seq' axis >1 this becomes ring attention over ICI neighbors
+        # (ops/ring_attention.py); otherwise plain (pallas) flash.
+        out = sequence_parallel_attention(q, k, v, causal=True)
         out = jnp.transpose(out, (0, 2, 1, 3))  # [B, S, H, D]
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,

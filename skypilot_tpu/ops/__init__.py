@@ -2,5 +2,15 @@
 reference fallbacks (used on CPU and as numerical ground truth in tests).
 """
 from skypilot_tpu.ops.flash_attention import flash_attention
+from skypilot_tpu.ops.ring_attention import (ring_attention,
+                                             sequence_parallel_attention,
+                                             seq_parallel_degree,
+                                             ulysses_attention)
 
-__all__ = ['flash_attention']
+__all__ = [
+    'flash_attention',
+    'ring_attention',
+    'ulysses_attention',
+    'sequence_parallel_attention',
+    'seq_parallel_degree',
+]

@@ -1,0 +1,203 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence parallelism anywhere (SURVEY.md §2.9: grep
+over sky/, examples/, llm/ finds none) — long context is delegated to the
+workload.  Here it is a first-class framework capability: make a mesh with
+``seq > 1`` and attention transparently becomes collective.
+
+Two strategies, both riding ICI:
+
+  ring    — K/V shards rotate around the 'seq' axis with ``ppermute`` while
+            each device keeps its Q shard resident; partial results merge
+            with the online-softmax (log-sum-exp) rule.  HBM cost per device
+            is O(S/n · d); comm is n-1 neighbor hops that XLA overlaps with
+            the chunk matmuls (the python loop is unrolled, so each
+            ppermute is independent of the previous chunk's FLOPs).
+  ulysses — ``all_to_all`` re-shards [heads ↔ seq]: each device gets the
+            FULL sequence for heads/n heads, runs ordinary (pallas flash)
+            attention locally, and all-to-alls back.  Cheaper comm volume
+            than ring for moderate S, but caps the seq-parallel degree at
+            num_kv_heads.
+
+Both are called inside ``shard_map`` over the active mesh; model code does
+not change (models route through ``sequence_parallel_attention`` when the
+active mesh's 'seq' axis is >1).
+"""
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.ops.flash_attention import flash_attention
+
+_NEG_INF = -1e30
+
+
+def _chunk_scores(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
+    """Grouped (GQA) scores.  q: [B, Hq, Sq, D], k: [B, Hkv, Skv, D]
+    -> [B, Hkv, G, Sq, Skv] in f32."""
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    qr = q.reshape(b, hkv, group, sq, d).astype(jnp.float32)
+    return jnp.einsum('bhgqd,bhkd->bhgqk', qr * scale, k.astype(jnp.float32))
+
+
+def _ring_step(q, k, v, q_pos, kv_pos, acc, m, l, *, causal, scale):
+    """Merge one visiting KV chunk into the online-softmax state.
+
+    acc: [B, Hkv, G, Sq, D] unnormalised numerator (f32)
+    m:   [B, Hkv, G, Sq]    running row max
+    l:   [B, Hkv, G, Sq]    running denominator
+    """
+    s = _chunk_scores(q, k, scale)                       # [B,Hkv,G,Sq,Skv]
+    if causal:
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    b, hkv, g, sq, _ = s.shape
+    pv = jnp.einsum('bhgqk,bhkd->bhgqd', p, v.astype(jnp.float32))
+    acc_new = acc * correction[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   axis_name: str = 'seq',
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over a named mesh axis.  Call inside shard_map.
+
+    q: [B, Hq, Sq, D] local query shard (global seq = Sq * axis_size,
+    contiguous blocks in axis-index order — GSPMD's block sharding).
+    k/v: [B, Hkv, Sq, D] local KV shards.  Returns the local output shard.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    skv = k.shape[2]
+    q_pos = my * sq + jnp.arange(sq)
+
+    acc = jnp.zeros((b, hkv, group, sq, d), jnp.float32)
+    m = jnp.full((b, hkv, group, sq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hkv, group, sq), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    step_fn = jax.checkpoint(
+        functools.partial(_ring_step, causal=causal, scale=scale))
+    for step in range(n):
+        src = (my - step) % n          # whose KV shard we hold right now
+        kv_pos = src * skv + jnp.arange(skv)
+        acc, m, l = step_fn(q, k, v, q_pos, kv_pos, acc, m, l)
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(b, hq, sq, d).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array,
+                      k: jax.Array,
+                      v: jax.Array,
+                      axis_name: str = 'seq',
+                      causal: bool = True,
+                      scale: Optional[float] = None) -> jax.Array:
+    """Ulysses (DeepSpeed-style) sequence parallelism: all-to-all swaps the
+    sharded dim from seq to heads, local full-sequence flash attention, and
+    all-to-all back.  Requires num_kv_heads % axis_size == 0.
+    """
+    n = lax.axis_size(axis_name)
+    if q.shape[1] % n or k.shape[1] % n:
+        raise ValueError(
+            f'ulysses needs head counts divisible by seq axis ({n}): '
+            f'Hq={q.shape[1]} Hkv={k.shape[1]}')
+    # [B, H, S/n, D] -> [B, H/n, S, D]
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name,
+                            split_axis=1, concat_axis=2, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=2,
+                          concat_axis=1, tiled=True)
+
+
+def _active_mesh() -> Optional[jax.sharding.Mesh]:
+    # thread_resources lives in a private module; guard the import so a
+    # jax upgrade degrades to "no seq parallelism unless mesh is passed
+    # explicitly" instead of breaking every attention call.
+    try:
+        from jax._src import mesh as mesh_lib
+        mesh = mesh_lib.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        return None
+    return None if mesh.empty else mesh
+
+
+def _shapes_divide(q: jax.Array, k: jax.Array,
+                   mesh: jax.sharding.Mesh) -> bool:
+    """True when [B, H, S, D] q/k block-shard cleanly over the mesh."""
+    size = dict(mesh.shape)
+    batch = size.get('data', 1) * size.get('fsdp', 1)
+    tensor = size.get('tensor', 1)
+    seq = size.get('seq', 1)
+    return (q.shape[0] % batch == 0 and q.shape[1] % tensor == 0 and
+            k.shape[1] % tensor == 0 and q.shape[2] % seq == 0)
+
+
+def seq_parallel_degree(mesh: Optional[jax.sharding.Mesh] = None) -> int:
+    """Size of the 'seq' axis in the active (or given) mesh; 1 if none."""
+    mesh = mesh if mesh is not None else _active_mesh()
+    if mesh is None or 'seq' not in mesh.shape:
+        return 1
+    return mesh.shape['seq']
+
+
+def sequence_parallel_attention(q: jax.Array,
+                                k: jax.Array,
+                                v: jax.Array,
+                                causal: bool = True,
+                                scale: Optional[float] = None,
+                                mode: str = 'ring',
+                                mesh: Optional[jax.sharding.Mesh] = None
+                                ) -> jax.Array:
+    """Attention with the seq dim sharded over the mesh's 'seq' axis.
+
+    Callable inside jit: wraps ring/ulysses in shard_map over the active
+    mesh.  Inputs are GLOBAL [B, H, S, D] arrays (GSPMD keeps them sharded;
+    shard_map hands each device its block).  Falls back to plain flash
+    attention when the mesh has no seq parallelism.
+    """
+    mesh = mesh if mesh is not None else _active_mesh()
+    p = jax.sharding.PartitionSpec
+    if mesh is not None and not _shapes_divide(q, k, mesh):
+        # Shapes (e.g. the batch-1 sample used by model.init) can't be
+        # block-sharded over this mesh; the math is identical either way.
+        mesh = None
+    degree = 1 if mesh is None else seq_parallel_degree(mesh)
+    if degree == 1:
+        if mesh is None:
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        # No seq parallelism, but a mesh is active: run flash per-shard
+        # under shard_map so the pallas kernel partitions over the
+        # batch/tensor axes instead of relying on GSPMD rules for
+        # pallas_call (seq stays replicated within each shard).
+        spec = p(('data', 'fsdp'), 'tensor', None, None)
+        fn = functools.partial(flash_attention, causal=causal, scale=scale)
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec)(q, k, v)
+    inner = ring_attention if mode == 'ring' else ulysses_attention
+    fn = functools.partial(inner, axis_name='seq', causal=causal,
+                           scale=scale)
+    spec = p(('data', 'fsdp'), 'tensor', 'seq', None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)

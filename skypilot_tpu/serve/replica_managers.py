@@ -123,15 +123,20 @@ class ReplicaManager:
             ip = info.head.external_ip or info.head.internal_ip
             serve_state.set_replica_endpoint(self.service_name, replica_id,
                                             f'http://{ip}:{port}')
-            serve_state.set_replica_status(self.service_name, replica_id,
-                                           ReplicaStatus.STARTING)
+            # CAS: if scale_down won the race while we were launching, the
+            # record is SHUTTING_DOWN and must stay that way (the queued
+            # _terminate_replica owns it now).
+            serve_state.set_replica_status(
+                self.service_name, replica_id, ReplicaStatus.STARTING,
+                unless=ReplicaStatus.SHUTTING_DOWN)
         except Exception as e:  # pylint: disable=broad-except
             logger.error('[%s] replica %d launch failed: %s',
                          self.service_name, replica_id, e)
             logger.debug('%s', traceback.format_exc())
             serve_state.set_replica_status(
                 self.service_name, replica_id,
-                ReplicaStatus.FAILED_PROVISION, str(e))
+                ReplicaStatus.FAILED_PROVISION, str(e),
+                unless=ReplicaStatus.SHUTTING_DOWN)
 
     def _terminate_replica(self, replica_id: int, cluster: str,
                            purge: bool,

@@ -178,7 +178,12 @@ def remove_replica(service_name: str, replica_id: int) -> None:
 
 def set_replica_status(service_name: str, replica_id: int,
                        status: ReplicaStatus,
-                       failure_reason: Optional[str] = None) -> None:
+                       failure_reason: Optional[str] = None,
+                       unless: Optional[ReplicaStatus] = None) -> bool:
+    """Update a replica's status.  With `unless`, the write is an atomic
+    compare-and-set that is skipped when the row currently holds that
+    status (e.g. a launch completing after scale_down must not overwrite
+    SHUTTING_DOWN).  Returns True iff a row was updated."""
     fields: Dict[str, Any] = {'status': status.value}
     if status == ReplicaStatus.READY:
         fields['ready_at'] = time.time()
@@ -186,11 +191,14 @@ def set_replica_status(service_name: str, replica_id: int,
     if failure_reason is not None:
         fields['failure_reason'] = failure_reason[:2000]
     sets = ', '.join(f'{k}=?' for k in fields)
+    where = 'WHERE service_name=? AND replica_id=?'
+    args = list(fields.values()) + [service_name, replica_id]
+    if unless is not None:
+        where += ' AND status != ?'
+        args.append(unless.value)
     with _db() as conn:
-        conn.execute(
-            f'UPDATE replicas SET {sets} '
-            'WHERE service_name=? AND replica_id=?',
-            list(fields.values()) + [service_name, replica_id])
+        cur = conn.execute(f'UPDATE replicas SET {sets} {where}', args)
+        return cur.rowcount > 0
 
 
 def set_replica_endpoint(service_name: str, replica_id: int,
