@@ -619,6 +619,57 @@ def serve_logs(service_name, replica_id, no_follow):
                             follow=not no_follow))
 
 
+# -------------------------------------------------------------- infer group
+
+
+@cli.group()
+def infer():
+    """Run the built-in inference engine (JetStream-analog)."""
+
+
+@infer.command('serve')
+@click.option('--model', default='llama-1b', help='Registered model name.')
+@click.option('--port', default=8100, type=int)
+@click.option('--host', default='0.0.0.0')
+@click.option('--num-slots', default=8, type=int,
+              help='Concurrent decode slots (continuous batching width).')
+@click.option('--max-cache-len', default=2048, type=int)
+@click.option('--tokenizer', default=None, help='HF tokenizer (optional).')
+@click.option('--eos-id', default=None, type=int,
+              help='Stop token (defaults to the tokenizer\'s EOS).')
+def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
+                eos_id):
+    """Start the HTTP inference server on this host."""
+    from skypilot_tpu.infer import server as infer_server
+    click.echo(f'serving {model} on {host}:{port}')
+    infer_server.run(model=model, host=host, port=port,
+                     num_slots=num_slots, max_cache_len=max_cache_len,
+                     tokenizer_name=tokenizer, eos_id=eos_id)
+
+
+@infer.command('bench')
+@click.option('--model', default='llama-1b')
+@click.option('--num-requests', default=32, type=int)
+@click.option('--prompt-len', default=128, type=int)
+@click.option('--new-tokens', default=64, type=int)
+@click.option('--num-slots', default=8, type=int)
+@click.option('--max-cache-len', default=2048, type=int)
+def infer_bench(model, num_requests, prompt_len, new_tokens, num_slots,
+                max_cache_len):
+    """Benchmark the engine (req/s, tok/s, TTFT) with synthetic prompts."""
+    import json as json_lib
+
+    from skypilot_tpu.infer import InferConfig, InferenceEngine
+    from skypilot_tpu.models import get_model_config
+    cfg = InferConfig(model=model, num_slots=num_slots,
+                      max_cache_len=max_cache_len)
+    engine = InferenceEngine(get_model_config(model), cfg)
+    metrics = engine.benchmark(num_requests=num_requests,
+                               prompt_len=prompt_len,
+                               new_tokens=new_tokens)
+    click.echo(json_lib.dumps(metrics))
+
+
 def main() -> None:
     try:
         cli.main(standalone_mode=True)

@@ -1,0 +1,11 @@
+"""TPU-native inference engine (JetStream-analog serving runtime).
+
+The reference serves LLMs by launching external engines (vLLM on GPUs,
+JetStream on TPUs — examples/tpu/v6e/serve-llama2-7b.yaml); here the
+engine is part of the framework: slotted KV cache, bucketed prefill,
+jitted single-token decode over the whole batch, continuous batching.
+"""
+from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
+                                       Request, RequestResult)
+
+__all__ = ['InferConfig', 'InferenceEngine', 'Request', 'RequestResult']

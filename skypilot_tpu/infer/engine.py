@@ -1,0 +1,367 @@
+"""Continuous-batching inference engine.
+
+Architecture (TPU-first, JetStream-shaped):
+
+- **Slotted KV cache**: one [num_slots, Hkv, max_cache_len, D] pair per
+  layer, allocated once.  A request occupies a slot from prefill until
+  EOS/max-tokens, then the slot is recycled — decode batch shape never
+  changes, so the decode step compiles exactly once.
+- **Bucketed prefill**: prompts are right-padded to a small set of bucket
+  lengths, so there are O(#buckets) prefill compilations.  Prefill runs
+  the full forward through the same cached-attention path and its KV rows
+  are inserted into the slot with one dynamic_update_slice per layer.
+- **Jitted decode**: one token for ALL slots per step ([B, 1] tokens),
+  cache buffers donated so XLA updates them in place.  Sampling (greedy /
+  temperature) happens on-device; only the [B] int32 token vector comes
+  back to the host per step.
+- **Continuous batching**: the scheduler fills free slots from the pending
+  queue between decode steps — no stop-the-world batching.
+
+Role parity: replaces the reference's delegation to vLLM/JetStream
+(llm/vllm/, examples/tpu/v6e/serve-llama2-7b.yaml); the serve plane's
+replicas run this engine via `python -m skypilot_tpu.infer.server`.
+"""
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models.llama import Llama, LlamaConfig, init_cache
+
+DEFAULT_PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+
+
+@dataclasses.dataclass
+class InferConfig:
+    model: str = 'llama-1b'
+    num_slots: int = 8
+    max_cache_len: int = 2048
+    prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS
+    max_new_tokens: int = 128
+    eos_id: Optional[int] = None
+    cache_dtype: Any = jnp.bfloat16
+
+
+@dataclasses.dataclass
+class Request:
+    tokens: List[int]
+    max_new_tokens: Optional[int] = None
+    temperature: float = 0.0
+    request_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RequestResult:
+    request_id: Optional[str]
+    prompt_tokens: List[int]
+    output_tokens: List[int]
+    ttft_s: float                 # arrival/submit -> first token
+    latency_s: float              # arrival/submit -> last token
+    finish_reason: str            # 'eos' | 'length' | 'error'
+    error: Optional[str] = None
+
+
+class _Slot:
+    __slots__ = ('request', 'length', 'generated', 'submit_time',
+                 'first_token_time', 'max_new')
+
+    def __init__(self, request: Request, length: int, submit_time: float,
+                 max_new: int):
+        self.request = request
+        self.length = length               # filled cache positions
+        self.generated: List[int] = []
+        self.submit_time = submit_time
+        self.first_token_time: Optional[float] = None
+        self.max_new = max_new
+
+
+class InferenceEngine:
+    """Single-process engine over the local device(s).
+
+    With a mesh spanning multiple chips the params/cache shardings follow
+    the model's logical axes (tensor-parallel serving); on one chip
+    everything is resident locally.
+    """
+
+    def __init__(self, model_config: LlamaConfig,
+                 cfg: Optional[InferConfig] = None,
+                 params: Optional[Any] = None,
+                 rng: Optional[jax.Array] = None):
+        self.model_config = model_config
+        self.cfg = cfg or InferConfig()
+        if self.cfg.max_cache_len > model_config.max_seq_len:
+            raise ValueError(
+                f'max_cache_len {self.cfg.max_cache_len} exceeds model '
+                f'max_seq_len {model_config.max_seq_len}')
+        self.model = Llama(model_config)
+        self.cfg.prefill_buckets = tuple(
+            b for b in self.cfg.prefill_buckets
+            if b <= self.cfg.max_cache_len) or (self.cfg.max_cache_len,)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._rng = rng
+        if params is None:
+            sample = jnp.zeros((1, 8), jnp.int32)
+            params = jax.jit(self.model.init)(rng, sample)
+        self.params = params
+        b = self.cfg.num_slots
+        self.cache = init_cache(model_config, b, self.cfg.max_cache_len,
+                                self.cfg.cache_dtype)
+        self._slots: List[Optional[_Slot]] = [None] * b
+        # Host mirrors of per-slot decode state (pushed to device each
+        # step as small arrays).
+        self._lengths = np.zeros((b,), np.int32)
+        self._last_tokens = np.zeros((b,), np.int32)
+        self._temps = np.zeros((b,), np.float32)
+        self._lock = threading.Lock()
+        self._jit_fns()
+
+    # ------------------------------------------------------------- jitted
+
+    def _jit_fns(self) -> None:
+        model = self.model
+
+        def prefill(params, tokens, true_len, cache):
+            # tokens: [1, bucket]; cache: fresh [1, Hkv, bucket, D] pairs.
+            positions = jnp.arange(tokens.shape[1])[None]
+            logits, new_cache = model.apply(params, tokens, positions,
+                                            cache)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1)[:, 0]      # [1, V]
+            return last, new_cache
+
+        def insert(cache, prefill_cache, slot):
+            # Write the [1, Hkv, bucket, D] prefill rows into slot `slot`.
+            out = []
+            for (k, v), (pk, pv) in zip(cache, prefill_cache):
+                k = jax.lax.dynamic_update_slice(
+                    k, pk.astype(k.dtype), (slot, 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    v, pv.astype(v.dtype), (slot, 0, 0, 0))
+                out.append((k, v))
+            return out
+
+        def decode(params, cache, tokens, lengths, temps, rng):
+            # tokens/lengths/temps: [B]; one decode step for every slot.
+            positions = lengths[:, None]
+            logits, new_cache = model.apply(params, tokens[:, None],
+                                            positions, cache)
+            logits = logits[:, 0]                            # [B, V]
+            greedy = jnp.argmax(logits, axis=-1)
+            temps_safe = jnp.maximum(temps, 1e-4)[:, None]
+            sampled = jax.random.categorical(rng, logits / temps_safe,
+                                             axis=-1)
+            next_tokens = jnp.where(temps > 0, sampled, greedy)
+            return next_tokens.astype(jnp.int32), new_cache
+
+        self._prefill = jax.jit(prefill)
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._decode = jax.jit(decode, donate_argnums=(1,))
+
+    # ---------------------------------------------------------- schedule
+
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f'prompt length {n} exceeds largest prefill bucket '
+            f'{self.cfg.prefill_buckets[-1]}')
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _start_request(self, req: Request, slot: int,
+                       submit_time: float) -> int:
+        """Prefill `req` into `slot`; returns the first generated token."""
+        n = len(req.tokens)
+        bucket = self._bucket(n)
+        if n + (req.max_new_tokens or self.cfg.max_new_tokens) > \
+                self.cfg.max_cache_len:
+            raise ValueError(
+                f'prompt ({n}) + max_new_tokens exceeds cache '
+                f'({self.cfg.max_cache_len})')
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = req.tokens
+        pcache = init_cache(self.model_config, 1, bucket,
+                            self.cfg.cache_dtype)
+        last_logits, pcache = self._prefill(self.params,
+                                            jnp.asarray(tokens),
+                                            n, pcache)
+        self.cache = self._insert(self.cache, pcache, slot)
+        if req.temperature > 0:
+            self._rng, key = jax.random.split(self._rng)
+            first = int(jax.random.categorical(
+                key, last_logits / max(req.temperature, 1e-4), axis=-1)[0])
+        else:
+            first = int(jnp.argmax(last_logits, axis=-1)[0])
+        max_new = req.max_new_tokens or self.cfg.max_new_tokens
+        s = _Slot(req, length=n, submit_time=submit_time, max_new=max_new)
+        s.first_token_time = time.time()
+        s.generated.append(first)
+        self._slots[slot] = s
+        self._lengths[slot] = n
+        self._last_tokens[slot] = first
+        self._temps[slot] = req.temperature
+        return first
+
+    def _finish_slot(self, i: int,
+                     reason: str) -> Tuple[Request, RequestResult]:
+        s = self._slots[i]
+        assert s is not None
+        now = time.time()
+        res = RequestResult(
+            request_id=s.request.request_id,
+            prompt_tokens=list(s.request.tokens),
+            output_tokens=list(s.generated),
+            ttft_s=(s.first_token_time or now) - s.submit_time,
+            latency_s=now - s.submit_time,
+            finish_reason=reason)
+        req = s.request
+        self._slots[i] = None
+        self._lengths[i] = 0
+        self._temps[i] = 0.0
+        return req, res
+
+    def _decode_step(self) -> None:
+        """One batched decode step; appends a token to every active slot."""
+        self._rng, key = jax.random.split(self._rng)
+        next_tokens, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(self._last_tokens),
+            jnp.asarray(self._lengths), jnp.asarray(self._temps), key)
+        next_np = np.asarray(next_tokens)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            s.length += 1            # the token we just fed is now cached
+            tok = int(next_np[i])
+            s.generated.append(tok)
+            self._lengths[i] = s.length
+            self._last_tokens[i] = tok
+
+    def _harvest(self) -> List[Tuple[Request, RequestResult]]:
+        done = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if self.cfg.eos_id is not None and \
+                    s.generated[-1] == self.cfg.eos_id:
+                done.append(self._finish_slot(i, 'eos'))
+            elif len(s.generated) >= s.max_new:
+                done.append(self._finish_slot(i, 'length'))
+            elif s.length + 1 >= self.cfg.max_cache_len:
+                done.append(self._finish_slot(i, 'length'))
+        return done
+
+    # -------------------------------------------------------------- API
+
+    def generate(self, requests: List[Request]) -> List[RequestResult]:
+        """Offline batch generation with continuous batching: slots are
+        refilled from the pending list as requests finish."""
+        with self._lock:
+            pending = list(requests)
+            finished: List[Tuple[Request, RequestResult]] = []
+            t0 = time.time()
+            while pending or any(s is not None for s in self._slots):
+                while pending:
+                    slot = self._free_slot()
+                    if slot is None:
+                        break
+                    self._start_request(pending.pop(0), slot, t0)
+                # Harvest between prefill and decode: the prefill already
+                # produced one token, which may satisfy max_new_tokens=1
+                # or be the EOS.
+                finished.extend(self._harvest())
+                if not any(s is not None for s in self._slots):
+                    continue
+                self._decode_step()
+                finished.extend(self._harvest())
+            order = {id(r): i for i, r in enumerate(requests)}
+            finished.sort(key=lambda pair: order.get(id(pair[0]), 0))
+            return [res for _, res in finished]
+
+    def generate_stream(self, request_queue: 'queue.Queue[Request]',
+                        result_cb, stop_event: threading.Event,
+                        idle_sleep: float = 0.005) -> None:
+        """Server loop: pull requests from a queue, run continuous
+        batching forever, deliver RequestResults via result_cb."""
+        while not stop_event.is_set():
+            moved = False
+            while True:
+                slot = self._free_slot()
+                if slot is None:
+                    break
+                try:
+                    req = request_queue.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    with self._lock:
+                        self._start_request(req, slot, time.time())
+                except ValueError as e:
+                    # Bad request (oversized prompt, …) must not kill the
+                    # serving loop: report it as an error result.
+                    result_cb(RequestResult(
+                        request_id=req.request_id,
+                        prompt_tokens=list(req.tokens), output_tokens=[],
+                        ttft_s=0.0, latency_s=0.0, finish_reason='error',
+                        error=str(e)))
+                moved = True
+            with self._lock:
+                for _, res in self._harvest():   # prefill-only finishes
+                    result_cb(res)
+                if any(s is not None for s in self._slots):
+                    self._decode_step()
+                    for _, res in self._harvest():
+                        result_cb(res)
+                    moved = True
+            if not moved:
+                time.sleep(idle_sleep)
+
+    def benchmark(self, num_requests: int = 32, prompt_len: int = 128,
+                  new_tokens: int = 64,
+                  seed: int = 0) -> Dict[str, float]:
+        """Synthetic serving benchmark: JetStream-comparable metrics
+        (req/s, output tok/s, TTFT) on random prompts."""
+        rng = np.random.default_rng(seed)
+        reqs = [
+            Request(tokens=rng.integers(
+                0, self.model_config.vocab_size,
+                size=prompt_len).tolist(),
+                    max_new_tokens=new_tokens)
+            for _ in range(num_requests)
+        ]
+        # Warmup/compile with a full-length request so the timed run hits
+        # the same prefill bucket (no jit compile inside the measurement).
+        self.generate([Request(tokens=list(reqs[0].tokens),
+                               max_new_tokens=2)])
+        t0 = time.time()
+        results = self.generate(reqs)
+        elapsed = time.time() - t0
+        out_tokens = sum(len(r.output_tokens) for r in results)
+        in_tokens = sum(len(r.prompt_tokens) for r in results)
+        ttfts = sorted(r.ttft_s for r in results)
+        return {
+            'requests_per_second': num_requests / elapsed,
+            'output_tokens_per_second': out_tokens / elapsed,
+            'input_tokens_per_second': in_tokens / elapsed,
+            'ttft_median_s': ttfts[len(ttfts) // 2],
+            'ttft_p99_s': ttfts[min(len(ttfts) - 1,
+                                    int(len(ttfts) * 0.99))],
+            'elapsed_s': elapsed,
+        }
+
+
+def engine_from_name(model: str, cfg: Optional[InferConfig] = None,
+                     rng: Optional[jax.Array] = None) -> InferenceEngine:
+    from skypilot_tpu.models import get_model_config
+    model_config = get_model_config(model)
+    cfg = cfg or InferConfig(model=model)
+    return InferenceEngine(model_config, cfg, rng=rng)

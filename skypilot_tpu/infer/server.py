@@ -1,0 +1,204 @@
+"""HTTP inference server: the process a serve-plane replica runs.
+
+`python -m skypilot_tpu.infer.server --model llama-debug --port 8100`
+
+Endpoints:
+  GET  /health    -> 200 {"status": "ok"} once the engine is compiled
+                     (the serve plane's readiness prober hits this).
+  POST /generate  -> {"tokens": [...], "max_new_tokens": N,
+                      "temperature": T}
+                  <- {"output_tokens": [...], "ttft_s": ..., ...}
+  POST /generate_text (when --tokenizer is given: HF tokenizer name)
+
+stdlib-only (ThreadingHTTPServer): requests block their handler thread on
+a per-request event while the single engine thread runs continuous
+batching across all in-flight requests.
+
+Role parity: the replica-side counterpart of the reference's vLLM/
+JetStream server recipes (llm/vllm/serve.yaml, examples/tpu/v6e/).
+"""
+import argparse
+import json
+import queue
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
+                                       Request, RequestResult)
+
+
+class InferenceServer:
+
+    def __init__(self, engine: InferenceEngine,
+                 tokenizer: Optional[object] = None):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.ready = threading.Event()
+        self._queue: 'queue.Queue[Request]' = queue.Queue()
+        self._results: Dict[str, RequestResult] = {}
+        self._events: Dict[str, threading.Event] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        # Compile before declaring ready so the first real request does
+        # not eat the (tens of seconds) jit cost.
+        self.engine.generate([Request(tokens=[1, 2, 3],
+                                      max_new_tokens=2)])
+        self.ready.set()
+        self.engine.generate_stream(self._queue, self._deliver, self._stop)
+
+    def _deliver(self, res: RequestResult) -> None:
+        rid = res.request_id
+        if rid is None:
+            return
+        ev = self._events.get(rid)
+        if ev is None:
+            return   # waiter timed out and abandoned the request: drop
+        self._results[rid] = res
+        ev.set()
+
+    def submit(self, req: Request,
+               timeout: float = 300.0) -> Optional[RequestResult]:
+        rid = req.request_id or uuid.uuid4().hex
+        req.request_id = rid
+        ev = threading.Event()
+        self._events[rid] = ev
+        self._queue.put(req)
+        ev.wait(timeout)
+        # Pop the event FIRST so a racing _deliver either stored the
+        # result before this pop (we return it) or sees no event and
+        # drops it (no leak).
+        self._events.pop(rid, None)
+        return self._results.pop(rid, None)
+
+
+def _make_handler(server: InferenceServer):
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in ('/health', '/'):
+                if server.ready.is_set():
+                    self._json(200, {'status': 'ok'})
+                else:
+                    self._json(503, {'status': 'starting'})
+            else:
+                self._json(404, {'error': 'not found'})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get('Content-Length', 0))
+                payload = json.loads(self.rfile.read(n) or b'{}')
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {'error': str(e)})
+                return
+            if self.path == '/generate':
+                tokens = payload.get('tokens')
+                if not isinstance(tokens, list) or not tokens:
+                    self._json(400, {'error': '"tokens" list required'})
+                    return
+            elif self.path == '/generate_text':
+                if server.tokenizer is None:
+                    self._json(400, {'error': 'no tokenizer configured'})
+                    return
+                tokens = server.tokenizer.encode(payload.get('prompt', ''))
+            else:
+                self._json(404, {'error': 'not found'})
+                return
+            req = Request(
+                tokens=[int(t) for t in tokens],
+                max_new_tokens=payload.get('max_new_tokens'),
+                temperature=float(payload.get('temperature', 0.0)))
+            try:
+                res = server.submit(req)
+            except ValueError as e:
+                self._json(400, {'error': str(e)})
+                return
+            if res is None:
+                self._json(504, {'error': 'timed out'})
+                return
+            if res.finish_reason == 'error':
+                self._json(400, {'error': res.error or 'bad request'})
+                return
+            out = {
+                'output_tokens': res.output_tokens,
+                'ttft_s': res.ttft_s,
+                'latency_s': res.latency_s,
+                'finish_reason': res.finish_reason,
+            }
+            if server.tokenizer is not None:
+                out['text'] = server.tokenizer.decode(res.output_tokens)
+            self._json(200, out)
+
+    return Handler
+
+
+def serve(engine: InferenceEngine, host: str = '0.0.0.0', port: int = 8100,
+          tokenizer: Optional[object] = None) -> None:
+    srv = InferenceServer(engine, tokenizer)
+    srv.start()
+    httpd = ThreadingHTTPServer((host, port), _make_handler(srv))
+    try:
+        httpd.serve_forever()
+    finally:
+        srv.stop()
+
+
+def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
+        num_slots: int = 8, max_cache_len: int = 2048,
+        tokenizer_name: Optional[str] = None,
+        eos_id: Optional[int] = None) -> None:
+    """Build engine (+ optional tokenizer) and serve.  Shared by the
+    module entry point and the `skytpu infer serve` CLI."""
+    from skypilot_tpu.models import get_model_config
+    tokenizer = None
+    if tokenizer_name:
+        from transformers import AutoTokenizer
+        tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
+        if eos_id is None:
+            eos_id = getattr(tokenizer, 'eos_token_id', None)
+    cfg = InferConfig(model=model, num_slots=num_slots,
+                      max_cache_len=max_cache_len, eos_id=eos_id)
+    engine = InferenceEngine(get_model_config(model), cfg)
+    serve(engine, host=host, port=port, tokenizer=tokenizer)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama-1b')
+    parser.add_argument('--port', type=int, default=8100)
+    parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--num-slots', type=int, default=8)
+    parser.add_argument('--max-cache-len', type=int, default=2048)
+    parser.add_argument('--tokenizer', default=None,
+                        help='HF tokenizer name (optional)')
+    parser.add_argument('--eos-id', type=int, default=None)
+    args = parser.parse_args()
+    run(model=args.model, host=args.host, port=args.port,
+        num_slots=args.num_slots, max_cache_len=args.max_cache_len,
+        tokenizer_name=args.tokenizer, eos_id=args.eos_id)
+
+
+if __name__ == '__main__':
+    main()

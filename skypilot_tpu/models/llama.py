@@ -93,11 +93,38 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rotated.astype(x.dtype)
 
 
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     q_positions: jax.Array) -> jax.Array:
+    """Attention of T new queries over a [B, Hkv, M, D] KV cache.
+
+    q: [B, Hq, T, D]; q_positions: [B, T] absolute positions (== cache
+    indices) of the new tokens.  Cache entry j is visible to query i iff
+    j <= position_i (causal over the slot's history; entries past the
+    slot's filled length are masked by the same rule since positions are
+    always <= length).  O(T·M) scores — the decode path (T=1) is
+    HBM-bandwidth-bound streaming the cache, which XLA handles well.
+    """
+    b, hq, t, d = q.shape
+    hkv, m = k_cache.shape[1], k_cache.shape[2]
+    group = hq // hkv
+    scale = d ** -0.5
+    qr = q.reshape(b, hkv, group, t, d).astype(jnp.float32)
+    scores = jnp.einsum('bhgtd,bhmd->bhgtm', qr * scale,
+                        k_cache.astype(jnp.float32))
+    cache_idx = jnp.arange(m)
+    mask = cache_idx[None, None, :] <= q_positions[:, :, None]  # [B, T, M]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bhgtm,bhmd->bhgtd', probs,
+                     v_cache.astype(jnp.float32))
+    return out.reshape(b, hq, t, d).astype(q.dtype)
+
+
 class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, kv_cache=None):
         cfg = self.config
         d = cfg.head_dim_
         dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
@@ -117,17 +144,37 @@ class Attention(nn.Module):
         v = jnp.transpose(v, (0, 2, 1, 3))
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        q = nn.with_logical_constraint(
-            q, ('activation_batch', 'activation_heads', 'activation_seq',
-                None))
-        k = nn.with_logical_constraint(
-            k, ('activation_batch', 'activation_kv', 'activation_seq', None))
-        v = nn.with_logical_constraint(
-            v, ('activation_batch', 'activation_kv', 'activation_seq', None))
-        # Transparently sequence-parallel: when the active mesh has a
-        # 'seq' axis >1 this becomes ring attention over ICI neighbors
-        # (ops/ring_attention.py); otherwise plain (pallas) flash.
-        out = sequence_parallel_attention(q, k, v, causal=True)
+        new_cache = None
+        if kv_cache is not None:
+            # Incremental decode/prefill: write the (roped) new K/V rows
+            # into the cache at their absolute positions, then attend
+            # over the whole cache.  start = positions[:, 0] (positions
+            # within one call are contiguous).
+            k_cache, v_cache = kv_cache
+            start = positions[:, 0]
+
+            def upd(cache_row, new_row, s):
+                return jax.lax.dynamic_update_slice(
+                    cache_row, new_row.astype(cache_row.dtype), (0, s, 0))
+
+            k_cache = jax.vmap(upd)(k_cache, k, start)
+            v_cache = jax.vmap(upd)(v_cache, v, start)
+            out = decode_attention(q, k_cache, v_cache, positions)
+            new_cache = (k_cache, v_cache)
+        else:
+            q = nn.with_logical_constraint(
+                q, ('activation_batch', 'activation_heads', 'activation_seq',
+                    None))
+            k = nn.with_logical_constraint(
+                k,
+                ('activation_batch', 'activation_kv', 'activation_seq', None))
+            v = nn.with_logical_constraint(
+                v,
+                ('activation_batch', 'activation_kv', 'activation_seq', None))
+            # Transparently sequence-parallel: when the active mesh has a
+            # 'seq' axis >1 this becomes ring attention over ICI neighbors
+            # (ops/ring_attention.py); otherwise plain (pallas) flash.
+            out = sequence_parallel_attention(q, k, v, causal=True)
         out = jnp.transpose(out, (0, 2, 1, 3))  # [B, S, H, D]
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
@@ -138,6 +185,8 @@ class Attention(nn.Module):
                     0.02 / (2 * cfg.num_layers) ** 0.5),
                 ('heads', 'qkv_embed', 'embed')),
             name='o_proj')(out)
+        if kv_cache is not None:
+            return out, new_cache
         return out
 
 
@@ -171,13 +220,20 @@ class DecoderLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions):
-        h = x + Attention(self.config, name='attn')(
-            RMSNorm(self.config.norm_eps, name='input_norm')(x), positions)
+    def __call__(self, x, positions, kv_cache=None):
+        attn_in = RMSNorm(self.config.norm_eps, name='input_norm')(x)
+        attn = Attention(self.config, name='attn')
+        if kv_cache is not None:
+            attn_out, new_cache = attn(attn_in, positions, kv_cache)
+        else:
+            attn_out, new_cache = attn(attn_in, positions), None
+        h = x + attn_out
         out = h + MLP(self.config, name='mlp')(
             RMSNorm(self.config.norm_eps, name='post_attn_norm')(h))
         out = nn.with_logical_constraint(
             out, ('activation_batch', 'activation_seq', 'activation_embed'))
+        if kv_cache is not None:
+            return out, new_cache
         return out
 
 
@@ -186,7 +242,14 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, cache=None):
+        """Training/scoring: __call__(tokens) -> logits.
+
+        Incremental inference: __call__(tokens, positions, cache) ->
+        (logits, new_cache) where `cache` is a per-layer list of
+        (k_cache, v_cache) [B, Hkv, M, D] pairs (see infer.engine) and
+        `positions` [B, T] are the absolute cache positions of `tokens`.
+        """
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(
@@ -199,11 +262,16 @@ class Llama(nn.Module):
         x = embed.astype(cfg.dtype)[tokens]
         x = nn.with_logical_constraint(
             x, ('activation_batch', 'activation_seq', 'activation_embed'))
+        new_cache = []
         for i in range(cfg.num_layers):
             layer = DecoderLayer(cfg, name=f'layer_{i}')
-            x = nn.remat(  # rematerialize each block: HBM for FLOPs
-                lambda mdl, h, pos: mdl(h, pos),
-                prevent_cse=True)(layer, x, positions)
+            if cache is not None:
+                x, layer_cache = layer(x, positions, cache[i])
+                new_cache.append(layer_cache)
+            else:
+                x = nn.remat(  # rematerialize each block: HBM for FLOPs
+                    lambda mdl, h, pos: mdl(h, pos),
+                    prevent_cse=True)(layer, x, positions)
         x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
         if cfg.tie_embeddings:
             logits = x.astype(jnp.float32) @ embed.astype(jnp.float32).T
@@ -213,4 +281,14 @@ class Llama(nn.Module):
                 kernel_init=nn.with_logical_partitioning(
                     nn.initializers.normal(0.02), ('embed', 'vocab')),
                 name='lm_head')(x.astype(jnp.float32))
+        if cache is not None:
+            return logits, new_cache
         return logits
+
+
+def init_cache(config: LlamaConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16):
+    """Per-layer [(k, v)] KV cache, each [B, Hkv, max_len, head_dim]."""
+    shape = (batch_size, config.num_kv_heads, max_len, config.head_dim_)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(config.num_layers)]

@@ -1,0 +1,174 @@
+"""Inference engine: cached decode correctness, continuous batching, and
+the HTTP server surface (tier-2: everything on the CPU mesh)."""
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.infer import (InferConfig, InferenceEngine, Request)
+from skypilot_tpu.models.llama import Llama, LlamaConfig, init_cache
+
+
+@pytest.fixture(scope='module')
+def tiny_config():
+    return LlamaConfig(name='infer-test', vocab_size=101, hidden_size=32,
+                       intermediate_size=64, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_seq_len=128,
+                       tie_embeddings=True, dtype=jnp.float32)
+
+
+@pytest.fixture(scope='module')
+def engine(tiny_config):
+    cfg = InferConfig(model='infer-test', num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=8,
+                      cache_dtype=jnp.float32)
+    return InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(7))
+
+
+def test_incremental_decode_matches_full_forward(tiny_config):
+    m = Llama(tiny_config)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 101)
+    params = m.init(jax.random.PRNGKey(0), toks)
+    full = m.apply(params, toks)
+    cache = init_cache(tiny_config, 2, 16, jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(7)[None], (2, 7))
+    logits, cache = m.apply(params, toks[:, :7], pos, cache)
+    outs = [logits]
+    for i in range(7, 12):
+        p = jnp.full((2, 1), i)
+        l, cache = m.apply(params, toks[:, i:i + 1], p, cache)
+        outs.append(l)
+    inc = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_greedy_generation_deterministic(engine):
+    req = [Request(tokens=[5, 6, 7, 8], max_new_tokens=6)]
+    r1 = engine.generate(req)[0]
+    r2 = engine.generate([Request(tokens=[5, 6, 7, 8],
+                                  max_new_tokens=6)])[0]
+    assert r1.output_tokens == r2.output_tokens
+    assert len(r1.output_tokens) == 6
+    assert r1.finish_reason == 'length'
+
+
+def test_generation_matches_full_forward_argmax(engine, tiny_config):
+    """Greedy engine output == step-by-step argmax over the full forward
+    (no cache): the engine's cache path is exact, not approximate."""
+    prompt = [3, 1, 4, 1, 5]
+    res = engine.generate([Request(tokens=prompt, max_new_tokens=5)])[0]
+    m, params = engine.model, engine.params
+    toks = list(prompt)
+    expected = []
+    for _ in range(5):
+        logits = m.apply(params, jnp.asarray([toks]))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        expected.append(nxt)
+        toks.append(nxt)
+    assert res.output_tokens == expected
+
+
+def test_continuous_batching_more_requests_than_slots(engine):
+    reqs = [Request(tokens=[i + 1, i + 2, i + 3], max_new_tokens=4,
+                    request_id=str(i)) for i in range(9)]  # 9 > 4 slots
+    results = engine.generate(reqs)
+    assert len(results) == 9
+    assert [r.request_id for r in results] == [str(i) for i in range(9)]
+    for r in results:
+        assert len(r.output_tokens) == 4
+        assert r.ttft_s >= 0 and r.latency_s >= r.ttft_s
+
+
+def test_eos_stops_generation(tiny_config):
+    cfg = InferConfig(num_slots=2, max_cache_len=64,
+                      prefill_buckets=(8,), max_new_tokens=16,
+                      cache_dtype=jnp.float32)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(3))
+    probe = eng.generate([Request(tokens=[1, 2, 3],
+                                  max_new_tokens=4)])[0]
+    eos = probe.output_tokens[1]  # make the 2nd generated token the EOS
+    eng.cfg.eos_id = eos
+    res = eng.generate([Request(tokens=[1, 2, 3], max_new_tokens=16)])[0]
+    assert res.finish_reason == 'eos'
+    # Generation stops at the FIRST occurrence of eos.
+    assert res.output_tokens[-1] == eos
+    assert eos not in res.output_tokens[:-1]
+    assert len(res.output_tokens) < 16
+
+
+def test_max_new_tokens_one(engine):
+    """The prefill-produced token alone satisfies max_new_tokens=1."""
+    res = engine.generate([Request(tokens=[2, 3, 4],
+                                   max_new_tokens=1)])[0]
+    assert len(res.output_tokens) == 1
+
+
+def test_oversized_prompt_does_not_kill_server_loop(tiny_config):
+    from skypilot_tpu.infer.server import InferenceServer
+    cfg = InferConfig(num_slots=2, max_cache_len=32, prefill_buckets=(8,),
+                      max_new_tokens=4, cache_dtype=jnp.float32)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(11))
+    srv = InferenceServer(eng)
+    srv.start()
+    try:
+        assert srv.ready.wait(120)
+        bad = srv.submit(Request(tokens=list(range(20))), timeout=30)
+        assert bad is not None and bad.finish_reason == 'error'
+        ok = srv.submit(Request(tokens=[1, 2], max_new_tokens=2),
+                        timeout=60)
+        assert ok is not None and len(ok.output_tokens) == 2
+    finally:
+        srv.stop()
+
+
+def test_temperature_sampling_varies(engine):
+    outs = set()
+    for seed in range(4):
+        engine._rng = jax.random.PRNGKey(seed)
+        r = engine.generate([Request(tokens=[9, 9, 9], max_new_tokens=6,
+                                     temperature=5.0)])[0]
+        outs.add(tuple(r.output_tokens))
+    assert len(outs) > 1
+
+
+def test_benchmark_metrics(engine):
+    m = engine.benchmark(num_requests=6, prompt_len=8, new_tokens=4)
+    assert m['requests_per_second'] > 0
+    assert m['output_tokens_per_second'] > 0
+    assert m['ttft_median_s'] >= 0
+
+
+def test_http_server_generate(tiny_config):
+    from skypilot_tpu.infer.server import InferenceServer, _make_handler
+    from http.server import ThreadingHTTPServer
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=8, cache_dtype=jnp.float32)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(5))
+    srv = InferenceServer(eng)
+    srv.start()
+    httpd = ThreadingHTTPServer(('127.0.0.1', 0), _make_handler(srv))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        assert srv.ready.wait(120)
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/health', timeout=10) as r:
+            assert json.load(r)['status'] == 'ok'
+        body = json.dumps({'tokens': [4, 5, 6],
+                           'max_new_tokens': 5}).encode()
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/generate', data=body,
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.load(r)
+        assert len(out['output_tokens']) == 5
+        assert out['finish_reason'] == 'length'
+    finally:
+        httpd.shutdown()
+        srv.stop()
