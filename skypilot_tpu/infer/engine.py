@@ -98,10 +98,18 @@ class InferenceEngine:
             raise ValueError(
                 f'max_cache_len {self.cfg.max_cache_len} exceeds model '
                 f'max_seq_len {model_config.max_seq_len}')
+        if not isinstance(model_config, LlamaConfig):
+            raise TypeError(
+                'InferenceEngine currently supports the Llama family '
+                f'(KV-cache decode path); got {type(model_config).__name__}')
         self.model = Llama(model_config)
-        self.cfg.prefill_buckets = tuple(
-            b for b in self.cfg.prefill_buckets
-            if b <= self.cfg.max_cache_len) or (self.cfg.max_cache_len,)
+        buckets = tuple(b for b in self.cfg.prefill_buckets
+                        if b <= self.cfg.max_cache_len)
+        if not buckets or buckets[-1] < self.cfg.max_cache_len:
+            # Cover the (largest-bucket, cache-len] gap so any prompt the
+            # cache can hold has a bucket.
+            buckets += (self.cfg.max_cache_len,)
+        self.cfg.prefill_buckets = buckets
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._rng = rng
         if params is None:
@@ -178,15 +186,25 @@ class InferenceEngine:
                 return i
         return None
 
+    def _max_new(self, req: Request) -> int:
+        return self.cfg.max_new_tokens if req.max_new_tokens is None \
+            else req.max_new_tokens
+
     def _start_request(self, req: Request, slot: int,
                        submit_time: float) -> int:
         """Prefill `req` into `slot`; returns the first generated token."""
         n = len(req.tokens)
-        bucket = self._bucket(n)
-        if n + (req.max_new_tokens or self.cfg.max_new_tokens) > \
-                self.cfg.max_cache_len:
+        max_new = self._max_new(req)
+        if n < 1:
+            raise ValueError('empty prompt')
+        if max_new < 1:
             raise ValueError(
-                f'prompt ({n}) + max_new_tokens exceeds cache '
+                f'max_new_tokens must be >= 1 (got {max_new}); generation '
+                'always produces at least the prefill token')
+        bucket = self._bucket(n)
+        if n + max_new > self.cfg.max_cache_len:
+            raise ValueError(
+                f'prompt ({n}) + max_new_tokens ({max_new}) exceeds cache '
                 f'({self.cfg.max_cache_len})')
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = req.tokens
@@ -202,7 +220,6 @@ class InferenceEngine:
                 key, last_logits / max(req.temperature, 1e-4), axis=-1)[0])
         else:
             first = int(jnp.argmax(last_logits, axis=-1)[0])
-        max_new = req.max_new_tokens or self.cfg.max_new_tokens
         s = _Slot(req, length=n, submit_time=submit_time, max_new=max_new)
         s.first_token_time = time.time()
         s.generated.append(first)
@@ -274,7 +291,16 @@ class InferenceEngine:
                     slot = self._free_slot()
                     if slot is None:
                         break
-                    self._start_request(pending.pop(0), slot, t0)
+                    req = pending.pop(0)
+                    try:
+                        self._start_request(req, slot, t0)
+                    except ValueError as e:
+                        # A bad request fails alone, not the whole batch.
+                        finished.append((req, RequestResult(
+                            request_id=req.request_id,
+                            prompt_tokens=list(req.tokens),
+                            output_tokens=[], ttft_s=0.0, latency_s=0.0,
+                            finish_reason='error', error=str(e))))
                 # Harvest between prefill and decode: the prefill already
                 # produced one token, which may satisfy max_new_tokens=1
                 # or be the EOS.
@@ -305,9 +331,10 @@ class InferenceEngine:
                 try:
                     with self._lock:
                         self._start_request(req, slot, time.time())
-                except ValueError as e:
-                    # Bad request (oversized prompt, …) must not kill the
-                    # serving loop: report it as an error result.
+                except Exception as e:  # pylint: disable=broad-except
+                    # ANY per-request failure must not kill the serving
+                    # loop (the thread is the whole data plane); report
+                    # it as an error result instead.
                     result_cb(RequestResult(
                         request_id=req.request_id,
                         prompt_tokens=list(req.tokens), output_tokens=[],

@@ -61,10 +61,15 @@ class InferenceServer:
         rid = res.request_id
         if rid is None:
             return
+        # Store BEFORE checking the event: if the waiter times out
+        # between our check and store, it pops _results after popping
+        # _events, so either it takes the result or we remove it below —
+        # no ordering leaks entries.
+        self._results[rid] = res
         ev = self._events.get(rid)
         if ev is None:
-            return   # waiter timed out and abandoned the request: drop
-        self._results[rid] = res
+            self._results.pop(rid, None)   # abandoned: drop
+            return
         ev.set()
 
     def submit(self, req: Request,
@@ -123,18 +128,25 @@ def _make_handler(server: InferenceServer):
                     self._json(400, {'error': 'no tokenizer configured'})
                     return
                 tokens = server.tokenizer.encode(payload.get('prompt', ''))
+                if not tokens:
+                    self._json(400, {'error': 'empty prompt'})
+                    return
             else:
                 self._json(404, {'error': 'not found'})
                 return
-            req = Request(
-                tokens=[int(t) for t in tokens],
-                max_new_tokens=payload.get('max_new_tokens'),
-                temperature=float(payload.get('temperature', 0.0)))
+            # Validate types HERE: a malformed field must become a 400,
+            # never an exception inside the engine thread.
             try:
-                res = server.submit(req)
-            except ValueError as e:
-                self._json(400, {'error': str(e)})
+                tokens = [int(t) for t in tokens]
+                max_new = payload.get('max_new_tokens')
+                max_new = None if max_new is None else int(max_new)
+                temperature = float(payload.get('temperature', 0.0))
+            except (TypeError, ValueError) as e:
+                self._json(400, {'error': f'bad field: {e}'})
                 return
+            req = Request(tokens=tokens, max_new_tokens=max_new,
+                          temperature=temperature)
+            res = server.submit(req)
             if res is None:
                 self._json(504, {'error': 'timed out'})
                 return

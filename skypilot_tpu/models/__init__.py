@@ -6,6 +6,8 @@ with logical sharding axes so the same code runs single-chip, FSDP, TP, or
 multi-slice by changing the MeshSpec only.
 """
 from skypilot_tpu.models import registry
-from skypilot_tpu.models.registry import get_model_config, list_models
+from skypilot_tpu.models.registry import (build_model, get_model_config,
+                                          is_causal_lm, list_models)
 
-__all__ = ['registry', 'get_model_config', 'list_models']
+__all__ = ['registry', 'build_model', 'get_model_config', 'is_causal_lm',
+           'list_models']

@@ -1,0 +1,182 @@
+"""BERT-family encoder: bidirectional transformer with MLM and
+sequence-classification heads.
+
+Role parity: the reference's BERT fine-tuning recipes
+(examples/bert_qa.yaml and the BASELINE BERT-IMDB workload) run HF
+Trainer scripts on provisioned VMs; here the encoder is a native model
+family on the shared mesh/logical-axis stack (bidirectional attention:
+flash with causal=False).
+"""
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    name: str
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_classes: int = 2          # classification head width
+    norm_eps: float = 1e-12
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        d = cfg.head_dim_
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, d), axis=-1, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02),
+                ('embed', 'heads', 'qkv_embed')),
+            name=name)
+        q = jnp.transpose(dense('query')(x), (0, 2, 1, 3))
+        k = jnp.transpose(dense('key')(x), (0, 2, 1, 3))
+        v = jnp.transpose(dense('value')(x), (0, 2, 1, 3))
+        if attention_mask is not None:
+            # Padding mask path: masked dense attention (scores must see
+            # the mask, so no flash kernel here).
+            scores = jnp.einsum('bhqd,bhkd->bhqk', q, k) * d ** -0.5
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             -1e30)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32) + bias, axis=-1)
+            out = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(v.dtype), v)
+        else:
+            out = flash_attention(q, k, v, causal=False)
+        out = jnp.transpose(out, (0, 2, 1, 3))
+        return nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02),
+                ('heads', 'qkv_embed', 'embed')),
+            name='output')(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        # Post-LN (original BERT): sublayer -> residual -> LayerNorm.
+        attn = BertSelfAttention(cfg, name='attention')(x, attention_mask)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name='attention_norm')(x + attn).astype(cfg.dtype)
+        h = nn.DenseGeneral(
+            cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', 'mlp')),
+            name='intermediate')(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.DenseGeneral(
+            cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('mlp', 'embed')),
+            name='output')(h)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name='output_norm')(x + h).astype(cfg.dtype)
+        return nn.with_logical_constraint(
+            x, ('activation_batch', 'activation_seq', 'activation_embed'))
+
+
+class Bert(nn.Module):
+    """Encoder.  __call__(tokens [B,S], type_ids?, attention_mask?) ->
+    hidden states [B, S, H]."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, type_ids=None, attention_mask=None):
+        cfg = self.config
+        positions = jnp.arange(tokens.shape[1])[None]
+        wte = self.param(
+            'word_embeddings', nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('vocab', 'embed')),
+            (cfg.vocab_size, cfg.hidden_size))
+        wpe = self.param(
+            'position_embeddings', nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, 'embed')),
+            (cfg.max_seq_len, cfg.hidden_size))
+        tte = self.param(
+            'token_type_embeddings', nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, 'embed')),
+            (cfg.type_vocab_size, cfg.hidden_size))
+        if type_ids is None:
+            type_ids = jnp.zeros_like(tokens)
+        x = wte[tokens] + wpe[positions] + tte[type_ids]
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name='embeddings_norm')(x).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            layer = BertLayer(cfg, name=f'layer_{i}')
+            x = nn.remat(lambda mdl, h, m: mdl(h, m),
+                         prevent_cse=True,
+                         static_argnums=())(layer, x, attention_mask)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, type_ids=None, attention_mask=None):
+        cfg = self.config
+        x = Bert(cfg, name='bert')(tokens, type_ids, attention_mask)
+        x = nn.DenseGeneral(
+            cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', 'embed')),
+            name='transform')(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name='transform_norm')(x)
+        return nn.DenseGeneral(
+            cfg.vocab_size, use_bias=True, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', 'vocab')),
+            name='decoder')(x.astype(jnp.float32))
+
+
+class BertForSequenceClassification(nn.Module):
+    """IMDB-style classifier: [CLS] pooling + linear head."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, type_ids=None, attention_mask=None):
+        cfg = self.config
+        x = Bert(cfg, name='bert')(tokens, type_ids, attention_mask)
+        pooled = nn.tanh(nn.DenseGeneral(
+            cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', 'embed')),
+            name='pooler')(x[:, 0]))
+        return nn.DenseGeneral(
+            cfg.num_classes, use_bias=True, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', None)),
+            name='classifier')(pooled.astype(jnp.float32))
+
+
+def mlm_loss(logits: jax.Array, targets: jax.Array,
+             mask: jax.Array) -> jax.Array:
+    """Masked-LM loss: cross-entropy on masked positions only."""
+    from skypilot_tpu.train.trainer import cross_entropy_loss
+    return cross_entropy_loss(logits, targets, mask)

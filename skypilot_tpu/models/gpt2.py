@@ -1,0 +1,145 @@
+"""GPT-2 family: learned positional embeddings, pre-LN transformer, GELU
+MLP, full multi-head attention — flax.linen with the same logical-axis
+sharding vocabulary as the Llama family.
+
+Role parity: the reference ships GPT-2 as a training recipe
+(llm/gpt-2/, built on nanoGPT + data pipelines); here the architecture
+is a first-class model family usable with the same Trainer/mesh stack.
+"""
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import sequence_parallel_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    name: str
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    dropout: float = 0.0          # kept 0 for deterministic training
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @property
+    def num_params(self) -> int:
+        h, v, l = self.hidden_size, self.vocab_size, self.num_layers
+        per_layer = 4 * h * h + 2 * h * self.intermediate_size + 13 * h
+        return l * per_layer + v * h + self.max_seq_len * h + 2 * h
+
+    def flops_per_token(self, seq_len: int) -> float:
+        attn_flops = 12 * self.num_layers * self.num_heads * \
+            self.head_dim_ * seq_len
+        return 6 * self.num_params + attn_flops
+
+
+class GPT2Attention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        d = cfg.head_dim_
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, d), axis=-1, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02),
+                ('embed', None, 'heads', 'qkv_embed')),
+            name='c_attn')(x)
+        q, k, v = (jnp.transpose(qkv[:, :, i], (0, 2, 1, 3))
+                   for i in range(3))        # each [B, H, S, D]
+        q = nn.with_logical_constraint(
+            q, ('activation_batch', 'activation_heads', 'activation_seq',
+                None))
+        out = sequence_parallel_attention(q, k, v, causal=True)
+        out = jnp.transpose(out, (0, 2, 1, 3))
+        return nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02 / (2 * cfg.num_layers) ** 0.5),
+                ('heads', 'qkv_embed', 'embed')),
+            name='c_proj')(out)
+
+
+class GPT2MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.DenseGeneral(
+            cfg.intermediate_size, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', 'mlp')),
+            name='c_fc')(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.with_logical_constraint(
+            h, ('activation_batch', 'activation_seq', 'activation_mlp'))
+        return nn.DenseGeneral(
+            cfg.hidden_size, use_bias=True, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02 / (2 * cfg.num_layers) ** 0.5),
+                ('mlp', 'embed')),
+            name='c_proj')(h)
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = x + GPT2Attention(cfg, name='attn')(
+            nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name='ln_1')(x).astype(cfg.dtype))
+        out = h + GPT2MLP(cfg, name='mlp')(
+            nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name='ln_2')(h).astype(cfg.dtype))
+        return nn.with_logical_constraint(
+            out, ('activation_batch', 'activation_seq', 'activation_embed'))
+
+
+class GPT2(nn.Module):
+    """Decoder-only LM, GPT-2 architecture.  tokens [B, S] -> logits
+    [B, S, V] (weight-tied lm head, as in the original)."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens, positions: Optional[jax.Array] = None):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None], tokens.shape)
+        wte = self.param(
+            'wte', nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('vocab', 'embed')),
+            (cfg.vocab_size, cfg.hidden_size))
+        wpe = self.param(
+            'wpe', nn.with_logical_partitioning(
+                nn.initializers.normal(0.01), (None, 'embed')),
+            (cfg.max_seq_len, cfg.hidden_size))
+        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[positions]
+        x = nn.with_logical_constraint(
+            x, ('activation_batch', 'activation_seq', 'activation_embed'))
+        for i in range(cfg.num_layers):
+            block = GPT2Block(cfg, name=f'h_{i}')
+            x = nn.remat(lambda mdl, h: mdl(h),
+                         prevent_cse=True)(block, x)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                         name='ln_f')(x)
+        return x.astype(jnp.float32) @ wte.astype(jnp.float32).T

@@ -1,0 +1,215 @@
+"""Mixtral-family MoE decoder: Llama attention + top-k sparse MoE FFN.
+
+TPU-first MoE: GShard-style einsum dispatch — routing becomes one-hot
+matmuls (MXU-friendly, static shapes, no gather/scatter), experts live in
+stacked [E, ...] weight tensors sharded over the 'expert' logical axis
+(→ 'tensor' mesh axis by default, i.e. expert parallelism rides ICI).
+Capacity-factor truncation keeps every shape static for XLA; dropped
+tokens pass through the residual (standard GShard/Switch behavior).
+
+Role parity: the reference serves Mixtral by delegating MoE to vLLM/
+megablocks (llm/mixtral/README.md, llm/mixtral/serve.yaml); here MoE is
+a native model family on the shared mesh/trainer stack.
+"""
+import dataclasses
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models.llama import (Attention, LlamaConfig, RMSNorm)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    name: str
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 4096
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    router_aux_loss_weight: float = 0.02
+    tie_embeddings: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim_(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def as_llama(self) -> LlamaConfig:
+        """Attention/norm hyperparams reused by the shared Llama blocks."""
+        return LlamaConfig(
+            name=self.name, vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads, max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            dtype=self.dtype)
+
+    @property
+    def num_params(self) -> int:
+        h, v, l = self.hidden_size, self.vocab_size, self.num_layers
+        d = self.head_dim_
+        attn = h * d * (self.num_heads * 2 + self.num_kv_heads * 2)
+        moe = self.num_experts * 3 * h * self.intermediate_size + \
+            h * self.num_experts
+        return l * (attn + moe + 2 * h) + v * h * 2 + h
+
+    @property
+    def active_params(self) -> int:
+        """Params touched per token (the compute-cost number for MoE)."""
+        h, v, l = self.hidden_size, self.vocab_size, self.num_layers
+        d = self.head_dim_
+        attn = h * d * (self.num_heads * 2 + self.num_kv_heads * 2)
+        moe = self.experts_per_token * 3 * h * self.intermediate_size + \
+            h * self.num_experts
+        return l * (attn + moe + 2 * h) + v * h * 2 + h
+
+    def flops_per_token(self, seq_len: int) -> float:
+        attn_flops = 12 * self.num_layers * self.num_heads * \
+            self.head_dim_ * seq_len
+        return 6 * self.active_params + attn_flops
+
+
+def top_k_routing(router_logits: jax.Array, num_experts: int, k: int,
+                  capacity: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """GShard-style dispatch/combine tensors from router logits.
+
+    router_logits: [G, E] (G = flattened tokens).  Returns
+      dispatch: [G, E, C] one-hot (token g -> slot c of expert e)
+      combine:  [G, E, C] dispatch weighted by normalized router probs
+      aux_loss: load-balancing loss (mean_prob * mean_assignment * E^2)
+    """
+    g = router_logits.shape[0]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)           # [G, k]
+    # Renormalize the k selected gates (Mixtral semantics).
+    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+    # Expert-assignment one-hots per choice: [k, G, E].
+    choice_masks = jax.nn.one_hot(gate_idx.T, num_experts,
+                                  dtype=jnp.float32)
+    # Slot positions: within each expert, tokens take slots in order of
+    # (choice priority, token index) — cumsum over the flattened
+    # [k*G, E] mask gives each (choice, token) its per-expert rank.
+    flat_mask = choice_masks.reshape(k * g, num_experts)
+    position = jnp.cumsum(flat_mask, axis=0) - 1.0           # [k*G, E]
+    in_capacity = (position < capacity).astype(jnp.float32) * flat_mask
+    slot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                          dtype=jnp.float32) * in_capacity[..., None]
+    slot = slot.reshape(k, g, num_experts, capacity)
+    dispatch = jnp.sum(slot, axis=0)                         # [G, E, C]
+    combine = jnp.einsum('kgec,gk->gec',
+                         slot, gate_vals.astype(jnp.float32))
+    # Load-balance aux loss (Switch): encourages uniform routing.
+    density = jnp.mean(choice_masks[0], axis=0)              # top-1 share
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * num_experts
+    return dispatch, combine, aux
+
+
+class MoEBlock(nn.Module):
+    """Top-k sparse SwiGLU experts with einsum dispatch."""
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, h = x.shape
+        g = b * s
+        capacity = max(
+            1,
+            int(cfg.capacity_factor * g * cfg.experts_per_token /
+                cfg.num_experts))
+        xf = x.reshape(g, h)
+        router = nn.DenseGeneral(
+            cfg.num_experts, use_bias=False, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', None)),
+            name='router')(xf.astype(jnp.float32))
+        dispatch, combine, aux = top_k_routing(
+            router, cfg.num_experts, cfg.experts_per_token, capacity)
+        self.sow('intermediates', 'router_aux_loss',
+                 aux * cfg.router_aux_loss_weight)
+
+        def expert_param(name, shape, axes):
+            return self.param(
+                name, nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), axes), shape)
+
+        f = cfg.intermediate_size
+        w_gate = expert_param('w_gate', (cfg.num_experts, h, f),
+                              ('expert', 'embed', 'mlp'))
+        w_up = expert_param('w_up', (cfg.num_experts, h, f),
+                            ('expert', 'embed', 'mlp'))
+        w_down = expert_param('w_down', (cfg.num_experts, f, h),
+                              ('expert', 'mlp', 'embed'))
+        # Dispatch tokens into per-expert slots: [E, C, H].
+        expert_in = jnp.einsum('gec,gh->ech',
+                               dispatch.astype(cfg.dtype),
+                               xf.astype(cfg.dtype))
+        hmid = nn.silu(jnp.einsum('ech,ehf->ecf', expert_in,
+                                  w_gate.astype(cfg.dtype))) * \
+            jnp.einsum('ech,ehf->ecf', expert_in, w_up.astype(cfg.dtype))
+        expert_out = jnp.einsum('ecf,efh->ech', hmid,
+                                w_down.astype(cfg.dtype))
+        out = jnp.einsum('gec,ech->gh', combine.astype(cfg.dtype),
+                         expert_out)
+        return out.reshape(b, s, h)
+
+
+class MixtralLayer(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        lcfg = cfg.as_llama()
+        h = x + Attention(lcfg, name='attn')(
+            RMSNorm(cfg.norm_eps, name='input_norm')(x), positions)
+        out = h + MoEBlock(cfg, name='moe')(
+            RMSNorm(cfg.norm_eps, name='post_attn_norm')(h))
+        return nn.with_logical_constraint(
+            out, ('activation_batch', 'activation_seq', 'activation_embed'))
+
+
+class Mixtral(nn.Module):
+    """MoE decoder LM.  tokens [B, S] -> logits [B, S, V].  The router
+    aux loss is sowed under 'intermediates'/'router_aux_loss' — training
+    reads it via mutable=['intermediates'] (see trainer.lm_loss_fn)."""
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None], tokens.shape)
+        embed = self.param(
+            'embedding', nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('vocab', 'embed')),
+            (cfg.vocab_size, cfg.hidden_size))
+        x = embed.astype(cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(
+            x, ('activation_batch', 'activation_seq', 'activation_embed'))
+        for i in range(cfg.num_layers):
+            layer = MixtralLayer(cfg, name=f'layer_{i}')
+            x = nn.remat(lambda mdl, h, pos: mdl(h, pos),
+                         prevent_cse=True)(layer, x, positions)
+        x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
+        if cfg.tie_embeddings:
+            return x.astype(jnp.float32) @ embed.astype(jnp.float32).T
+        return nn.DenseGeneral(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ('embed', 'vocab')),
+            name='lm_head')(x.astype(jnp.float32))

@@ -1,20 +1,34 @@
-"""Model config registry: named configs for the BASELINE workloads.
+"""Model registry: named configs for every family + module builders.
 
-Sizes match the public architectures (Llama-2-7B, Llama-3-8B/3.1-8B), plus
-scaled-down variants for single-chip benches and CI-sized tests.
+Sizes match the public architectures (Llama-2-7B, Llama-3-8B, GPT-2,
+Mixtral-8x7B, BERT-base, ResNet-50), plus scaled-down variants for
+single-chip benches and CI-sized tests.
+
+The trainer and graft entry points look models up by name; `build_model`
+maps a config object to its flax module, so the Trainer is
+family-agnostic for causal LMs (llama/gpt2/mixtral all produce
+tokens->logits).
 """
-from typing import Dict, List
+from typing import Any, Dict, List
 
-from skypilot_tpu.models.llama import LlamaConfig
+import flax.linen as nn
 
-_LLAMA_CONFIGS: Dict[str, LlamaConfig] = {}
+from skypilot_tpu.models.bert import (Bert, BertConfig, BertForMaskedLM,
+                                      BertForSequenceClassification)
+from skypilot_tpu.models.gpt2 import GPT2, GPT2Config
+from skypilot_tpu.models.llama import Llama, LlamaConfig
+from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+from skypilot_tpu.models.resnet import ResNet, ResNetConfig
+
+_CONFIGS: Dict[str, Any] = {}
 
 
-def _register(cfg: LlamaConfig) -> LlamaConfig:
-    _LLAMA_CONFIGS[cfg.name] = cfg
+def _register(cfg) -> Any:
+    _CONFIGS[cfg.name] = cfg
     return cfg
 
 
+# ----------------------------------------------------------------- llama
 # Llama 2 7B (llm/llama-2 + JetStream serve baseline, BASELINE.md rows 4-7).
 _register(
     LlamaConfig(name='llama2-7b', vocab_size=32000, hidden_size=4096,
@@ -43,13 +57,77 @@ _register(
                 intermediate_size=128, num_layers=2, num_heads=4,
                 num_kv_heads=2, max_seq_len=256, tie_embeddings=True))
 
+# ------------------------------------------------------------------ gpt2
+# GPT-2 sizes from the original family (llm/gpt-2 recipe parity).
+_register(GPT2Config(name='gpt2', vocab_size=50257, hidden_size=768,
+                     num_layers=12, num_heads=12, max_seq_len=1024))
+_register(GPT2Config(name='gpt2-medium', vocab_size=50257,
+                     hidden_size=1024, num_layers=24, num_heads=16,
+                     max_seq_len=1024))
+_register(GPT2Config(name='gpt2-large', vocab_size=50257,
+                     hidden_size=1280, num_layers=36, num_heads=20,
+                     max_seq_len=1024))
+_register(GPT2Config(name='gpt2-debug', vocab_size=256, hidden_size=64,
+                     num_layers=2, num_heads=4, max_seq_len=128))
 
-def get_model_config(name: str) -> LlamaConfig:
-    if name not in _LLAMA_CONFIGS:
+# --------------------------------------------------------------- mixtral
+# Mixtral 8x7B (llm/mixtral serve recipe parity).
+_register(
+    MixtralConfig(name='mixtral-8x7b', vocab_size=32000, hidden_size=4096,
+                  intermediate_size=14336, num_layers=32, num_heads=32,
+                  num_kv_heads=8, num_experts=8, experts_per_token=2,
+                  max_seq_len=4096))
+_register(
+    MixtralConfig(name='mixtral-debug', vocab_size=256, hidden_size=64,
+                  intermediate_size=128, num_layers=2, num_heads=4,
+                  num_kv_heads=2, num_experts=4, experts_per_token=2,
+                  max_seq_len=128, tie_embeddings=True))
+
+# ------------------------------------------------------------------ bert
+_register(BertConfig(name='bert-base', vocab_size=30522, hidden_size=768,
+                     num_layers=12, num_heads=12, intermediate_size=3072,
+                     max_seq_len=512))
+_register(BertConfig(name='bert-debug', vocab_size=256, hidden_size=64,
+                     num_layers=2, num_heads=4, intermediate_size=128,
+                     max_seq_len=128))
+
+# ---------------------------------------------------------------- resnet
+_register(ResNetConfig(name='resnet50', stage_sizes=(3, 4, 6, 3)))
+_register(ResNetConfig(name='resnet18-debug', stage_sizes=(1, 1),
+                       width=8, num_classes=10))
+
+
+def get_model_config(name: str) -> Any:
+    if name not in _CONFIGS:
         raise ValueError(
-            f'Unknown model {name!r}. Available: {sorted(_LLAMA_CONFIGS)}')
-    return _LLAMA_CONFIGS[name]
+            f'Unknown model {name!r}. Available: {sorted(_CONFIGS)}')
+    return _CONFIGS[name]
 
 
 def list_models() -> List[str]:
-    return sorted(_LLAMA_CONFIGS)
+    return sorted(_CONFIGS)
+
+
+def build_model(config: Any, head: str = 'lm') -> nn.Module:
+    """Config -> flax module.  `head` selects the task head for
+    encoder/vision families ('lm' | 'mlm' | 'classify')."""
+    if isinstance(config, LlamaConfig):
+        return Llama(config)
+    if isinstance(config, GPT2Config):
+        return GPT2(config)
+    if isinstance(config, MixtralConfig):
+        return Mixtral(config)
+    if isinstance(config, BertConfig):
+        if head == 'classify':
+            return BertForSequenceClassification(config)
+        if head == 'mlm':
+            return BertForMaskedLM(config)
+        return Bert(config)
+    if isinstance(config, ResNetConfig):
+        return ResNet(config)
+    raise TypeError(f'No module builder for config type {type(config)}')
+
+
+def is_causal_lm(config: Any) -> bool:
+    """True for families the LM Trainer can train out of the box."""
+    return isinstance(config, (LlamaConfig, GPT2Config, MixtralConfig))

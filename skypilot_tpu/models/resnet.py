@@ -1,0 +1,80 @@
+"""ResNet family (v1.5 bottleneck) for vision workloads.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 compute
+with f32 BatchNorm statistics; convs map straight onto the MXU.
+
+Role parity: the reference's distributed ResNet recipes
+(examples/resnet_distributed_torch.yaml, resnet_app_storage_spot.yaml)
+and the BASELINE Flax-ResNet workload, as a native model family.
+"""
+import dataclasses
+import functools
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    name: str
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)     # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5,
+                                 dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1), name='conv1')(x)
+        y = nn.relu(norm(name='bn1')(y).astype(self.dtype))
+        y = conv(self.filters, (3, 3), self.strides, name='conv2')(y)
+        y = nn.relu(norm(name='bn2')(y).astype(self.dtype))
+        y = conv(4 * self.filters, (1, 1), name='conv3')(y)
+        y = norm(name='bn3', scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(4 * self.filters, (1, 1), self.strides,
+                            name='proj')(residual)
+            residual = norm(name='bn_proj')(residual)
+        return nn.relu((y + residual).astype(self.dtype))
+
+
+class ResNet(nn.Module):
+    """images [B, H, W, 3] -> logits [B, num_classes].
+
+    BatchNorm state lives in the 'batch_stats' collection: apply with
+    mutable=['batch_stats'] when train=True.
+    """
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=cfg.dtype, name='conv_init')(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32,
+                         name='bn_init')(x)
+        x = nn.relu(x.astype(cfg.dtype))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        for i, block_count in enumerate(cfg.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(cfg.width * 2 ** i, strides,
+                                    cfg.dtype,
+                                    name=f'stage{i}_block{j}')(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name='head')(x.astype(jnp.float32))

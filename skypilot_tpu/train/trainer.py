@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import optax
 from flax.training import train_state as flax_train_state
 
-from skypilot_tpu.models.llama import Llama, LlamaConfig
+from skypilot_tpu.models import registry as model_registry
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 
@@ -56,16 +56,17 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
 
 
 def create_sharded_state(
-        model_config: LlamaConfig, train_cfg: TrainConfig,
+        model_config: Any, train_cfg: TrainConfig,
         mesh: jax.sharding.Mesh,
         rng: jax.Array) -> Tuple[TrainState, Any]:
     """Initialize a TrainState with every leaf placed by its logical axes.
 
-    The init function is jit'd with out_shardings derived from the model's
-    logical annotations, so even 70B-class params are *born sharded* —
-    no single-host materialization.
+    Works for any causal-LM family (llama/gpt2/mixtral — see
+    registry.is_causal_lm).  The init function is jit'd with out_shardings
+    derived from the model's logical annotations, so even 70B-class
+    params are *born sharded* — no single-host materialization.
     """
-    model = Llama(model_config)
+    model = model_registry.build_model(model_config)
     tx = make_optimizer(train_cfg)
     sample = jnp.zeros((1, train_cfg.seq_len), jnp.int32)
 
@@ -110,8 +111,14 @@ def make_train_step(mesh: jax.sharding.Mesh
             mask = mask[:, 1:]
 
         def loss_fn(params):
-            logits = state.apply_fn({'params': params}, inputs)
-            return cross_entropy_loss(logits, targets, mask)
+            logits, mutables = state.apply_fn(
+                {'params': params}, inputs, mutable=['intermediates'])
+            loss = cross_entropy_loss(logits, targets, mask)
+            # MoE families sow per-layer router load-balancing losses.
+            inter = mutables.get('intermediates', {})
+            aux = sum(jnp.sum(jnp.asarray(leaf))
+                      for leaf in jax.tree.leaves(inter))
+            return loss + aux
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         new_state = state.apply_gradients(grads=grads)
@@ -151,11 +158,16 @@ class Trainer:
     """
 
     def __init__(self, cfg: TrainConfig,
-                 model_config: Optional[LlamaConfig] = None):
+                 model_config: Optional[Any] = None):
         from skypilot_tpu.models import registry
         self.cfg = cfg
         self.model_config = model_config or registry.get_model_config(
             cfg.model)
+        if not registry.is_causal_lm(self.model_config):
+            raise ValueError(
+                f'{cfg.model!r} is not a causal-LM family; use its '
+                'task-specific training loop (see models/bert.py, '
+                'models/resnet.py).')
         spec = cfg.mesh or mesh_lib.MeshSpec.auto(len(jax.devices()))
         self.mesh = mesh_lib.make_mesh(spec)
         self.state: Optional[TrainState] = None

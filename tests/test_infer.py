@@ -117,8 +117,11 @@ def test_oversized_prompt_does_not_kill_server_loop(tiny_config):
     srv.start()
     try:
         assert srv.ready.wait(120)
-        bad = srv.submit(Request(tokens=list(range(20))), timeout=30)
+        bad = srv.submit(Request(tokens=list(range(40))), timeout=30)
         assert bad is not None and bad.finish_reason == 'error'
+        zero = srv.submit(Request(tokens=[1, 2], max_new_tokens=0),
+                          timeout=30)
+        assert zero is not None and zero.finish_reason == 'error'
         ok = srv.submit(Request(tokens=[1, 2], max_new_tokens=2),
                         timeout=60)
         assert ok is not None and len(ok.output_tokens) == 2
