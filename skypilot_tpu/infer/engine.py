@@ -64,6 +64,7 @@ class RequestResult:
     latency_s: float              # arrival/submit -> last token
     finish_reason: str            # 'eos' | 'length' | 'error'
     error: Optional[str] = None
+    error_class: Optional[str] = None   # 'client' | 'internal'
 
 
 class _Slot:
@@ -94,14 +95,14 @@ class InferenceEngine:
                  rng: Optional[jax.Array] = None):
         self.model_config = model_config
         self.cfg = cfg or InferConfig()
-        if self.cfg.max_cache_len > model_config.max_seq_len:
-            raise ValueError(
-                f'max_cache_len {self.cfg.max_cache_len} exceeds model '
-                f'max_seq_len {model_config.max_seq_len}')
         if not isinstance(model_config, LlamaConfig):
             raise TypeError(
                 'InferenceEngine currently supports the Llama family '
                 f'(KV-cache decode path); got {type(model_config).__name__}')
+        if self.cfg.max_cache_len > model_config.max_seq_len:
+            raise ValueError(
+                f'max_cache_len {self.cfg.max_cache_len} exceeds model '
+                f'max_seq_len {model_config.max_seq_len}')
         self.model = Llama(model_config)
         buckets = tuple(b for b in self.cfg.prefill_buckets
                         if b <= self.cfg.max_cache_len)
@@ -300,7 +301,8 @@ class InferenceEngine:
                             request_id=req.request_id,
                             prompt_tokens=list(req.tokens),
                             output_tokens=[], ttft_s=0.0, latency_s=0.0,
-                            finish_reason='error', error=str(e))))
+                            finish_reason='error', error=str(e),
+                            error_class='client')))
                 # Harvest between prefill and decode: the prefill already
                 # produced one token, which may satisfy max_new_tokens=1
                 # or be the EOS.
@@ -334,12 +336,16 @@ class InferenceEngine:
                 except Exception as e:  # pylint: disable=broad-except
                     # ANY per-request failure must not kill the serving
                     # loop (the thread is the whole data plane); report
-                    # it as an error result instead.
+                    # it as an error result.  ValueError = the request
+                    # was bad (HTTP 400); anything else is our fault
+                    # (HTTP 500).
+                    klass = 'client' if isinstance(e, ValueError) \
+                        else 'internal'
                     result_cb(RequestResult(
                         request_id=req.request_id,
                         prompt_tokens=list(req.tokens), output_tokens=[],
                         ttft_s=0.0, latency_s=0.0, finish_reason='error',
-                        error=str(e)))
+                        error=str(e), error_class=klass))
                 moved = True
             with self._lock:
                 for _, res in self._harvest():   # prefill-only finishes

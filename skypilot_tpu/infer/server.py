@@ -151,7 +151,8 @@ def _make_handler(server: InferenceServer):
                 self._json(504, {'error': 'timed out'})
                 return
             if res.finish_reason == 'error':
-                self._json(400, {'error': res.error or 'bad request'})
+                code = 500 if res.error_class == 'internal' else 400
+                self._json(code, {'error': res.error or 'bad request'})
                 return
             out = {
                 'output_tokens': res.output_tokens,

@@ -107,6 +107,12 @@ class Bert(nn.Module):
     @nn.compact
     def __call__(self, tokens, type_ids=None, attention_mask=None):
         cfg = self.config
+        if tokens.shape[1] > cfg.max_seq_len:
+            # Learned-position table: out-of-range indexing would clamp
+            # SILENTLY (jnp semantics), so reject over-long inputs here.
+            raise ValueError(
+                f'sequence length {tokens.shape[1]} exceeds max_seq_len '
+                f'{cfg.max_seq_len}')
         positions = jnp.arange(tokens.shape[1])[None]
         wte = self.param(
             'word_embeddings', nn.with_logical_partitioning(

@@ -122,6 +122,12 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, tokens, positions: Optional[jax.Array] = None):
         cfg = self.config
+        if tokens.shape[1] > cfg.max_seq_len:
+            # Learned-position table: out-of-range indexing would clamp
+            # SILENTLY (jnp semantics), so reject over-long inputs here.
+            raise ValueError(
+                f'sequence length {tokens.shape[1]} exceeds max_seq_len '
+                f'{cfg.max_seq_len}')
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1])[None], tokens.shape)

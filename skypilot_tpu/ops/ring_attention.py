@@ -131,6 +131,17 @@ def ulysses_attention(q: jax.Array,
                           concat_axis=1, tiled=True)
 
 
+def _inside_manual_region() -> bool:
+    """True when tracing inside a shard_map body (manual axes bound) —
+    nesting another shard_map there is not allowed, so attention must run
+    as plain per-shard flash and let the caller own the collectives."""
+    try:
+        from jax._src import core as jcore
+        return bool(jcore.get_axis_env().axis_sizes)
+    except (ImportError, AttributeError):
+        return False
+
+
 def _active_mesh() -> Optional[jax.sharding.Mesh]:
     # thread_resources lives in a private module; guard the import so a
     # jax upgrade degrades to "no seq parallelism unless mesh is passed
@@ -177,6 +188,8 @@ def sequence_parallel_attention(q: jax.Array,
     shard_map hands each device its block).  Falls back to plain flash
     attention when the mesh has no seq parallelism.
     """
+    if _inside_manual_region():
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     mesh = mesh if mesh is not None else _active_mesh()
     p = jax.sharding.PartitionSpec
     if mesh is not None and not _shapes_divide(q, k, mesh):

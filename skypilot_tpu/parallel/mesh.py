@@ -1,7 +1,7 @@
 """Device meshes + logical axis rules (the sharding vocabulary).
 
-Design: a 4-axis mesh ('data', 'fsdp', 'seq', 'tensor') covering the
-parallelism strategies the reference ships as NCCL recipes
+Design: a 5-axis mesh ('data', 'fsdp', 'seq', 'tensor', 'stage')
+covering the parallelism strategies the reference ships as NCCL recipes
 (SURVEY.md §2.9):
 
   data   — pure data parallel; gradients all-reduce (DCN-friendly: this is
@@ -12,6 +12,12 @@ parallelism strategies the reference ships as NCCL recipes
            neighbors.
   tensor — Megatron-style tensor parallel for mlp/heads. Innermost, needs
            the fastest ICI.
+  stage  — GPipe pipeline stages (parallel/pipeline.py): activations hop
+           stage->stage+1 with ppermute; never referenced by logical
+           axis rules (stage parallelism partitions LAYERS, not tensors).
+           Outermost: stage hops are infrequent (once per microbatch) so
+           this is the axis to span DCN/multi-slice with, alongside
+           'data'.
 
 Model code never names mesh axes: it uses LOGICAL axes ('batch', 'embed',
 'mlp', 'heads', ...) mapped here — swapping strategies is a rules edit,
@@ -24,7 +30,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-MESH_AXES = ('data', 'fsdp', 'seq', 'tensor')
+MESH_AXES = ('stage', 'data', 'fsdp', 'seq', 'tensor')
 
 # Logical axis -> mesh axis (or tuple: sharded over both, or None).
 _BASE_RULES: List[Tuple[str, object]] = [
@@ -53,14 +59,15 @@ class MeshSpec:
     fsdp: int = 1
     seq: int = 1
     tensor: int = 1
+    stage: int = 1
 
     @property
-    def shape(self) -> Tuple[int, int, int, int]:
-        return (self.data, self.fsdp, self.seq, self.tensor)
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.stage, self.data, self.fsdp, self.seq, self.tensor)
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.seq * self.tensor
+        return self.data * self.fsdp * self.seq * self.tensor * self.stage
 
     @classmethod
     def fsdp_only(cls, n: int) -> 'MeshSpec':

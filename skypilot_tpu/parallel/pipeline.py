@@ -1,0 +1,230 @@
+"""GPipe pipeline parallelism over the mesh 'stage' axis.
+
+The reference has no framework-level pipeline parallelism (SURVEY.md
+§2.9: its "pipeline" example is DAG stage-chaining, not micro-batch PP).
+Here it is a mesh axis: layers are partitioned into S stages, each stage's
+parameters live only on its stage's devices (leading stacked dim sharded
+over 'stage'), and activations hop stage→stage+1 with `ppermute` while
+M microbatches flow through the classic GPipe schedule (M + S - 1 ticks,
+bubble fraction (S-1)/(M+S-1)).
+
+Everything runs inside one `shard_map` under jit: the backward schedule
+falls out of reverse-mode AD (ppermute's transpose is the reverse hop),
+and `jax.checkpoint` around the stage body keeps activation memory at
+one microbatch per stage.
+
+Composability: the 'stage' axis is orthogonal to data/fsdp/seq/tensor —
+inside a stage, tensors keep their logical shardings on the remaining
+axes.  Put 'stage' (and 'data') across DCN when spanning slices: one
+activation hop per microbatch is the cheapest cross-slice traffic
+pattern.
+"""
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+P = jax.sharding.PartitionSpec
+
+
+def pipeline_degree(mesh: Optional[jax.sharding.Mesh] = None) -> int:
+    mesh = mesh if mesh is not None else _active_mesh()
+    if mesh is None or 'stage' not in mesh.shape:
+        return 1
+    return mesh.shape['stage']
+
+
+def _active_mesh() -> Optional[jax.sharding.Mesh]:
+    try:
+        from jax._src import mesh as jmesh
+        m = jmesh.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        return None
+    return None if m.empty else m
+
+
+def pipeline(stage_fn: Callable[[Any, jax.Array, Any], jax.Array],
+             stage_params: Any,
+             microbatches: jax.Array,
+             consts: Any,
+             mesh: jax.sharding.Mesh,
+             axis_name: str = 'stage') -> jax.Array:
+    """Run microbatches through S pipeline stages.
+
+    Args:
+      stage_fn: (params_for_one_stage, x, consts) -> y, with y.shape ==
+        x.shape (a chunk of transformer layers).
+      stage_params: pytree whose every leaf has leading dim S (stacked
+        per-stage weights); sharded over 'stage'.
+      microbatches: [M, mb, ...] stage-0 inputs.  The per-microbatch
+        batch dim may additionally be sharded over data/fsdp.
+      consts: pytree broadcast to every stage invocation (e.g. positions).
+      mesh: the device mesh (must contain `axis_name`).
+
+    Returns [M, mb, ...] last-stage outputs (replicated over 'stage').
+    """
+    num_stages = mesh.shape[axis_name]
+    num_micro = microbatches.shape[0]
+    if num_micro < num_stages:
+        raise ValueError(
+            f'need microbatches ({num_micro}) >= stages ({num_stages}) '
+            'to fill the pipeline')
+
+    def run(params, mbs, consts):
+        # Leaves arrive as [1, ...] slices of the stacked stage dim.
+        params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+        s = lax.axis_index(axis_name)
+        body = jax.checkpoint(
+            lambda p, x, c: stage_fn(p, x, c))
+        perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        buf = jnp.zeros_like(mbs[0])
+        outputs = jnp.zeros_like(mbs)
+        for t in range(num_micro + num_stages - 1):
+            mb_idx = min(t, num_micro - 1)
+            x0 = mbs[mb_idx]
+            x = jnp.where(s == 0, x0, buf)
+            y = body(params, x, consts)
+            out_idx = max(t - (num_stages - 1), 0)
+            take = (s == num_stages - 1) & (t >= num_stages - 1)
+            outputs = outputs.at[out_idx].set(
+                jnp.where(take, y, outputs[out_idx]))
+            if t != num_micro + num_stages - 2:
+                buf = lax.ppermute(y, axis_name, perm)
+        # Broadcast the last stage's outputs to every stage so downstream
+        # (head/loss) math is replicated over 'stage'.
+        outputs = lax.psum(
+            jnp.where(s == num_stages - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs
+
+    batch_axes = ('data', 'fsdp')
+    x_spec = P(None, batch_axes)           # [M, mb, ...]: mb data-sharded
+    return jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(P(axis_name), x_spec, P()),
+        out_specs=x_spec)(stage_params, microbatches, consts)
+
+
+class PipelinedLM:
+    """A Llama-family LM with its decoder stack pipelined over 'stage'.
+
+    Parameters:
+      {'embed': [V, H] (replicated over stage),
+       'stages': stacked per-stage DecoderLayer params ([S, ...] leaves),
+       'final_norm': RMSNorm scale}
+    Embedding and the (tied) LM head are computed replicated on every
+    stage — they are O(1%) of the FLOPs; the layer stack is what
+    pipelines.
+
+    Reference contrast: llm/gpt-2/gpt2-pipeline.yaml chains whole TASKS
+    (data stage -> train stage); this is true micro-batch model
+    parallelism.
+    """
+
+    def __init__(self, config, num_stages: int, num_microbatches: int):
+        from skypilot_tpu.models.llama import DecoderLayer
+        if config.num_layers % num_stages:
+            raise ValueError(
+                f'num_layers {config.num_layers} must divide evenly into '
+                f'{num_stages} stages')
+        self.config = config
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.layers_per_stage = config.num_layers // num_stages
+
+        import flax.linen as nn
+
+        cfg = config
+        layers_per_stage = self.layers_per_stage
+
+        class Stage(nn.Module):
+
+            @nn.compact
+            def __call__(self, x, positions):
+                for i in range(layers_per_stage):
+                    x = DecoderLayer(cfg, name=f'layer_{i}')(x, positions)
+                return x
+
+        self._stage_module = Stage()
+
+    def init(self, rng: jax.Array, sample_tokens: jax.Array) -> Any:
+        cfg = self.config
+        h = cfg.hidden_size
+        rng_e, rng_s, rng_n = jax.random.split(rng, 3)
+        embed = jax.random.normal(rng_e, (cfg.vocab_size, h),
+                                  jnp.float32) * 0.02
+        x = jnp.zeros((1, sample_tokens.shape[1], h), cfg.dtype)
+        positions = jnp.zeros((1, sample_tokens.shape[1]), jnp.int32)
+
+        def init_one(key):
+            return self._stage_module.init(key, x, positions)['params']
+
+        stage_keys = jax.random.split(rng_s, self.num_stages)
+        stages = jax.vmap(init_one)(stage_keys)
+        return {
+            'embed': embed,
+            'stages': stages,
+            'final_norm': jnp.zeros((h,), jnp.float32),
+        }
+
+    def apply(self, params: Any, tokens: jax.Array,
+              mesh: Optional[jax.sharding.Mesh] = None) -> jax.Array:
+        """tokens [B, S] -> logits [B, S, V] (tied embeddings)."""
+        from skypilot_tpu.models.llama import rmsnorm
+        cfg = self.config
+        mesh = mesh if mesh is not None else _active_mesh()
+        assert mesh is not None, 'PipelinedLM needs an active mesh'
+        b, seq = tokens.shape
+        m = self.num_microbatches
+        if b % m:
+            raise ValueError(f'batch {b} must divide microbatches {m}')
+        # [1, seq]: broadcasts against any local batch size inside the
+        # shard_map (rope broadcasts the batch dim), so it can ride the
+        # replicated `consts` slot regardless of data sharding.
+        positions = jnp.arange(seq)[None]
+        x = params['embed'].astype(cfg.dtype)[tokens]
+        mbs = x.reshape(m, b // m, seq, cfg.hidden_size)
+
+        def stage_fn(stage_params, xmb, consts):
+            return self._stage_module.apply({'params': stage_params}, xmb,
+                                            consts)
+
+        out = pipeline(stage_fn, params['stages'], mbs, positions, mesh)
+        out = out.reshape(b, seq, cfg.hidden_size)
+        out = rmsnorm(out, params['final_norm'], cfg.norm_eps)
+        return out.astype(jnp.float32) @ \
+            params['embed'].astype(jnp.float32).T
+
+
+def make_pipelined_train_step(model: PipelinedLM,
+                              mesh: jax.sharding.Mesh,
+                              learning_rate: float = 3e-4):
+    """Minimal adamw train step for a PipelinedLM (used by tests and the
+    multichip dryrun's pp configuration)."""
+    import optax
+
+    tx = optax.adamw(learning_rate)
+
+    def init_state(rng, sample_tokens):
+        params = model.init(rng, sample_tokens)
+        return params, tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+        def loss_fn(p):
+            logits = model.apply(p, inputs, mesh)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state2 = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    return init_state, step

@@ -115,9 +115,15 @@ def make_train_step(mesh: jax.sharding.Mesh
                 {'params': params}, inputs, mutable=['intermediates'])
             loss = cross_entropy_loss(logits, targets, mask)
             # MoE families sow per-layer router load-balancing losses.
+            # Filter by key: other sowed intermediates (diagnostics)
+            # must NOT leak into the loss.
             inter = mutables.get('intermediates', {})
-            aux = sum(jnp.sum(jnp.asarray(leaf))
-                      for leaf in jax.tree.leaves(inter))
+            aux = sum(
+                jnp.sum(jnp.asarray(v))
+                for path, v in jax.tree_util.tree_flatten_with_path(
+                    inter)[0]
+                if any(getattr(k, 'key', None) == 'router_aux_loss'
+                       for k in path))
             return loss + aux
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
