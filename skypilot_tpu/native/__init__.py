@@ -1,0 +1,87 @@
+"""Native (C++) runtime components, built on demand on each host.
+
+The reference delegates job-process supervision to Ray's C++ worker
+management plus Python helpers (sky/skylet/log_lib.py:131 run_with_log,
+sky/skylet/subprocess_daemon.py).  This framework owns that path natively:
+`src/supervisor.cc` runs the job in its own session, tees output to a
+host-local log (so logs survive a dropped ssh connection), records the
+process-group id for gang-cancel, and reaps surviving grandchildren.
+
+Build model: the C++ source travels with the package (the provisioner
+rsyncs the whole package tree to every host), and each host compiles it
+once per source hash into $SKYTPU_HOME/native/bin/ via the single build
+recipe in build_host.py (stdlib-only so job hosts can run it bare).
+Every consumer must tolerate a missing binary (no compiler on the host) —
+the shell fallback in podlet/driver.py keeps the system working, just
+without host-local log durability and true session isolation.
+"""
+import os
+import threading
+from typing import Optional
+
+from skypilot_tpu import logsys
+from skypilot_tpu.native import build_host
+from skypilot_tpu.utils import locks as locks_lib
+
+logger = logsys.init_logger(__name__)
+
+SUPERVISOR_NAME = build_host.SUPERVISOR_NAME
+
+_build_lock = threading.Lock()
+_build_cache: dict = {}
+
+
+def source_path() -> str:
+    return build_host.default_source()
+
+
+def source_hash() -> str:
+    return build_host.source_hash(source_path())
+
+
+def _bin_dir() -> str:
+    from skypilot_tpu.utils import common
+    return os.path.join(common.home_dir(), 'native', 'bin')
+
+
+def installed_bin_path() -> str:
+    """Where job hosts look for the binary ($HOME-relative, stable name)."""
+    return os.path.join(_bin_dir(), SUPERVISOR_NAME)
+
+
+def supervisor_path(build: bool = True) -> Optional[str]:
+    """Absolute path of a supervisor binary matching the current source,
+    building it if necessary.  Returns None when it cannot be produced
+    (no g++ on this machine, or compilation failed) — callers must fall
+    back to the pure-Python / plain-shell path.
+    """
+    src_hash = source_hash()
+    cached = _build_cache.get(src_hash)
+    if cached is not None:
+        return cached or None  # '' caches a failed build
+    versioned = os.path.join(_bin_dir(), f'{SUPERVISOR_NAME}-{src_hash}')
+    if os.path.exists(versioned):
+        _build_cache[src_hash] = versioned
+        return versioned
+    if not build:
+        return None
+    with _build_lock, locks_lib.named_lock('native-build'):
+        path = build_host.build(source_path(), _bin_dir())
+        if path is None:
+            logger.warning('Native supervisor unavailable (no compiler or '
+                           'build failed); using shell fallback.')
+        _build_cache[src_hash] = path or ''
+        return path
+
+
+def host_build_script() -> str:
+    """Shell one-liner that builds + installs the supervisor ON a job host
+    by running the SAME recipe (build_host.py) with the host's python3.
+
+    Run once per runtime sync (post_provision_runtime_setup); idempotent via
+    the source-hash-named binary.  Never fails the setup: a host without a
+    compiler simply runs jobs through the shell fallback.
+    """
+    script = ('$HOME/.skytpu_runtime/skypilot_tpu/native/build_host.py')
+    return (f'if [ -f {script} ] && command -v python3 >/dev/null; then '
+            f'python3 {script} || true; fi; true')

@@ -1,0 +1,84 @@
+"""Build + install the native supervisor on this machine.
+
+The ONE implementation of the build recipe, used by (a) the client via
+`skypilot_tpu.native.supervisor_path()` and (b) job hosts, where the
+provisioner runs this file with the host's `python3` right after rsyncing
+the runtime tree (see native.host_build_script()).  Stdlib-only on purpose:
+job hosts may not have the framework's Python dependencies installed when
+this runs.
+
+Install layout: `<bindir>/skytpu-supervisor-<hash12>` (content-addressed,
+idempotent) plus a stable `<bindir>/skytpu-supervisor` symlink that job
+commands reference without knowing the hash.
+"""
+import argparse
+import hashlib
+import os
+import shutil
+import subprocess
+import sys
+from typing import Optional
+
+SUPERVISOR_NAME = 'skytpu-supervisor'
+CXX_FLAGS = ['-O2', '-std=c++17']
+
+
+def default_source() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), 'src',
+                        'supervisor.cc')
+
+
+def source_hash(src: str) -> str:
+    with open(src, 'rb') as f:
+        return hashlib.sha256(f.read()).hexdigest()[:12]
+
+
+def build(src: str, bindir: str) -> Optional[str]:
+    """Compile src into bindir (idempotent); returns the versioned binary
+    path, or None when no compiler is available or compilation fails."""
+    compiler = shutil.which('g++') or shutil.which('c++')
+    if compiler is None:
+        return None
+    versioned = os.path.join(bindir, f'{SUPERVISOR_NAME}-{source_hash(src)}')
+    if not os.path.exists(versioned):
+        os.makedirs(bindir, exist_ok=True)
+        tmp = f'{versioned}.tmp.{os.getpid()}'
+        proc = subprocess.run([compiler, *CXX_FLAGS, '-o', tmp, src],
+                              capture_output=True, text=True, check=False)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr[:2000])
+            return None
+        os.replace(tmp, versioned)  # atomic: concurrent builders both win
+    stable = os.path.join(bindir, SUPERVISOR_NAME)
+    tmp_link = f'{stable}.tmp.{os.getpid()}'
+    try:
+        os.symlink(versioned, tmp_link)
+        os.replace(tmp_link, stable)
+    except OSError:
+        pass
+    return versioned
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--src', default=default_source())
+    parser.add_argument(
+        '--bindir',
+        default=os.path.expanduser(
+            os.path.join(os.environ.get('SKYTPU_HOME', '~/.skytpu'),
+                         'native', 'bin')))
+    args = parser.parse_args()
+    if not os.path.exists(args.src):
+        return 0  # source-less host: nothing to do, not an error
+    path = build(args.src, args.bindir)
+    if path is None:
+        sys.stderr.write('skytpu: native supervisor unavailable '
+                         '(no compiler or build failed); jobs will use the '
+                         'shell fallback.\n')
+        return 0  # never fail host setup over the optional native path
+    print(path)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,206 @@
+// skytpu-supervisor: native per-host job supervisor.
+//
+// Runs a command in its OWN SESSION (setsid), tees its merged
+// stdout/stderr to a host-local log file AND to our stdout (so the ssh
+// channel still streams lines back to the head host), records the
+// process-group id for gang-cancel, forwards SIGTERM/SIGINT to the whole
+// group, and reaps surviving grandchildren when the job ends.
+//
+// Role parity (reference, rebuilt native instead of Python):
+//   - sky/skylet/log_lib.py:131 run_with_log  (tee loop -> C++ read/write)
+//   - sky/skylet/subprocess_daemon.py         (process-tree reaping)
+//   - Ray worker process management           (the reference delegates
+//     job process supervision to Ray; this framework owns it)
+//
+// Usage:
+//   skytpu-supervisor --log PATH --pgid-file PATH [--grace-ms N]
+//                     -- CMD [ARGS...]
+// Exit code: the child's exit code, or 128+signal if it died by signal.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+volatile sig_atomic_t g_signal = 0;
+
+void handle_signal(int sig) { g_signal = sig; }
+
+void die(const char* msg) {
+  perror(msg);
+  exit(127);
+}
+
+// Write all of buf, retrying on short writes/EINTR. Returns false on error.
+bool write_all(int fd, const char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t w = write(fd, buf, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    buf += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* log_path = nullptr;
+  const char* pgid_path = nullptr;
+  long grace_ms = 2000;
+  int cmd_start = -1;
+  for (int i = 1; i < argc; i++) {
+    if (!strcmp(argv[i], "--log") && i + 1 < argc) {
+      log_path = argv[++i];
+    } else if (!strcmp(argv[i], "--pgid-file") && i + 1 < argc) {
+      pgid_path = argv[++i];
+    } else if (!strcmp(argv[i], "--grace-ms") && i + 1 < argc) {
+      grace_ms = atol(argv[++i]);
+    } else if (!strcmp(argv[i], "--")) {
+      cmd_start = i + 1;
+      break;
+    } else {
+      fprintf(stderr, "skytpu-supervisor: unknown arg %s\n", argv[i]);
+      return 127;
+    }
+  }
+  if (cmd_start < 0 || cmd_start >= argc) {
+    fprintf(stderr,
+            "usage: skytpu-supervisor --log PATH --pgid-file PATH "
+            "[--grace-ms N] -- CMD [ARGS...]\n");
+    return 127;
+  }
+
+  int log_fd = -1;
+  if (log_path) {
+    log_fd = open(log_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (log_fd < 0) die("skytpu-supervisor: open log");
+  }
+
+  int pipefd[2];
+  if (pipe(pipefd) < 0) die("pipe");
+
+  pid_t child = fork();
+  if (child < 0) die("fork");
+  if (child == 0) {
+    // Child: new session => new process group; pgid == pid. Every
+    // descendant the job spawns stays in this group unless it setsids
+    // itself.
+    setsid();
+    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDERR_FILENO);
+    close(pipefd[0]);
+    close(pipefd[1]);
+    if (log_fd >= 0) close(log_fd);
+    execvp(argv[cmd_start], &argv[cmd_start]);
+    perror("skytpu-supervisor: execvp");
+    _exit(127);
+  }
+  close(pipefd[1]);
+
+  if (pgid_path) {
+    FILE* f = fopen(pgid_path, "w");
+    if (f) {
+      fprintf(f, "%d\n", static_cast<int>(child));
+      fclose(f);
+    }
+  }
+
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = handle_signal;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+  signal(SIGPIPE, SIG_IGN);  // head-side ssh teardown must not kill us
+
+  bool child_exited = false;
+  int child_status = 0;
+  bool signaled_group = false;
+  bool eof = false;
+  long long drain_deadline_ms = -1;
+  std::vector<char> buf(1 << 16);
+
+  auto now_ms = []() -> long long {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<long long>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+  };
+
+  while (true) {
+    if (g_signal) {
+      g_signal = 0;
+      if (!signaled_group) {
+        // Cancel: forward to the whole group (grandchildren included).
+        kill(-child, SIGTERM);
+        signaled_group = true;
+      } else {
+        kill(-child, SIGKILL);  // second signal: escalate
+      }
+    }
+    if (!child_exited) {
+      pid_t r = waitpid(child, &child_status, WNOHANG);
+      if (r == child) {
+        child_exited = true;
+        drain_deadline_ms = now_ms() + grace_ms;
+      }
+    }
+    // Enforce the drain window unconditionally: a chatty surviving
+    // grandchild that keeps the pipe saturated must not pin the
+    // supervisor (and the gang driver waiting on it) forever.
+    if (child_exited && drain_deadline_ms >= 0 &&
+        now_ms() >= drain_deadline_ms) {
+      break;
+    }
+    if (eof) {
+      // Every writer closed the pipe; only the child's exit remains.
+      if (child_exited) break;
+      usleep(100 * 1000);
+      continue;
+    }
+    struct pollfd pfd = {pipefd[0], POLLIN, 0};
+    int timeout = child_exited ? 100 : 200;
+    int pr = poll(&pfd, 1, timeout);
+    if (pr > 0 && (pfd.revents & (POLLIN | POLLHUP))) {
+      ssize_t n = read(pipefd[0], buf.data(), buf.size());
+      if (n > 0) {
+        write_all(STDOUT_FILENO, buf.data(), static_cast<size_t>(n));
+        if (log_fd >= 0) write_all(log_fd, buf.data(),
+                                   static_cast<size_t>(n));
+        continue;
+      }
+      if (n == 0) {
+        if (child_exited) break;  // done draining
+        eof = true;  // child closed stdout but still runs
+      }
+    }
+  }
+
+  if (!child_exited) {
+    waitpid(child, &child_status, 0);
+  }
+  // Reap stragglers: once the job's main process is gone, surviving
+  // group members are orphans of THIS job (parity: subprocess_daemon).
+  kill(-child, SIGTERM);
+  usleep(50 * 1000);
+  kill(-child, SIGKILL);
+
+  if (log_fd >= 0) close(log_fd);
+  if (WIFSIGNALED(child_status)) return 128 + WTERMSIG(child_status);
+  return WEXITSTATUS(child_status);
+}

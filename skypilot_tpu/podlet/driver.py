@@ -81,16 +81,33 @@ def build_host_env(info: ClusterInfo, rank: int, job_id: int,
     return env
 
 
+def _wrap_with_supervisor(job_id: int, rank: int, run_script_remote: str,
+                          supervisor_bin: str) -> str:
+    """Command that runs the job under the native supervisor when the
+    host has one, else falls back to a recorded-pgid plain shell.
+
+    The supervisor (native/src/supervisor.cc) runs the script in its own
+    session, tees output to a HOST-LOCAL log (survives a dropped ssh
+    connection), writes the true process-group id for gang-cancel, and
+    reaps surviving grandchildren — the roles the reference delegates to
+    Ray worker management + sky/skylet/subprocess_daemon.py.
+    """
+    job_dir = f'~/.skytpu/jobs/{job_id}'
+    pgid_file = f'{job_dir}/host{rank}.pgid'
+    local_log = f'{job_dir}/host{rank}.local.log'
+    return (f'mkdir -p {job_dir} && '
+            f'if [ -x {supervisor_bin} ]; then '
+            f'exec {supervisor_bin} --log {local_log} '
+            f'--pgid-file {pgid_file} -- bash {run_script_remote}; '
+            f'else echo $$ > {pgid_file} && '
+            f'exec bash {run_script_remote}; fi')
+
+
 def _run_on_host(runner, rank: int, job_id: int, run_script_remote: str,
                  env: Dict[str, str], host_log: str,
                  merged_log_lock: threading.Lock, merged_log_path: str,
                  cancel_event: threading.Event) -> int:
     """Run the job on one host, teeing output to per-host + merged logs."""
-    pgid_file = f'~/.skytpu/jobs/{job_id}/host{rank}.pgid'
-    # Record the remote process-group id so gang-cancel can kill it.
-    wrapped = (f'mkdir -p ~/.skytpu/jobs/{job_id} && '
-               f'echo $$ > {pgid_file} && '
-               f'exec bash {run_script_remote}')
 
     def _hook_factory():
         merged = open(merged_log_path, 'a', encoding='utf-8')
@@ -102,9 +119,14 @@ def _run_on_host(runner, rank: int, job_id: int, run_script_remote: str,
 
         return hook
 
+    from skypilot_tpu import native
     from skypilot_tpu.utils import subprocess_utils
     from skypilot_tpu.utils.command_runner import LocalProcessRunner
     if isinstance(runner, LocalProcessRunner):
+        # Same machine: use the client-built binary by absolute path (the
+        # per-host fake $HOME has no native/bin of its own).
+        sup = native.supervisor_path() or '/nonexistent'
+        wrapped = _wrap_with_supervisor(job_id, rank, run_script_remote, sup)
         rc, _ = subprocess_utils.run_with_log(
             ['bash', '-c', wrapped],
             host_log,
@@ -113,6 +135,11 @@ def _run_on_host(runner, rank: int, job_id: int, run_script_remote: str,
         )
         return rc
     # SSH runner: env is exported inline; output streams over the ssh pipe.
+    # The supervisor was built on the host at provision time
+    # (native.host_build_script); a compiler-less host falls back.
+    wrapped = _wrap_with_supervisor(job_id, rank, run_script_remote,
+                                    '$HOME/.skytpu/native/bin/'
+                                    f'{native.SUPERVISOR_NAME}')
     exports = ' '.join(
         f'export {k}={shlex.quote(str(v))};' for k, v in env.items())
     rc, _ = subprocess_utils.run_with_log(

@@ -17,7 +17,7 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu import exceptions, logsys, provision
+from skypilot_tpu import exceptions, logsys, native, provision
 from skypilot_tpu.podlet import driver as driver_lib
 from skypilot_tpu.provision.common import ClusterInfo, ProvisionRecord
 from skypilot_tpu.provision.common import metadata_dir
@@ -116,6 +116,10 @@ def post_provision_runtime_setup(cluster_name: str, cluster_info: ClusterInfo,
         runner.run(f'mkdir -p {_RUNTIME_DIR} ~/.skytpu', log_path=log_path)
         runner.rsync(pkg_root + '/', f'{_RUNTIME_DIR}/skypilot_tpu/',
                      up=True, log_path=log_path)
+        # Compile the native job supervisor on the host (idempotent per
+        # source hash; a compiler-less host just uses the shell fallback).
+        if cluster_info.provider != 'local':
+            runner.run(native.host_build_script(), log_path=log_path)
         _mark(cluster_name, runner.node_id, 'runtime', token)
 
     subprocess_utils.run_in_parallel(_sync_runtime, list(range(len(runners))))

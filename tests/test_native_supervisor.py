@@ -1,0 +1,162 @@
+"""Tests for the native C++ job supervisor (skypilot_tpu/native).
+
+Covers the roles the reference delegates to Ray process management +
+sky/skylet/subprocess_daemon.py: exit-code propagation, output teeing to a
+host-local log, true process-group recording for gang-cancel, and
+grandchild reaping.
+"""
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+from skypilot_tpu import native
+
+
+@pytest.fixture(scope='module')
+def supervisor():
+    path = native.supervisor_path()
+    if path is None:
+        pytest.skip('no C++ compiler available')
+    return path
+
+
+def _run(supervisor, tmp_path, cmd, timeout=30):
+    log = tmp_path / 'out.log'
+    pgid = tmp_path / 'job.pgid'
+    proc = subprocess.run(
+        [supervisor, '--log', str(log), '--pgid-file', str(pgid), '--',
+         'bash', '-c', cmd],
+        capture_output=True, text=True, timeout=timeout, check=False)
+    return proc, log, pgid
+
+
+def test_build_is_cached(supervisor):
+    # Second call returns the same binary without rebuilding.
+    assert native.supervisor_path() == supervisor
+    assert os.path.exists(supervisor)
+
+
+def test_exit_code_and_tee(supervisor, tmp_path):
+    proc, log, pgid = _run(supervisor, tmp_path, 'echo hello; exit 7')
+    assert proc.returncode == 7
+    # Output goes BOTH to stdout (streams back over ssh) and the log file
+    # (survives a dropped connection).
+    assert 'hello' in proc.stdout
+    assert 'hello' in log.read_text()
+    assert pgid.read_text().strip().isdigit()
+
+
+def test_signal_death_reports_128_plus_sig(supervisor, tmp_path):
+    proc, _, _ = _run(supervisor, tmp_path, 'kill -TERM $$')
+    assert proc.returncode == 128 + signal.SIGTERM
+
+
+def test_stderr_captured(supervisor, tmp_path):
+    proc, log, _ = _run(supervisor, tmp_path, 'echo oops >&2')
+    assert proc.returncode == 0
+    assert 'oops' in log.read_text()
+
+
+def test_term_kills_whole_group(supervisor, tmp_path):
+    """Cancel semantics: SIGTERM to the supervisor terminates the job AND
+    its background children (the recorded pgid is a real session id)."""
+    log = tmp_path / 'out.log'
+    pgid_file = tmp_path / 'job.pgid'
+    marker = tmp_path / 'grandchild.pid'
+    proc = subprocess.Popen(
+        [supervisor, '--log', str(log), '--pgid-file', str(pgid_file), '--',
+         'bash', '-c',
+         f'sleep 300 & echo $! > {marker}; wait'],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 10
+    while not marker.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    assert marker.exists(), 'job never started'
+    grandchild = int(marker.read_text().strip())
+    pgid = int(pgid_file.read_text().strip())
+    # The job runs in its own session: its pgid is NOT the test's.
+    assert os.getpgid(grandchild) == pgid
+    assert pgid != os.getpgid(0)
+
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=15)
+    assert rc != 0
+    # Grandchild must be gone (reaped by group TERM/KILL).
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            os.kill(grandchild, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        os.killpg(pgid, signal.SIGKILL)
+        pytest.fail('grandchild survived supervisor TERM')
+
+
+def test_orphan_reaped_after_job_exit(supervisor, tmp_path):
+    """A background process leaked by the job is killed when the job's main
+    process exits (parity: subprocess_daemon grandchild reaping)."""
+    marker = tmp_path / 'leak.pid'
+    proc, _, _ = _run(
+        supervisor, tmp_path,
+        f'setsid_free() {{ sleep 300 & echo $! > {marker}; }}; '
+        f'setsid_free; exit 0', timeout=30)
+    assert proc.returncode == 0
+    leaked = int(marker.read_text().strip())
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            os.kill(leaked, 0)
+        except ProcessLookupError:
+            return
+        time.sleep(0.1)
+    os.kill(leaked, signal.SIGKILL)
+    pytest.fail('leaked background process survived job end')
+
+
+def test_chatty_grandchild_does_not_pin_supervisor(supervisor, tmp_path):
+    """A surviving grandchild that keeps the pipe saturated must not keep
+    the supervisor (and the gang driver waiting on it) alive past the
+    drain grace window."""
+    log = tmp_path / 'out.log'
+    proc = subprocess.run(
+        [supervisor, '--log', str(log), '--pgid-file',
+         str(tmp_path / 'p'), '--grace-ms', '500', '--',
+         'bash', '-c', 'while true; do echo x; done & exit 0'],
+        capture_output=True, text=True, timeout=15, check=False)
+    assert proc.returncode == 0
+
+
+def test_host_build_script_is_idempotent(tmp_path):
+    script = native.host_build_script()
+    env = {**os.environ, 'HOME': str(tmp_path),
+           'SKYTPU_HOME': str(tmp_path / '.skytpu')}
+    # No runtime tree under this fake HOME: script must still succeed
+    # (compiler-less / source-less hosts fall back silently).
+    r = subprocess.run(['bash', '-c', script], env=env,
+                       capture_output=True, text=True, check=False)
+    assert r.returncode == 0, r.stderr
+    # Now stage the runtime tree where the provisioner rsyncs it and build
+    # twice (the build recipe itself rides along as build_host.py).
+    native_dir = tmp_path / '.skytpu_runtime' / 'skypilot_tpu' / 'native'
+    src_dir = native_dir / 'src'
+    src_dir.mkdir(parents=True)
+    import skypilot_tpu.native.build_host as bh
+    with open(bh.__file__, 'rb') as f:
+        (native_dir / 'build_host.py').write_bytes(f.read())
+    with open(native.source_path(), 'rb') as f:
+        (src_dir / 'supervisor.cc').write_bytes(f.read())
+    for _ in range(2):
+        r = subprocess.run(['bash', '-c', script], env=env,
+                           capture_output=True, text=True, check=False)
+        assert r.returncode == 0, r.stderr
+    built = tmp_path / '.skytpu' / 'native' / 'bin' / native.SUPERVISOR_NAME
+    assert built.exists()
+    probe = subprocess.run([str(built), '--log', str(tmp_path / 'l'),
+                            '--pgid-file', str(tmp_path / 'p'), '--',
+                            'true'], check=False)
+    assert probe.returncode == 0
