@@ -45,6 +45,7 @@ def __getattr__(name):
         # planes
         'jobs': ('skypilot_tpu', 'jobs'),
         'serve': ('skypilot_tpu', 'serve'),
+        'bench': ('skypilot_tpu', 'bench'),
         # optimizer enum
         'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
         'ClusterStatus': ('skypilot_tpu.status_lib', 'ClusterStatus'),

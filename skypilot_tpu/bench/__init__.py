@@ -1,0 +1,19 @@
+"""Cost benchmarking: run one task on N candidate resources, compare
+$/step and time-to-completion.  Parity: sky/benchmark/ + sky/callbacks/.
+"""
+from skypilot_tpu.bench.callback import BenchmarkCallback, step_iterator
+from skypilot_tpu.bench.state import BenchmarkStatus
+from skypilot_tpu.bench.utils import (delete_benchmark,
+                                      down_benchmark_clusters,
+                                      launch_benchmark,
+                                      update_benchmark_state)
+
+__all__ = [
+    'BenchmarkCallback',
+    'BenchmarkStatus',
+    'delete_benchmark',
+    'down_benchmark_clusters',
+    'launch_benchmark',
+    'step_iterator',
+    'update_benchmark_state',
+]

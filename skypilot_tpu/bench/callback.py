@@ -1,0 +1,114 @@
+"""User-side benchmark callback: per-step timestamps for `skytpu bench`.
+
+Parity: the SkyCallback library (sky/callbacks/sky_callback/base.py:20) —
+a tiny, dependency-free timer the training loop calls once per step; it
+periodically writes an atomic ``summary.json`` that the bench harness
+syncs down and turns into $/step and time-to-completion estimates.
+
+STDLIB-ONLY by design: job hosts run this inside arbitrary user programs
+(it is also loadable by file path, without importing the skypilot_tpu
+package).  In multi-host jobs only rank 0 writes (``SKYTPU_NODE_RANK``).
+
+Usage::
+
+    from skypilot_tpu.bench import BenchmarkCallback
+    cb = BenchmarkCallback(total_steps=1000)
+    for batch in data:
+        cb.on_step_begin()
+        step(batch)
+        cb.on_step_end()
+
+or wrap the iterable::
+
+    for batch in step_iterator(data, total_steps=1000):
+        step(batch)
+"""
+import json
+import os
+import time
+
+ENV_LOG_DIR = 'SKYTPU_BENCHMARK_LOG_DIR'
+SUMMARY_NAME = 'summary.json'
+_BOOT_TIME = time.time()  # import time ~ program start
+
+
+def default_log_dir() -> str:
+    return os.environ.get(
+        ENV_LOG_DIR, os.path.join('~', '.skytpu', 'benchmark_logs',
+                                  'default'))
+
+
+class BenchmarkCallback:
+    """Records step timestamps; rank 0 writes summary.json periodically."""
+
+    def __init__(self, log_dir=None, total_steps=None, warmup_steps=1,
+                 write_every=10):
+        self.log_dir = os.path.expanduser(log_dir or default_log_dir())
+        self.total_steps = total_steps
+        self.warmup_steps = warmup_steps
+        self.write_every = max(1, write_every)
+        self.create_time = time.time()
+        self.first_step_time = None
+        self.warmup_end_time = None
+        self.last_step_time = None
+        self.num_steps = 0
+        self._is_writer = os.environ.get('SKYTPU_NODE_RANK', '0') == '0'
+        if self._is_writer:
+            os.makedirs(self.log_dir, exist_ok=True)
+
+    def on_step_begin(self):
+        if self.first_step_time is None:
+            self.first_step_time = time.time()
+
+    def on_step_end(self):
+        now = time.time()
+        if self.first_step_time is None:  # begin() not called: tolerate
+            self.first_step_time = now
+        self.num_steps += 1
+        self.last_step_time = now
+        if self.num_steps == self.warmup_steps:
+            self.warmup_end_time = now
+        if self.num_steps % self.write_every == 0:
+            self.write_summary()
+
+    def summary(self) -> dict:
+        return {
+            'boot_time': _BOOT_TIME,
+            'create_time': self.create_time,
+            'first_step_time': self.first_step_time,
+            'warmup_end_time': self.warmup_end_time,
+            'last_step_time': self.last_step_time,
+            'num_steps': self.num_steps,
+            'warmup_steps': self.warmup_steps,
+            'total_steps': self.total_steps,
+        }
+
+    def write_summary(self):
+        if not self._is_writer:
+            return
+        path = os.path.join(self.log_dir, SUMMARY_NAME)
+        tmp = f'{path}.tmp.{os.getpid()}'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(self.summary(), f)
+        os.replace(tmp, path)  # atomic: the harness may rsync mid-write
+
+    # Context-manager form: `with BenchmarkCallback(...) as cb:` flushes the
+    # final partial window on exit.
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.write_summary()
+        return False
+
+
+def step_iterator(iterable, log_dir=None, total_steps=None, warmup_steps=1,
+                  write_every=10):
+    """Wrap a step iterable; timestamps each yielded item as one step."""
+    with BenchmarkCallback(log_dir=log_dir, total_steps=total_steps,
+                           warmup_steps=warmup_steps,
+                           write_every=write_every) as cb:
+        for item in iterable:
+            cb.on_step_begin()
+            yield item
+            cb.on_step_end()

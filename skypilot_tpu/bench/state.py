@@ -1,0 +1,140 @@
+"""Benchmark-local state: SQLite DB at ``$SKYTPU_HOME/benchmark.db``.
+
+Parity: sky/benchmark/benchmark_state.py — one row per benchmark plus one
+row per (benchmark, candidate cluster) with the parsed callback summary
+and derived cost/time estimates.
+"""
+import enum
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common
+
+_local = threading.local()
+
+
+class BenchmarkStatus(enum.Enum):
+    INIT = 'INIT'
+    RUNNING = 'RUNNING'
+    FINISHED = 'FINISHED'
+    TERMINATED = 'TERMINATED'
+
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS benchmark (
+    name TEXT PRIMARY KEY,
+    task_name TEXT,
+    launched_at INTEGER,
+    status TEXT);
+CREATE TABLE IF NOT EXISTS benchmark_results (
+    benchmark TEXT,
+    cluster TEXT,
+    resources BLOB,
+    num_nodes INTEGER,
+    status TEXT,
+    num_steps INTEGER,
+    seconds_per_step REAL,
+    init_seconds REAL,
+    estimated_total_seconds REAL,
+    estimated_cost REAL,
+    updated_at INTEGER,
+    PRIMARY KEY (benchmark, cluster));
+"""
+
+
+def _db() -> sqlite3.Connection:
+    conn = getattr(_local, 'conn', None)
+    path = os.path.join(common.home_dir(), 'benchmark.db')
+    if conn is None or getattr(_local, 'path', None) != path:
+        os.makedirs(common.home_dir(), exist_ok=True)
+        conn = sqlite3.connect(path)
+        conn.executescript(_CREATE_SQL)
+        conn.row_factory = sqlite3.Row
+        _local.conn = conn
+        _local.path = path
+    return conn
+
+
+def add_benchmark(name: str, task_name: Optional[str]) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmark VALUES (?, ?, ?, ?)',
+            (name, task_name, int(time.time()), BenchmarkStatus.INIT.value))
+
+
+def set_benchmark_status(name: str, status: BenchmarkStatus) -> None:
+    with _db() as conn:
+        conn.execute('UPDATE benchmark SET status = ? WHERE name = ?',
+                     (status.value, name))
+
+
+def get_benchmark(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().execute('SELECT * FROM benchmark WHERE name = ?',
+                        (name,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_benchmarks() -> List[Dict[str, Any]]:
+    return [dict(r) for r in _db().execute(
+        'SELECT * FROM benchmark ORDER BY launched_at').fetchall()]
+
+
+def delete_benchmark(name: str) -> None:
+    with _db() as conn:
+        conn.execute('DELETE FROM benchmark_results WHERE benchmark = ?',
+                     (name,))
+        conn.execute('DELETE FROM benchmark WHERE name = ?', (name,))
+
+
+def add_result(benchmark: str, cluster: str, resources: Any,
+               num_nodes: int) -> None:
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmark_results '
+            '(benchmark, cluster, resources, num_nodes, status, updated_at) '
+            'VALUES (?, ?, ?, ?, ?, ?)',
+            (benchmark, cluster, pickle.dumps(resources), num_nodes,
+             BenchmarkStatus.INIT.value, int(time.time())))
+
+
+def update_result(benchmark: str, cluster: str, *, status: BenchmarkStatus,
+                  num_steps: Optional[int] = None,
+                  seconds_per_step: Optional[float] = None,
+                  init_seconds: Optional[float] = None,
+                  estimated_total_seconds: Optional[float] = None,
+                  estimated_cost: Optional[float] = None) -> None:
+    with _db() as conn:
+        conn.execute(
+            'UPDATE benchmark_results SET status = ?, '
+            'num_steps = COALESCE(?, num_steps), '
+            'seconds_per_step = COALESCE(?, seconds_per_step), '
+            'init_seconds = COALESCE(?, init_seconds), '
+            'estimated_total_seconds = COALESCE(?, estimated_total_seconds), '
+            'estimated_cost = COALESCE(?, estimated_cost), '
+            'updated_at = ? WHERE benchmark = ? AND cluster = ?',
+            (status.value, num_steps, seconds_per_step, init_seconds,
+             estimated_total_seconds, estimated_cost, int(time.time()),
+             benchmark, cluster))
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    rows = _db().execute(
+        'SELECT * FROM benchmark_results WHERE benchmark = ? '
+        'ORDER BY cluster', (benchmark,)).fetchall()
+    out = []
+    for r in rows:
+        d = dict(r)
+        d['resources'] = pickle.loads(d['resources'])
+        out.append(d)
+    return out
+
+
+def reset_for_tests() -> None:
+    if getattr(_local, 'conn', None) is not None:
+        _local.conn.close()
+        _local.conn = None
+        _local.path = None

@@ -1,0 +1,193 @@
+"""Benchmark orchestration: launch candidates, harvest summaries, report.
+
+Parity: sky/benchmark/benchmark_utils.py:432,488 — launch the same task on
+N candidate resources in parallel, pull the callback's summary.json from
+each cluster, and derive seconds/step, time- and cost-to-completion.
+"""
+import copy
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, logsys
+from skypilot_tpu.bench import callback as callback_lib
+from skypilot_tpu.bench import state as bench_state
+from skypilot_tpu.bench.state import BenchmarkStatus
+from skypilot_tpu.utils import common, subprocess_utils
+
+logger = logsys.init_logger(__name__)
+
+_CLUSTER_PREFIX = 'skytpu-bench-'
+# Where the callback writes on the cluster (exported to the job env).
+_REMOTE_LOG_DIR = '~/.skytpu/benchmark_logs'
+
+
+def cluster_name(benchmark: str, index: int) -> str:
+    return f'{_CLUSTER_PREFIX}{benchmark}-{index}'
+
+
+def launch_benchmark(benchmark: str, task: 'Any',
+                     candidates: List['Any'],
+                     detach: bool = True) -> List[str]:
+    """Launch `task` once per candidate Resources, in parallel.
+
+    Returns the launched cluster names.  Each launch exports
+    SKYTPU_BENCHMARK_LOG_DIR so BenchmarkCallback lands in a known place.
+    """
+    from skypilot_tpu import execution
+    if bench_state.get_benchmark(benchmark) is not None:
+        raise exceptions.SkyTpuError(
+            f'Benchmark {benchmark!r} already exists. '
+            f'`skytpu bench delete {benchmark}` first.')
+    bench_state.add_benchmark(benchmark, task.name)
+    names = [cluster_name(benchmark, i) for i in range(len(candidates))]
+
+    def _launch_one(i: int) -> Optional[str]:
+        t = copy.deepcopy(task)
+        t.set_resources(candidates[i])
+        t.update_envs({
+            callback_lib.ENV_LOG_DIR: f'{_REMOTE_LOG_DIR}/{benchmark}',
+        })
+        bench_state.add_result(benchmark, names[i], candidates[i],
+                               t.num_nodes or 1)
+        try:
+            execution.launch(t, cluster_name=names[i], detach_run=detach,
+                             stream_logs=False)
+            bench_state.update_result(benchmark, names[i],
+                                      status=BenchmarkStatus.RUNNING)
+            return names[i]
+        except Exception as e:  # pylint: disable=broad-except
+            logger.error('bench launch %s failed: %s', names[i], e)
+            bench_state.update_result(benchmark, names[i],
+                                      status=BenchmarkStatus.TERMINATED)
+            return None
+
+    launched = [n for n in subprocess_utils.run_in_parallel(
+        _launch_one, list(range(len(candidates)))) if n]
+    # A benchmark with zero surviving candidates never ran: record that
+    # instead of letting the all-terminal rollup report it FINISHED.
+    bench_state.set_benchmark_status(
+        benchmark,
+        BenchmarkStatus.RUNNING if launched else BenchmarkStatus.TERMINATED)
+    return launched
+
+
+def _parse_summary(raw: Dict[str, Any], resources: 'Any',
+                   num_nodes: int) -> Dict[str, Optional[float]]:
+    """Derive the report row from a callback summary dict."""
+    num_steps = raw.get('num_steps') or 0
+    warmup = raw.get('warmup_steps') or 0
+    first = raw.get('first_step_time')
+    warmup_end = raw.get('warmup_end_time')
+    last = raw.get('last_step_time')
+    boot = raw.get('boot_time')
+    total_steps = raw.get('total_steps')
+    out: Dict[str, Optional[float]] = {
+        'num_steps': num_steps,
+        'seconds_per_step': None,
+        'init_seconds': None,
+        'estimated_total_seconds': None,
+        'estimated_cost': None,
+    }
+    if boot is not None and first is not None:
+        out['init_seconds'] = first - boot
+    # Steady-state rate excludes warmup steps (compile time on TPU).
+    if (last is not None and warmup_end is not None and
+            num_steps > warmup > 0 and last > warmup_end):
+        out['seconds_per_step'] = (last - warmup_end) / (num_steps - warmup)
+    elif last is not None and first is not None and num_steps > 1:
+        out['seconds_per_step'] = (last - first) / num_steps
+    sps = out['seconds_per_step']
+    # get_cost prices the WHOLE slice; num_nodes (gang width, i.e. slice
+    # count) is the only multiplier — parity with core.cost_report.
+    if sps is not None and total_steps:
+        est = (out['init_seconds'] or 0.0) + sps * total_steps
+        out['estimated_total_seconds'] = est
+        try:
+            out['estimated_cost'] = resources.get_cost(est) * num_nodes
+        except exceptions.SkyTpuError:
+            out['estimated_cost'] = None
+    elif sps is not None and last is not None and boot is not None:
+        # No declared total: report cost of the observed run so far.
+        try:
+            out['estimated_cost'] = (resources.get_cost(last - boot) *
+                                     num_nodes)
+        except exceptions.SkyTpuError:
+            out['estimated_cost'] = None
+    return out
+
+
+def update_benchmark_state(benchmark: str) -> List[Dict[str, Any]]:
+    """Pull summary.json from each candidate cluster and refresh results."""
+    from skypilot_tpu import backend_utils
+    from skypilot_tpu.backends.slice_backend import SliceBackend
+    rows = bench_state.get_results(benchmark)
+
+    def _update_one(row: Dict[str, Any]) -> None:
+        cname = row['cluster']
+        if row['status'] == BenchmarkStatus.TERMINATED.value:
+            return
+        try:
+            handle = backend_utils.check_cluster_available(cname)
+        except exceptions.ClusterDoesNotExist:
+            bench_state.update_result(benchmark, cname,
+                                      status=BenchmarkStatus.TERMINATED)
+            return
+        except exceptions.SkyTpuError:
+            # Transiently not UP (INIT, locked refresh, …): keep the row
+            # as-is and try again on the next `bench show`.
+            return
+        local_dir = os.path.join(common.home_dir(), 'benchmark_logs',
+                                 benchmark, cname)
+        os.makedirs(local_dir, exist_ok=True)
+        head = handle.head_runner()
+        remote = f'{_REMOTE_LOG_DIR}/{benchmark}/{callback_lib.SUMMARY_NAME}'
+        try:
+            head.rsync(remote, os.path.join(local_dir,
+                                            callback_lib.SUMMARY_NAME),
+                       up=False)
+        except exceptions.SkyTpuError:
+            return  # no summary yet
+        path = os.path.join(local_dir, callback_lib.SUMMARY_NAME)
+        if not os.path.exists(path):
+            return
+        with open(path, 'r', encoding='utf-8') as f:
+            raw = json.load(f)
+        derived = _parse_summary(raw, row['resources'], row['num_nodes'])
+        status = BenchmarkStatus.RUNNING
+        try:
+            from skypilot_tpu.podlet import job_lib
+            job = SliceBackend().get_job_status(handle, None)
+            if (job and job.get('status') and
+                    job_lib.JobStatus(job['status']).is_terminal()):
+                status = BenchmarkStatus.FINISHED
+        except (exceptions.SkyTpuError, ValueError):
+            pass
+        bench_state.update_result(benchmark, cname, status=status, **derived)
+
+    subprocess_utils.run_in_parallel(_update_one, rows)
+    new_rows = bench_state.get_results(benchmark)
+    if new_rows and all(r['status'] in (BenchmarkStatus.FINISHED.value,
+                                        BenchmarkStatus.TERMINATED.value)
+                        for r in new_rows):
+        bench_state.set_benchmark_status(benchmark, BenchmarkStatus.FINISHED)
+    return new_rows
+
+
+def down_benchmark_clusters(benchmark: str) -> None:
+    from skypilot_tpu import core
+
+    def _down(row: Dict[str, Any]) -> None:
+        try:
+            core.down(row['cluster'])
+        except exceptions.SkyTpuError as e:
+            logger.warning('bench down %s: %s', row['cluster'], e)
+
+    subprocess_utils.run_in_parallel(_down, bench_state.get_results(benchmark))
+    bench_state.set_benchmark_status(benchmark, BenchmarkStatus.TERMINATED)
+
+
+def delete_benchmark(benchmark: str) -> None:
+    if bench_state.get_benchmark(benchmark) is None:
+        raise exceptions.SkyTpuError(f'Benchmark {benchmark!r} not found.')
+    bench_state.delete_benchmark(benchmark)

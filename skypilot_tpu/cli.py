@@ -619,6 +619,118 @@ def serve_logs(service_name, replica_id, no_follow):
                             follow=not no_follow))
 
 
+# -------------------------------------------------------------- bench group
+
+
+@cli.group(cls=_NaturalOrderGroup)
+def bench():
+    """Cost benchmarks: one task on N candidate resources, compare $/step.
+    Parity: `sky bench` (sky/cli.py:4615)."""
+
+
+@bench.command('launch')
+@click.argument('entrypoint', nargs=-1, required=True)
+@click.option('--benchmark', '-b', required=True, help='Benchmark name.')
+@click.option('--candidate', '-C', 'candidates', multiple=True,
+              help='Candidate accelerator (repeat), e.g. -C tpu-v5e-8 '
+                   '-C tpu-v5e-16. Defaults to the task\'s own resources.')
+@_resource_flags
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_launch(entrypoint, benchmark, candidates, name, workdir, cloud,
+                 tpus, cpus, memory, use_spot, region, zone, num_nodes, env,
+                 yes):
+    """Launch ENTRYPOINT on each candidate resource in parallel."""
+    from skypilot_tpu import bench as bench_lib
+    task = _make_task(entrypoint, name, workdir, cloud, tpus, cpus, memory,
+                      use_spot, region, zone, num_nodes, env)
+    base_set = list(task.resources)
+    if candidates:
+        if len(base_set) > 1:
+            raise click.UsageError(
+                'Cannot combine -C candidates with a task YAML declaring '
+                'multiple resources alternatives: the candidate base would '
+                'be ambiguous.')
+        resources_list = [base_set[0].copy(accelerator=c)
+                          for c in candidates]
+    else:
+        # No -C: every YAML alternative IS a candidate.
+        resources_list = base_set
+    if not yes:
+        click.confirm(
+            f'Launching benchmark {benchmark!r} on {len(resources_list)} '
+            f'candidate cluster(s). Proceed?', default=True, abort=True)
+    launched = bench_lib.launch_benchmark(benchmark, task, resources_list)
+    click.echo(f'Benchmark {benchmark!r}: launched {len(launched)} '
+               f'cluster(s): {", ".join(launched)}')
+    click.echo(f'Track with: skytpu bench show {benchmark}')
+
+
+@bench.command('ls')
+def bench_ls():
+    """List benchmarks."""
+    from skypilot_tpu.bench import state as bench_state
+    rows = [[b['name'], b['task_name'] or '-', _fmt_ts(b['launched_at']),
+             b['status']] for b in bench_state.get_benchmarks()]
+    click.echo(_table(['BENCHMARK', 'TASK', 'LAUNCHED', 'STATUS'], rows)
+               if rows else 'No benchmarks.')
+
+
+@bench.command('show')
+@click.argument('benchmark')
+def bench_show(benchmark):
+    """Refresh and show one benchmark's candidate results."""
+    from skypilot_tpu import bench as bench_lib
+    from skypilot_tpu.bench import state as bench_state
+    if bench_state.get_benchmark(benchmark) is None:
+        raise click.UsageError(f'Benchmark {benchmark!r} not found.')
+    rows = bench_lib.update_benchmark_state(benchmark)
+
+    def _f(x, fmt='{:.3f}'):
+        return fmt.format(x) if x is not None else '-'
+
+    table_rows = []
+    for r in rows:
+        table_rows.append([
+            r['cluster'], str(r['resources']), r['status'],
+            r['num_steps'] if r['num_steps'] is not None else '-',
+            _f(r['seconds_per_step']),
+            _f(r['init_seconds'], '{:.1f}'),
+            _fmt_duration(r['estimated_total_seconds']),
+            _f(r['estimated_cost'], '${:.2f}'),
+        ])
+    click.echo(_table(['CLUSTER', 'RESOURCES', 'STATUS', 'STEPS', 'S/STEP',
+                       'INIT(S)', 'EST.TOTAL', 'EST.COST'], table_rows))
+
+
+@bench.command('down')
+@click.argument('benchmark')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_down(benchmark, yes):
+    """Terminate all of a benchmark's candidate clusters."""
+    from skypilot_tpu import bench as bench_lib
+    from skypilot_tpu.bench import state as bench_state
+    if bench_state.get_benchmark(benchmark) is None:
+        raise click.UsageError(f'Benchmark {benchmark!r} not found.')
+    if not yes:
+        click.confirm(f'Terminate all clusters of benchmark {benchmark!r}?',
+                      default=True, abort=True)
+    bench_lib.down_benchmark_clusters(benchmark)
+    click.echo(f'Benchmark {benchmark!r} clusters terminated.')
+
+
+@bench.command('delete')
+@click.argument('benchmark')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_delete(benchmark, yes):
+    """Delete a benchmark's records (does not touch clusters)."""
+    from skypilot_tpu import bench as bench_lib
+    if not yes:
+        click.confirm(f'Delete benchmark {benchmark!r} records?',
+                      default=True, abort=True)
+    bench_lib.delete_benchmark(benchmark)
+    click.echo(f'Benchmark {benchmark!r} deleted.')
+
+
 # -------------------------------------------------------------- infer group
 
 

@@ -1,0 +1,215 @@
+"""Benchmark subsystem tests: callback summaries, derived metrics, and the
+launch→harvest→report loop end-to-end on the local cloud.
+
+Parity model: tests/test_smoke.py benchmark scenarios +
+sky/benchmark/benchmark_utils.py parsing, run at tier 2 (no cloud).
+"""
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu.bench import callback as callback_lib
+from skypilot_tpu.bench import state as bench_state
+from skypilot_tpu.bench import utils as bench_utils
+from skypilot_tpu.bench.state import BenchmarkStatus
+
+
+@pytest.fixture(autouse=True)
+def _reset_bench_state(skytpu_home):
+    bench_state.reset_for_tests()
+    yield
+    bench_state.reset_for_tests()
+
+
+def test_callback_writes_summary(tmp_path):
+    log_dir = tmp_path / 'bench'
+    with callback_lib.BenchmarkCallback(log_dir=str(log_dir),
+                                        total_steps=100,
+                                        warmup_steps=2,
+                                        write_every=3) as cb:
+        for _ in range(7):
+            cb.on_step_begin()
+            time.sleep(0.01)
+            cb.on_step_end()
+    summary = json.loads((log_dir / 'summary.json').read_text())
+    assert summary['num_steps'] == 7
+    assert summary['total_steps'] == 100
+    assert summary['warmup_steps'] == 2
+    assert summary['first_step_time'] <= summary['warmup_end_time']
+    assert summary['warmup_end_time'] < summary['last_step_time']
+
+
+def test_callback_nonzero_rank_does_not_write(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_NODE_RANK', '3')
+    log_dir = tmp_path / 'bench'
+    with callback_lib.BenchmarkCallback(log_dir=str(log_dir)) as cb:
+        cb.on_step_end()
+    assert not (log_dir / 'summary.json').exists()
+
+
+def test_step_iterator(tmp_path):
+    log_dir = tmp_path / 'bench'
+    consumed = list(
+        callback_lib.step_iterator(range(5), log_dir=str(log_dir),
+                                   write_every=100))
+    assert consumed == [0, 1, 2, 3, 4]
+    summary = json.loads((log_dir / 'summary.json').read_text())
+    assert summary['num_steps'] == 5
+
+
+def test_callback_loadable_standalone(tmp_path):
+    """The callback must import by file path with NO package import — job
+    hosts embed it in arbitrary user programs."""
+    spec = importlib.util.spec_from_file_location(
+        'skytpu_bench_callback', callback_lib.__file__)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with mod.BenchmarkCallback(log_dir=str(tmp_path / 'b')) as cb:
+        cb.on_step_end()
+        cb.write_summary()
+    assert (tmp_path / 'b' / 'summary.json').exists()
+
+
+def test_parse_summary_derives_rate_and_cost():
+    from skypilot_tpu import Resources
+    res = Resources(cloud='gcp', accelerator='tpu-v5e-8')
+    raw = {
+        'boot_time': 1000.0,
+        'create_time': 1002.0,
+        'first_step_time': 1010.0,   # 10s init (compile)
+        'warmup_end_time': 1012.0,   # 1 warmup step
+        'last_step_time': 1021.0,    # 9 steady steps in 9s
+        'num_steps': 10,
+        'warmup_steps': 1,
+        'total_steps': 100,
+    }
+    d = bench_utils._parse_summary(raw, res, num_nodes=1)
+    assert d['num_steps'] == 10
+    assert d['seconds_per_step'] == pytest.approx(1.0)
+    assert d['init_seconds'] == pytest.approx(10.0)
+    assert d['estimated_total_seconds'] == pytest.approx(110.0)
+    assert d['estimated_cost'] == pytest.approx(
+        res.get_cost(110.0), rel=1e-6)
+    assert d['estimated_cost'] > 0
+
+
+def test_parse_summary_no_total_steps():
+    from skypilot_tpu import Resources
+    res = Resources(cloud='gcp', accelerator='tpu-v5e-8')
+    raw = {'boot_time': 0.0, 'first_step_time': 1.0, 'warmup_end_time': 2.0,
+           'last_step_time': 10.0, 'num_steps': 9, 'warmup_steps': 1,
+           'total_steps': None}
+    d = bench_utils._parse_summary(raw, res, num_nodes=1)
+    assert d['seconds_per_step'] == pytest.approx(1.0)
+    assert d['estimated_total_seconds'] is None
+    assert d['estimated_cost'] == pytest.approx(res.get_cost(10.0), rel=1e-6)
+
+
+@pytest.mark.e2e
+def test_benchmark_end_to_end_local(enable_local_cloud):
+    """launch → candidates run with the callback → harvest → report."""
+    from skypilot_tpu import Resources, Task, core
+    # The job loads the rsynced callback module by file path (standalone)
+    # and runs 6 fast steps.
+    run = (
+        'python3 -c "'
+        'import importlib.util, os, time; '
+        "p = os.path.expanduser('~/.skytpu_runtime/skypilot_tpu/bench/"
+        "callback.py'); "
+        "spec = importlib.util.spec_from_file_location('cb', p); "
+        'm = importlib.util.module_from_spec(spec); '
+        'spec.loader.exec_module(m); '
+        'cb = m.BenchmarkCallback(total_steps=50, warmup_steps=1, '
+        'write_every=2); '
+        '[ (cb.on_step_begin(), time.sleep(0.05), cb.on_step_end()) '
+        'for _ in range(6) ]; cb.write_summary()"'
+    )
+    task = Task(name='bench-e2e', run=run)
+    task.set_resources(Resources(cloud='local'))
+    candidates = [Resources(cloud='local', accelerator='tpu-v5e-8'),
+                  Resources(cloud='local', accelerator='tpu-v5e-16')]
+    launched = bench_utils.launch_benchmark('b1', task, candidates,
+                                            detach=False)
+    assert len(launched) == 2
+    rows = bench_utils.update_benchmark_state('b1')
+    assert len(rows) == 2
+    for r in rows:
+        assert r['status'] == BenchmarkStatus.FINISHED.value, r
+        assert r['num_steps'] == 6
+        assert r['seconds_per_step'] == pytest.approx(0.05, rel=0.8)
+        assert r['estimated_total_seconds'] is not None
+    # Benchmark rolls up to FINISHED once every candidate is terminal.
+    assert (bench_state.get_benchmark('b1')['status'] ==
+            BenchmarkStatus.FINISHED.value)
+    bench_utils.down_benchmark_clusters('b1')
+    assert not [c for c in core.status()
+                if c['name'].startswith('skytpu-bench-b1')]
+    bench_utils.delete_benchmark('b1')
+    assert bench_state.get_benchmark('b1') is None
+
+
+def test_duplicate_benchmark_rejected():
+    from skypilot_tpu import Task, exceptions
+    bench_state.add_benchmark('dup', 'x')
+    with pytest.raises(exceptions.SkyTpuError, match='already exists'):
+        bench_utils.launch_benchmark('dup', Task(run='true'), [])
+
+
+def test_cost_is_whole_slice_times_num_nodes():
+    """get_cost prices the whole slice: a 4-host slice must NOT be
+    multiplied by its host count, only by the gang width (num_nodes)."""
+    from skypilot_tpu import Resources
+    res = Resources(cloud='gcp', accelerator='tpu-v5e-16')  # 4 hosts
+    assert res.num_hosts == 4
+    raw = {'boot_time': 0.0, 'first_step_time': 0.0, 'warmup_end_time': 1.0,
+           'last_step_time': 10.0, 'num_steps': 10, 'warmup_steps': 1,
+           'total_steps': 10}
+    one = bench_utils._parse_summary(raw, res, num_nodes=1)
+    two = bench_utils._parse_summary(raw, res, num_nodes=2)
+    assert one['estimated_cost'] == pytest.approx(
+        res.get_cost(one['estimated_total_seconds']), rel=1e-6)
+    assert two['estimated_cost'] == pytest.approx(
+        2 * one['estimated_cost'], rel=1e-6)
+
+
+def test_all_launches_failed_marks_terminated(enable_local_cloud,
+                                              monkeypatch):
+    from skypilot_tpu import Resources, Task, execution
+
+    def _boom(*args, **kwargs):
+        raise RuntimeError('stockout')
+
+    monkeypatch.setattr(execution, 'launch', _boom)
+    task = Task(name='t', run='true')
+    task.set_resources(Resources(cloud='local'))
+    launched = bench_utils.launch_benchmark(
+        'dead', task, [Resources(cloud='local', accelerator='tpu-v5e-8')])
+    assert launched == []
+    assert (bench_state.get_benchmark('dead')['status'] ==
+            BenchmarkStatus.TERMINATED.value)
+
+
+def test_transient_not_up_cluster_is_not_terminated(monkeypatch):
+    """A cluster that is temporarily not UP (INIT/locked refresh) must stay
+    refreshable; only a nonexistent cluster is TERMINATED."""
+    from skypilot_tpu import Resources, backend_utils, exceptions
+    bench_state.add_benchmark('tr', 'x')
+    bench_state.add_result('tr', 'c-init',
+                           Resources(cloud='local'), 1)
+
+    def _not_up(name):
+        raise exceptions.ClusterNotUpError(f'{name} is INIT')
+
+    monkeypatch.setattr(backend_utils, 'check_cluster_available', _not_up)
+    rows = bench_utils.update_benchmark_state('tr')
+    assert rows[0]['status'] == BenchmarkStatus.INIT.value  # unchanged
+
+    def _gone(name):
+        raise exceptions.ClusterDoesNotExist(name)
+
+    monkeypatch.setattr(backend_utils, 'check_cluster_available', _gone)
+    rows = bench_utils.update_benchmark_state('tr')
+    assert rows[0]['status'] == BenchmarkStatus.TERMINATED.value

@@ -21,8 +21,17 @@ def test_help_lists_all_commands(runner):
     for cmd in ('launch', 'exec', 'status', 'start', 'stop', 'down',
                 'autostop', 'queue', 'logs', 'cancel', 'check',
                 'show-tpus', 'cost-report', 'optimize', 'storage', 'jobs',
-                'serve'):
+                'serve', 'bench'):
         assert cmd in result.output
+
+
+def test_bench_ls_empty_and_delete_missing(runner):
+    result = runner.invoke(cli.cli, ['bench', 'ls'])
+    assert result.exit_code == 0
+    assert 'No benchmarks' in result.output
+    result = runner.invoke(cli.cli, ['bench', 'show', 'nope'])
+    assert result.exit_code != 0
+    assert 'not found' in result.output
 
 
 def test_show_tpus(runner):
