@@ -1,0 +1,93 @@
+"""Minimal JAX MNIST-style training — the 'hello world' recipe.
+
+Analog of the reference's examples/tpu/tpuvm_mnist.yaml (which clones the
+flax repo and runs its MNIST example).  Self-contained instead: a small
+convnet on synthetic 28x28 data (zero-egress environments can't download
+MNIST; swap `synthetic_batches` for real data loading outside the demo).
+Data-parallel over all local devices via a 1-axis mesh.
+"""
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ConvNet(nn.Module):
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.hidden, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.hidden * 2, (3, 3))(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def synthetic_batches(batch_size: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    while True:
+        x = rng.rand(batch_size, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, size=(batch_size,))
+        yield x, y
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--steps', type=int, default=200)
+    parser.add_argument('--batch-size', type=int, default=512)
+    parser.add_argument('--hidden', type=int, default=32)
+    parser.add_argument('--lr', type=float, default=1e-3)
+    args = parser.parse_args()
+
+    model = ConvNet(hidden=args.hidden)
+    mesh = Mesh(np.array(jax.devices()), ('data',))
+    data_sharding = NamedSharding(mesh, P('data'))
+    replicated = NamedSharding(mesh, P())
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))['params']
+    params = jax.device_put(params, replicated)
+    tx = optax.adam(args.lr)
+    opt_state = jax.device_put(tx.init(params), replicated)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({'params': p}, x)
+            one_hot = jax.nn.one_hot(y, 10)
+            return optax.softmax_cross_entropy(logits, one_hot).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    data = synthetic_batches(args.batch_size)
+    t0 = None
+    for i in range(args.steps):
+        x, y = next(data)
+        x = jax.device_put(x, data_sharding)
+        y = jax.device_put(y, data_sharding)
+        params, opt_state, loss = step(params, opt_state, x, y)
+        if i == 0:
+            float(loss)  # sync: exclude compile from throughput
+            t0 = time.time()
+        if (i + 1) % 50 == 0 or i == args.steps - 1:
+            print(f'step {i + 1}: loss {float(loss):.4f}')
+    elapsed = time.time() - t0
+    rate = args.batch_size * max(args.steps - 1, 1) / max(elapsed, 1e-9)
+    print(f'throughput: {rate:,.0f} images/s on {len(jax.devices())} '
+          f'device(s)')
+
+
+if __name__ == '__main__':
+    main()

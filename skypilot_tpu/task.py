@@ -176,8 +176,22 @@ class Task:
             })
         else:
             task.set_resources(Resources.from_yaml_config(res_config))
-        task.set_file_mounts(config.get('file_mounts'))
-        raw_storage = config.get('storage_mounts') or {}
+        # Dict-valued file_mounts entries are inline storage mounts —
+        # parity with the reference, where `file_mounts: {/ckpt: {name:…,
+        # mode: MOUNT}}` is the canonical bucket-mount spelling
+        # (sky/task.py:951 sync_storage_mounts).
+        raw_fm = dict(config.get('file_mounts') or {})
+        task.set_file_mounts(
+            {k: v for k, v in raw_fm.items() if not isinstance(v, dict)})
+        inline_storage = {k: v for k, v in raw_fm.items()
+                          if isinstance(v, dict)}
+        explicit_storage = dict(config.get('storage_mounts') or {})
+        dup = set(inline_storage) & set(explicit_storage)
+        if dup:
+            raise exceptions.InvalidTaskError(
+                f'Mount path(s) declared in both file_mounts and '
+                f'storage_mounts: {sorted(dup)}')
+        raw_storage = {**inline_storage, **explicit_storage}
         if raw_storage:
             from skypilot_tpu.data import storage as storage_lib
             mounts = {}

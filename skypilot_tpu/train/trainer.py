@@ -210,6 +210,13 @@ class Trainer:
         import orbax.checkpoint as ocp
         self._ckpt_mgr.save(step, args=ocp.args.StandardSave(self.state))
 
+    def flush_checkpoints(self) -> None:
+        """Block until async orbax saves are durable.  Without this, a
+        process exiting right after save() silently drops the newest
+        checkpoint — the one preemption recovery needs most."""
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait_until_finished()
+
     def train(self, data: Optional[Iterator] = None,
               num_steps: Optional[int] = None,
               log_every: int = 10) -> Dict[str, float]:
@@ -236,6 +243,7 @@ class Trainer:
                 self.save(i + 1)
         float(metrics['loss'])  # sync the dispatched chain before timing
         elapsed = time.time() - (t0 or time.time())
+        self.flush_checkpoints()
         steps_timed = max(num_steps - 1, 1)
         tps = tokens_per_step * steps_timed / max(elapsed, 1e-9)
         return {
