@@ -106,6 +106,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
 
 
+def _out_struct(shape, dtype, *likes):
+    """ShapeDtypeStruct for pallas out_shape, varying over the union of
+    the mesh axes its inputs vary over.
+
+    Under shard_map with check_vma (default, jax>=0.8) pallas outputs must
+    declare their varying manual axes; the kernel consumes every input, so
+    the output varies over the union of all input vmas (an empty union —
+    fully replicated inputs — must still be declared, as an empty set is
+    not the same as "no vma").  On jax versions without vma, a plain
+    struct is produced.
+    """
+    vmas = [getattr(jax.typeof(x), 'vma', None) for x in likes]
+    if all(v is None for v in vmas):
+        return jax.ShapeDtypeStruct(shape, dtype)
+    union = frozenset().union(*(v for v in vmas if v is not None))
+    return jax.ShapeDtypeStruct(shape, dtype, vma=union)
+
+
 def _flash_fwd(q, k, v, *, causal, scale, block_q, block_kv):
     b, hq, s, d = q.shape
     hkv = k.shape[1]
@@ -134,8 +152,8 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_kv):
             pl.BlockSpec((1, block_q, _LANES), lambda h, i: (h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * hq, s, _LANES), jnp.float32),
+            _out_struct((b * hq, s, d), q.dtype, qf, kf, vf),
+            _out_struct((b * hq, s, _LANES), jnp.float32, qf, kf, vf),
         ],
         interpret=_interpret(),
     )(qf, kf, vf)
@@ -252,7 +270,8 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv):
             pl.BlockSpec((1, block_q, _LANES), lambda h, i: (h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
+        out_shape=_out_struct((b * hq, s, d), q.dtype, qf, kf, vf,
+                              dof, lsef, delta),
         interpret=_interpret(),
     )(qf, kf, vf, dof, lsef, delta)
     dk, dv = pl.pallas_call(
@@ -274,8 +293,10 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv):
             pl.BlockSpec((1, block_kv, d), lambda h, i: (h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * hq, s, d), q.dtype),
+            _out_struct((b * hq, s, d), q.dtype, qf, kf, vf, dof, lsef,
+                        delta),
+            _out_struct((b * hq, s, d), q.dtype, qf, kf, vf, dof, lsef,
+                        delta),
         ],
         interpret=_interpret(),
     )(qf, kf, vf, dof, lsef, delta)
