@@ -9,6 +9,7 @@ import json
 import os
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import usage
 from skypilot_tpu import exceptions, logsys
 from skypilot_tpu.bench import callback as callback_lib
 from skypilot_tpu.bench import state as bench_state
@@ -26,6 +27,7 @@ def cluster_name(benchmark: str, index: int) -> str:
     return f'{_CLUSTER_PREFIX}{benchmark}-{index}'
 
 
+@usage.entrypoint('bench.launch')
 def launch_benchmark(benchmark: str, task: 'Any',
                      candidates: List['Any'],
                      detach: bool = True) -> List[str]:

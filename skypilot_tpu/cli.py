@@ -533,6 +533,25 @@ def jobs_logs(job_id, name, no_follow):
                            follow=not no_follow))
 
 
+@jobs.command('dashboard')
+@click.option('--port', '-p', type=int, default=8765)
+@click.option('--host', default='127.0.0.1')
+def jobs_dashboard(port, host):
+    """Serve an HTML dashboard of the managed jobs queue."""
+    from skypilot_tpu.jobs import dashboard
+    try:  # bind BEFORE announcing a URL
+        server, thread = dashboard.start_dashboard(host=host, port=port,
+                                                   background=True)
+    except OSError as e:
+        raise click.ClickException(f'cannot bind {host}:{port}: {e}')
+    bound = server.server_address[1]
+    click.echo(f'Dashboard: http://{host}:{bound}/ (Ctrl-C to stop)')
+    try:
+        thread.join()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
 # -------------------------------------------------------------- serve group
 
 

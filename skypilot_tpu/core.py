@@ -6,6 +6,7 @@ Parity: sky/core.py:41-899.
 import time
 from typing import Any, Dict, List, Optional, Union
 
+from skypilot_tpu import usage
 from skypilot_tpu import backend_utils, exceptions, logsys, state
 from skypilot_tpu.backends import SliceBackend
 from skypilot_tpu.status_lib import ClusterStatus
@@ -14,6 +15,7 @@ from skypilot_tpu.utils import common, ux
 logger = logsys.init_logger(__name__)
 
 
+@usage.entrypoint('status')
 def status(cluster_names: Optional[Union[str, List[str]]] = None,
            refresh: bool = False) -> List[Dict[str, Any]]:
     """Cluster records (optionally reconciled against the cloud)."""
@@ -23,6 +25,7 @@ def status(cluster_names: Optional[Union[str, List[str]]] = None,
                                       cluster_names=cluster_names)
 
 
+@usage.entrypoint('start')
 def start(cluster_name: str, retry_until_up: bool = False) -> None:
     """Restart a STOPPED cluster (controller VMs; TPU slices cannot stop).
     Parity: sky/core.py start()."""
@@ -55,6 +58,7 @@ def start(cluster_name: str, retry_until_up: bool = False) -> None:
                                 is_launch=False)
 
 
+@usage.entrypoint('stop')
 def stop(cluster_name: str, purge: bool = False) -> None:
     record = state.get_cluster_from_name(cluster_name)
     if record is None:
@@ -63,6 +67,7 @@ def stop(cluster_name: str, purge: bool = False) -> None:
     SliceBackend().teardown(record['handle'], terminate=False, purge=purge)
 
 
+@usage.entrypoint('down')
 def down(cluster_name: str, purge: bool = False) -> None:
     record = state.get_cluster_from_name(cluster_name)
     if record is None:
@@ -71,6 +76,7 @@ def down(cluster_name: str, purge: bool = False) -> None:
     SliceBackend().teardown(record['handle'], terminate=True, purge=purge)
 
 
+@usage.entrypoint('autostop')
 def autostop(cluster_name: str, idle_minutes: int,
              down_after_idle: bool = False) -> None:
     """idle_minutes < 0 cancels autostop.  TPU slices require down=True."""
@@ -89,6 +95,7 @@ def queue(cluster_name: str) -> List[Dict[str, Any]]:
     return SliceBackend().get_job_queue(handle)
 
 
+@usage.entrypoint('cancel')
 def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> List[int]:
     handle = backend_utils.check_cluster_available(cluster_name)
@@ -117,6 +124,7 @@ def job_status(cluster_name: str,
     return SliceBackend().get_job_status(handle, job_id)
 
 
+@usage.entrypoint('cost_report')
 def cost_report() -> List[Dict[str, Any]]:
     """Per-cluster accumulated cost from usage intervals.
     Parity: sky/core.py cost_report + status_utils."""

@@ -10,6 +10,7 @@ from typing import List, Optional, Union
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions, logsys
 from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import usage
 from skypilot_tpu.backends import SliceBackend
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.task import Task
@@ -70,11 +71,18 @@ def _execute(task: Task,
         optimizer_lib.optimize(d, minimize=optimize_target,
                                quiet=not stream_logs)
 
+    usage.record('cluster_name', cluster_name)
+    usage.record('resources', str(task.best_resources or
+                                  task.get_preferred_resources()))
+    usage.record('num_nodes', task.num_nodes)
+
     if Stage.PROVISION in stages:
-        handle = backend.provision(task, task.best_resources, dryrun=dryrun,
-                                   stream_logs=stream_logs,
-                                   cluster_name=cluster_name,
-                                   retry_until_up=retry_until_up)
+        with usage.stage('provision'):
+            handle = backend.provision(task, task.best_resources,
+                                       dryrun=dryrun,
+                                       stream_logs=stream_logs,
+                                       cluster_name=cluster_name,
+                                       retry_until_up=retry_until_up)
         if dryrun:
             return None
     else:
@@ -96,13 +104,15 @@ def _execute(task: Task,
         backend.set_autostop(handle, idle_minutes_to_autostop, down=down)
 
     if Stage.EXEC in stages:
-        job_id = backend.execute(handle, task, detach_run=detach_run)
+        with usage.stage('exec'):
+            job_id = backend.execute(handle, task, detach_run=detach_run)
 
     if Stage.DOWN in stages and down and idle_minutes_to_autostop is None:
         backend.teardown(handle, terminate=True)
     return job_id
 
 
+@usage.entrypoint('launch')
 def launch(task: Union[Task, 'dag_lib.Dag'],
            cluster_name: Optional[str] = None,
            *,
@@ -144,6 +154,7 @@ def launch(task: Union[Task, 'dag_lib.Dag'],
                     no_setup=no_setup)
 
 
+@usage.entrypoint('exec')
 def exec_(task: Union[Task, 'dag_lib.Dag'],
           cluster_name: str,
           *,

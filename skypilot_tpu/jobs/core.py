@@ -11,6 +11,7 @@ import tempfile
 import uuid
 from typing import Any, Dict, List, Optional, Union
 
+from skypilot_tpu import usage
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions, execution, logsys, state
 from skypilot_tpu.backends import SliceBackend
@@ -33,6 +34,7 @@ def _controller_handle(refresh: bool = False):
     return record['handle'] if record else None
 
 
+@usage.entrypoint('jobs.launch')
 def launch(task_or_dag: Union[Task, dag_lib.Dag],
            name: Optional[str] = None,
            *,
@@ -118,6 +120,7 @@ def _register_job_info(head, job_id: int, name: str,
     head.run_or_raise(f'python3 -c {shlex.quote(py)}')
 
 
+@usage.entrypoint('jobs.queue')
 def queue(refresh: bool = False) -> List[Dict[str, Any]]:
     """All managed jobs, one row per task (newest job first)."""
     handle = _controller_handle(refresh=refresh)
@@ -131,6 +134,7 @@ def queue(refresh: bool = False) -> List[Dict[str, Any]]:
     return jobs_utils.parse_result(stdout)
 
 
+@usage.entrypoint('jobs.cancel')
 def cancel(job_ids: Optional[List[int]] = None,
            name: Optional[str] = None, all_jobs: bool = False) -> List[int]:
     """Request cancellation (signal file; the controller tears down)."""

@@ -1,0 +1,118 @@
+"""Managed-jobs dashboard: a small HTTP view of the jobs queue.
+
+Parity: sky/jobs/dashboard/dashboard.py (flask on the controller,
+port-forwarded by `sky jobs dashboard`) — rebuilt on stdlib http.server
+(flask is not a dependency of this framework) and run client-side: it
+queries the controller over the same codegen RPC the CLI uses, so there
+is nothing to port-forward.
+
+Endpoints: `/` (HTML table, auto-refresh), `/api/jobs` (JSON).
+"""
+import html
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import logsys
+
+logger = logsys.init_logger(__name__)
+
+_REFRESH_SECONDS = 30
+
+_PAGE = """<!doctype html>
+<html><head><title>skytpu jobs</title>
+<meta http-equiv="refresh" content="{refresh}">
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #999; padding: 4px 10px; text-align: left; }}
+ th {{ background: #eee; }}
+ .RUNNING {{ color: #0a0; }} .SUCCEEDED {{ color: #06c; }}
+ .FAILED, .FAILED_SETUP, .FAILED_CONTROLLER {{ color: #c00; }}
+ .RECOVERING {{ color: #c80; }} .CANCELLED {{ color: #888; }}
+</style></head>
+<body><h2>Managed jobs</h2>
+<p>{count} job task(s); refreshed {now} (auto-refresh {refresh}s)</p>
+<table><tr>{headers}</tr>{rows}</table>
+</body></html>
+"""
+
+_COLUMNS = [
+    ('job_id', 'ID'), ('job_name', 'NAME'), ('task_id', 'TASK'),
+    ('status', 'STATUS'), ('cluster_name', 'CLUSTER'),
+    ('submitted_at', 'SUBMITTED'), ('recovery_count', 'RECOVERIES'),
+]
+
+
+def _fetch_jobs() -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import core as jobs_core
+    # Bypass the @usage.entrypoint wrapper: browser auto-refresh polling is
+    # machine-generated and would flood the usage spool (one record / 30s).
+    queue = getattr(jobs_core.queue, '__wrapped__', jobs_core.queue)
+    return queue()
+
+
+def _render(jobs: List[Dict[str, Any]]) -> str:
+    headers = ''.join(f'<th>{h}</th>' for _, h in _COLUMNS)
+    rows = []
+    for j in jobs:
+        cells = []
+        for key, _ in _COLUMNS:
+            val = j.get(key, '')
+            if key == 'submitted_at' and val:
+                val = time.strftime('%Y-%m-%d %H:%M:%S',
+                                    time.localtime(float(val)))
+            cells.append(f'<td class="{html.escape(str(j.get("status", "")))}">'
+                         f'{html.escape(str(val))}</td>')
+        rows.append('<tr>' + ''.join(cells) + '</tr>')
+    return _PAGE.format(refresh=_REFRESH_SECONDS, count=len(jobs),
+                        now=time.strftime('%H:%M:%S'), headers=headers,
+                        rows=''.join(rows))
+
+
+class _Handler(BaseHTTPRequestHandler):
+
+    def log_message(self, fmt, *args):  # quiet access log -> logger.debug
+        logger.debug('dashboard: ' + fmt, *args)
+
+    def _send(self, code: int, content_type: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header('Content-Type', content_type)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API name)
+        try:
+            if self.path.startswith('/api/jobs'):
+                body = json.dumps(_fetch_jobs(), default=str).encode()
+                self._send(200, 'application/json', body)
+            elif self.path == '/' or self.path.startswith('/?'):
+                self._send(200, 'text/html; charset=utf-8',
+                           _render(_fetch_jobs()).encode())
+            else:
+                self._send(404, 'text/plain', b'not found')
+        except Exception as e:  # pylint: disable=broad-except
+            self._send(500, 'text/plain',
+                       f'error fetching jobs: {e}'.encode())
+
+
+def start_dashboard(host: str = '127.0.0.1', port: int = 8765,
+                    background: bool = False
+                    ) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
+    """Serve the dashboard; blocks unless background=True."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    if background:
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server, thread
+    logger.info('Dashboard at http://%s:%d/', host, port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return server, None

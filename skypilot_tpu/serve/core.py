@@ -10,6 +10,7 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from skypilot_tpu import usage
 from skypilot_tpu import exceptions, execution, logsys, state
 from skypilot_tpu.backends import SliceBackend
 from skypilot_tpu.serve import constants, serve_utils
@@ -62,6 +63,7 @@ def _validate_service_task(task: Task) -> SkyTpuServiceSpec:
     return task.service
 
 
+@usage.entrypoint('serve.up')
 def up(task: Task,
        service_name: Optional[str] = None,
        *,
@@ -142,6 +144,7 @@ def _controller_envs() -> Dict[str, str]:
     return envs
 
 
+@usage.entrypoint('serve.update')
 def update(task: Task, service_name: str) -> int:
     """Rolling update to a new task/spec; returns the new version."""
     spec = _validate_service_task(task)
@@ -191,6 +194,7 @@ def status(service_names: Optional[List[str]] = None
     return services
 
 
+@usage.entrypoint('serve.down')
 def down(service_names: Optional[List[str]] = None,
          all_services: bool = False,
          purge: bool = False) -> List[str]:

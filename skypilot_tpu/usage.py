@@ -1,0 +1,157 @@
+"""Usage telemetry: schema-ed per-invocation records, local-first.
+
+Parity: sky/usage/usage_lib.py — every public entrypoint records one
+message (command, resources, per-stage durations, exception class) — with
+one deliberate change: records spool to a local JSONL file
+($SKYTPU_HOME/usage/usage.jsonl) and are only POSTed when an endpoint is
+explicitly configured (SKYTPU_USAGE_ENDPOINT); the reference ships to a
+hardcoded Loki (usage_lib.py:296).  Opt out entirely with
+SKYTPU_DISABLE_USAGE_COLLECTION=1.
+
+Never raises: telemetry failure must not fail user work.
+"""
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, Optional
+
+_DISABLE_ENV = 'SKYTPU_DISABLE_USAGE_COLLECTION'
+_ENDPOINT_ENV = 'SKYTPU_USAGE_ENDPOINT'
+_RUN_ID = str(uuid.uuid4())[:8]
+
+_local = threading.local()
+
+
+def disabled() -> bool:
+    return os.environ.get(_DISABLE_ENV, '0') == '1'
+
+
+def _spool_path() -> str:
+    from skypilot_tpu.utils import common
+    d = os.path.join(common.home_dir(), 'usage')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'usage.jsonl')
+
+
+class _Message:
+    """One entrypoint invocation's record, built up as stages run."""
+
+    def __init__(self, entrypoint: str):
+        self.entrypoint = entrypoint
+        self.start = time.time()
+        self.stages: Dict[str, float] = {}
+        self.fields: Dict[str, Any] = {}
+        self.exception: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'schema_version': 1,
+            'run_id': _RUN_ID,
+            'entrypoint': self.entrypoint,
+            'start_time': self.start,
+            'duration_s': round(time.time() - self.start, 3),
+            'stages': {k: round(v, 3) for k, v in self.stages.items()},
+            'exception': self.exception,
+            **self.fields,
+        }
+
+
+def current() -> Optional[_Message]:
+    return getattr(_local, 'message', None)
+
+
+def record(key: str, value: Any) -> None:
+    """Attach a field (e.g. resources str, cluster name) to the active
+    entrypoint's record.  No-op when no entrypoint is active."""
+    msg = current()
+    if msg is not None:
+        try:
+            json.dumps(value)
+            msg.fields[key] = value
+        except (TypeError, ValueError):
+            msg.fields[key] = str(value)
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    """Time one stage of the active entrypoint."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        msg = current()
+        if msg is not None:
+            msg.stages[name] = msg.stages.get(name, 0.0) + time.time() - t0
+
+
+_SPOOL_MAX_BYTES = 16 * 1024 * 1024
+
+
+def _flush(msg: _Message) -> None:
+    payload = msg.to_dict()
+    try:
+        path = _spool_path()
+        try:
+            if os.path.getsize(path) > _SPOOL_MAX_BYTES:
+                os.replace(path, path + '.1')  # keep one rotated generation
+        except OSError:
+            pass
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(payload) + '\n')
+    except OSError:
+        return
+    endpoint = os.environ.get(_ENDPOINT_ENV)
+    if endpoint:
+        # Fire-and-forget: a slow/unreachable endpoint must not add
+        # latency to the exit path of every command (parity: the
+        # reference posts from a thread for the same reason).
+        threading.Thread(target=_post, args=(endpoint, payload),
+                         daemon=True).start()
+
+
+def _post(endpoint: str, payload: Dict[str, Any]) -> None:
+    try:
+        import urllib.request
+        req = urllib.request.Request(
+            endpoint, data=json.dumps(payload).encode(),
+            headers={'Content-Type': 'application/json'})
+        urllib.request.urlopen(req, timeout=2)
+    except Exception:  # pylint: disable=broad-except
+        pass  # best-effort; never fail user work over telemetry
+
+
+def entrypoint(name_or_fn):
+    """Decorator recording one usage message per outermost invocation.
+    Parity: @usage_lib.entrypoint (sky/usage/usage_lib.py:447)."""
+
+    def _wrap(fn, name):
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if disabled() or current() is not None:  # nested: outer records
+                return fn(*args, **kwargs)
+            msg = _Message(name)
+            _local.message = msg
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:
+                msg.exception = type(e).__name__
+                msg.fields.setdefault(
+                    'exception_site',
+                    traceback.extract_tb(e.__traceback__)[-1].name
+                    if e.__traceback__ else None)
+                raise
+            finally:
+                _local.message = None
+                _flush(msg)
+
+        return wrapper
+
+    if callable(name_or_fn):
+        return _wrap(name_or_fn, name_or_fn.__qualname__)
+    return lambda fn: _wrap(fn, name_or_fn)

@@ -493,8 +493,10 @@ def _service_task(min_replicas=1):
     return task
 
 
-def _wait_ready(serve, name, n, timeout=90):
-    """Wait until n replicas are READY and the service row caught up."""
+def _wait_ready(serve, name, n, timeout=240):
+    """Wait until n replicas are READY and the service row caught up.
+    Generous timeout: replica provisioning competes for CPU with jax
+    compiles elsewhere in a full-suite run (observed >90s under load)."""
     deadline = time.time() + timeout
     svcs = []
     while time.time() < deadline:
@@ -531,7 +533,7 @@ def test_serve_end_to_end(local_serve):
     # Terminate-replica is replaced by the autoscaler (service self-heals).
     rid = svc['replicas'][0]['replica_id']
     serve.terminate_replica(name, rid, purge=True)
-    svc = _wait_ready(serve, name, 1, timeout=90)
+    svc = _wait_ready(serve, name, 1, timeout=240)
     assert all(r['replica_id'] != rid or r['status'] != 'READY'
                for r in svc['replicas'])
     serve.down([name])

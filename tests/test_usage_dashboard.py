@@ -1,0 +1,142 @@
+"""Usage telemetry + jobs dashboard tests.
+
+Parity model: sky/usage/usage_lib.py (entrypoint wrapper, schema, opt-out)
+and sky/jobs/dashboard (queue view), tier 2 (no cloud).
+"""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import usage
+
+
+def _read_spool(home):
+    path = os.path.join(home, 'usage', 'usage.jsonl')
+    if not os.path.exists(path):
+        return []
+    with open(path, 'r', encoding='utf-8') as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def test_entrypoint_records_success(skytpu_home):
+
+    @usage.entrypoint('mytest')
+    def work():
+        usage.record('cluster_name', 'c1')
+        with usage.stage('provision'):
+            pass
+        return 42
+
+    assert work() == 42
+    msgs = _read_spool(skytpu_home)
+    assert len(msgs) == 1
+    m = msgs[0]
+    assert m['entrypoint'] == 'mytest'
+    assert m['cluster_name'] == 'c1'
+    assert 'provision' in m['stages']
+    assert m['exception'] is None
+    assert m['duration_s'] >= 0
+
+
+def test_entrypoint_records_exception_class_only(skytpu_home):
+
+    @usage.entrypoint('boom')
+    def work():
+        raise ValueError('secret detail that must NOT be recorded')
+
+    with pytest.raises(ValueError):
+        work()
+    (m,) = _read_spool(skytpu_home)
+    assert m['exception'] == 'ValueError'
+    assert 'secret' not in json.dumps(m)
+
+
+def test_nested_entrypoints_record_once(skytpu_home):
+
+    @usage.entrypoint('inner')
+    def inner():
+        return 1
+
+    @usage.entrypoint('outer')
+    def outer():
+        return inner()
+
+    outer()
+    msgs = _read_spool(skytpu_home)
+    assert [m['entrypoint'] for m in msgs] == ['outer']
+
+
+def test_opt_out(skytpu_home, monkeypatch):
+    monkeypatch.setenv('SKYTPU_DISABLE_USAGE_COLLECTION', '1')
+
+    @usage.entrypoint('quiet')
+    def work():
+        return 1
+
+    work()
+    assert _read_spool(skytpu_home) == []
+
+
+def test_launch_records_usage(skytpu_home, enable_local_cloud):
+    import skypilot_tpu as sky
+    task = sky.Task(name='u', run='echo hi')
+    task.set_resources(sky.Resources(cloud='local'))
+    sky.launch(task, cluster_name='uc', stream_logs=False)
+    sky.down('uc')
+    msgs = _read_spool(skytpu_home)
+    names = [m['entrypoint'] for m in msgs]
+    assert 'launch' in names and 'down' in names
+    launch_msg = [m for m in msgs if m['entrypoint'] == 'launch'][0]
+    assert launch_msg['cluster_name'] == 'uc'
+    assert 'provision' in launch_msg['stages']
+    assert 'exec' in launch_msg['stages']
+
+
+def test_dashboard_serves_queue(skytpu_home, monkeypatch):
+    from skypilot_tpu.jobs import dashboard
+
+    fake_jobs = [{
+        'job_id': 1, 'job_name': 'train<x>', 'task_id': 0,
+        'status': 'RUNNING', 'cluster_name': 'c-1',
+        'submitted_at': 1753840000.0, 'recovery_count': 2,
+    }]
+    monkeypatch.setattr(dashboard, '_fetch_jobs', lambda: fake_jobs)
+    server, thread = dashboard.start_dashboard(port=0, background=True)
+    try:
+        port = server.server_address[1]
+        html_body = urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/', timeout=5).read().decode()
+        assert 'train&lt;x&gt;' in html_body  # escaped
+        assert 'RUNNING' in html_body
+        api = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/api/jobs', timeout=5).read())
+        assert api[0]['job_id'] == 1
+        assert urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/api/jobs', timeout=5).status == 200
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f'http://127.0.0.1:{port}/nope',
+                                   timeout=5)
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+
+
+def test_dashboard_fetch_error_returns_500(skytpu_home, monkeypatch):
+    from skypilot_tpu.jobs import dashboard
+
+    def _boom():
+        raise RuntimeError('controller unreachable')
+
+    monkeypatch.setattr(dashboard, '_fetch_jobs', _boom)
+    server, thread = dashboard.start_dashboard(port=0, background=True)
+    try:
+        port = server.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f'http://127.0.0.1:{port}/', timeout=5)
+        assert err.value.code == 500
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
