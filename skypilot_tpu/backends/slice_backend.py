@@ -40,7 +40,7 @@ class SliceResourceHandle(ResourceHandle):
         self._version = self._VERSION
         self.cluster_name = cluster_name
         self.launched_resources = launched_resources
-        self.launched_nodes = launched_nodes  # slices (1 for now)
+        self.launched_nodes = launched_nodes  # slices (DCN gang width)
         self.stable_internal_external_ips: Optional[List] = None
         self.cached_cluster_info: Optional[Dict[str, Any]] = None
         self.run_timestamp: Optional[str] = None
@@ -128,9 +128,11 @@ class RetryingProvisioner:
                           use_spot=resources.use_spot))
 
     def provision_with_retries(self, task, candidates,
-                               retry_until_up: bool):
+                               retry_until_up: bool,
+                               num_slices: Optional[int] = None):
         """Try candidates in order; returns (chosen Candidate,
         ProvisionRecord, deploy_config)."""
+        num_slices = num_slices or getattr(task, 'num_nodes', 1) or 1
         from skypilot_tpu.clouds import Cloud
         while True:
             for cand in candidates:
@@ -143,6 +145,10 @@ class RetryingProvisioner:
                 config = cloud.make_deploy_variables(resources,
                                                      self.cluster_name,
                                                      cand.region, cand.zone)
+                # Gang width: num_nodes counts SLICES (task.py docstring);
+                # each provider provisions that many slice resources and
+                # reports all hosts in one ClusterInfo.
+                config['num_slices'] = num_slices
                 logger.info('%s Provisioning %s in %s...',
                             ux.emph('[provision]'), resources.pretty(),
                             cand.zone or cand.region)
@@ -187,10 +193,6 @@ class SliceBackend(Backend[SliceResourceHandle]):
                   dryrun: bool, stream_logs: bool, cluster_name: str,
                   retry_until_up: bool = False
                   ) -> Optional[SliceResourceHandle]:
-        if task.num_nodes != 1:
-            raise exceptions.NotSupportedError(
-                'Multi-slice tasks (num_nodes > 1) are not yet supported by '
-                'SliceBackend; coming with DCN multislice support.')
         candidates = getattr(task, 'candidates', None)
         if candidates is None:
             from skypilot_tpu import dag as dag_lib
@@ -205,6 +207,7 @@ class SliceBackend(Backend[SliceResourceHandle]):
                         cand.resources.pretty(), cand.zone or cand.region)
             return None
         log_path = os.path.join(_log_dir_for(cluster_name), 'provision.log')
+        width = task.num_nodes or 1
         with locks.cluster_status_lock(cluster_name):
             existing = state.get_cluster_from_name(cluster_name)
             if existing is not None:
@@ -218,6 +221,16 @@ class SliceBackend(Backend[SliceResourceHandle]):
                         f'{launched.pretty()}, which does not satisfy the '
                         f'requested resources. Use a new cluster name, or '
                         f'`skytpu down {cluster_name}` first.')
+                launched_width = getattr(handle, 'launched_nodes', 1) or 1
+                if (task.num_nodes or 1) > launched_width:
+                    raise exceptions.ResourcesMismatchError(
+                        f'Cluster {cluster_name!r} has '
+                        f'{handle.launched_nodes} slice(s); the task needs '
+                        f'{task.num_nodes}. Use a new cluster name.')
+                # Reuse keeps the cluster's existing gang width: shrinking
+                # it would orphan the extra slice resources (they would
+                # drop out of the provider metadata but keep billing).
+                width = launched_width
                 # Narrow candidates to the existing placement so a restart
                 # reuses the same zone.
                 candidates = [
@@ -226,8 +239,9 @@ class SliceBackend(Backend[SliceResourceHandle]):
                 ] or candidates
             retrier = RetryingProvisioner(cluster_name, log_path)
             cand, record, config = retrier.provision_with_retries(
-                task, candidates, retry_until_up)
-            handle = SliceResourceHandle(cluster_name, cand.resources)
+                task, candidates, retry_until_up, num_slices=width)
+            handle = SliceResourceHandle(cluster_name, cand.resources,
+                                         launched_nodes=width)
             state.add_or_update_cluster(cluster_name, handle,
                                         set(task.resources), ready=False)
             try:
@@ -249,8 +263,10 @@ class SliceBackend(Backend[SliceResourceHandle]):
                 zip(info.internal_ips(), info.external_ips()))
             state.add_or_update_cluster(cluster_name, handle,
                                         set(task.resources), ready=True)
-            logger.info('%s Cluster %r is UP (%d host(s)).',
-                        ux.ok('[done]'), cluster_name, info.num_hosts)
+            logger.info('%s Cluster %r is UP (%d host(s)%s).',
+                        ux.ok('[done]'), cluster_name, info.num_hosts,
+                        f' across {info.num_slices} slices'
+                        if info.num_slices > 1 else '')
             return handle
 
     # ----------------------------------------------------------- file sync

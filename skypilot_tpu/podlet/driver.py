@@ -59,9 +59,18 @@ def _make_runners(info: ClusterInfo):
 
 
 def build_host_env(info: ClusterInfo, rank: int, job_id: int,
-                   task_id: str, user_envs: Dict[str, str],
-                   num_slices: int = 1, slice_id: int = 0) -> Dict[str, str]:
+                   task_id: str, user_envs: Dict[str, str]
+                   ) -> Dict[str, str]:
+    """Env for host `rank` (global, slice-major order).
+
+    Multi-slice (num_nodes > 1): every host of every slice joins ONE
+    jax.distributed job — the coordinator is slice 0's first host, process
+    ids are global ranks, and SKYTPU_SLICE_ID/NUM_SLICES describe the DCN
+    topology (ICI within a slice, DCN between slices — megascale-style,
+    parity: the reference's rank/IP export, cloud_vm_ray_backend.py:494).
+    """
     ips = info.internal_ips()
+    slice_id = rank // info.hosts_per_slice
     env = dict(user_envs)
     env.update({
         common.ENV_VAR_NODE_RANK: str(rank),
@@ -75,7 +84,7 @@ def build_host_env(info: ClusterInfo, rank: int, job_id: int,
         common.ENV_VAR_PROCESS_ID: str(rank),
         common.ENV_VAR_NUM_PROCESSES: str(len(ips)),
         common.ENV_VAR_SLICE_ID: str(slice_id),
-        common.ENV_VAR_NUM_SLICES: str(num_slices),
+        common.ENV_VAR_NUM_SLICES: str(info.num_slices),
         'SKYTPU_INTERNAL_JOB_ID': str(job_id),
     })
     return env
@@ -199,9 +208,7 @@ def run_job(job_id: int) -> int:
     returncodes: List[Optional[int]] = [None] * len(runners)
 
     def _worker(i: int) -> int:
-        env = build_host_env(info, i, job_id, task_id, user_envs,
-                             num_slices=spec.get('num_slices', 1),
-                             slice_id=spec.get('slice_id', 0))
+        env = build_host_env(info, i, job_id, task_id, user_envs)
         host_log = os.path.join(tasks_log_dir, f'host{i}.log')
         rc = _run_on_host(runners[i], i, job_id, run_script_remote, env,
                           host_log, merged_lock, merged_log, cancel_event)

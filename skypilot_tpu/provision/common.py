@@ -47,6 +47,12 @@ class ClusterInfo:
     def num_hosts(self) -> int:
         return len(self.instances)
 
+    @property
+    def hosts_per_slice(self) -> int:
+        """Hosts in each slice; instances are ordered slice-major, so host
+        i belongs to slice i // hosts_per_slice."""
+        return max(len(self.instances) // max(self.num_slices, 1), 1)
+
     def internal_ips(self) -> List[str]:
         return [i.internal_ip for i in self.instances]
 

@@ -59,15 +59,39 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
     labels['skytpu-cluster'] = cluster_name
 
     if config['node_kind'] == 'tpu_slice':
-        existing = tpu_api.get_node(project, zone, name)
-        if existing is not None and existing.get('state') == 'READY':
-            record = ProvisionRecord('gcp', cluster_name, region, zone,
-                                     resource_id=name, is_resume=True)
-        else:
+        # Multi-slice: num_slices separate TPU resources, one per slice
+        # (DCN connects them; ICI stays within each slice).  Names are
+        # <base> for a single slice, <base>-s<i> otherwise.
+        num_slices = int(config.get('num_slices', 1))
+        names = ([name] if num_slices == 1 else
+                 [f'{name}-s{i}' for i in range(num_slices)])
+        # Save metadata BEFORE creating anything: if slice k of N fails
+        # (stockout is the dominant TPU failure), the already-created
+        # slices must stay tracked so failover cleanup / terminate can
+        # delete them instead of leaking billed TPUs.
+        _save_meta(
+            cluster_name, {
+                'kind': 'tpu_slice',
+                'project': project,
+                'zone': zone,
+                'region': region,
+                'resource_id': names[0],
+                'resource_ids': names,
+                'queued_resource': bool(config.get('queued_resource')),
+                'accelerator': config.get('accelerator'),
+                'chips_per_host': config.get('chips_per_host', 0),
+                'ssh_user': ssh_user,
+            })
+        all_resumed = True
+        for node_name in names:
+            existing = tpu_api.get_node(project, zone, node_name)
+            if existing is not None and existing.get('state') == 'READY':
+                continue
+            all_resumed = False
             if existing is not None:
                 # Half-dead slice (e.g. PREEMPTED remnant): delete first —
                 # TPU slices cannot be repaired in place.
-                tpu_api.delete_node(project, zone, name)
+                tpu_api.delete_node(project, zone, node_name)
             body = tpu_api.build_node_body(
                 accelerator_type=config['tpu_type'],
                 runtime_version=config['runtime_version'],
@@ -81,27 +105,20 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
             )
             if config.get('queued_resource'):
                 qr_body = tpu_api.build_queued_resource_body(
-                    name, body, config.get('use_spot', False))
-                tpu_api.create_queued_resource(project, zone, name, qr_body)
+                    node_name, body, config.get('use_spot', False))
+                tpu_api.create_queued_resource(project, zone, node_name,
+                                               qr_body)
             else:
-                tpu_api.create_node(project, zone, name, body)
-            record = ProvisionRecord('gcp', cluster_name, region, zone,
-                                     resource_id=name)
-        _save_meta(
-            cluster_name, {
-                'kind': 'tpu_slice',
-                'project': project,
-                'zone': zone,
-                'region': region,
-                'resource_id': name,
-                'queued_resource': bool(config.get('queued_resource')),
-                'accelerator': config.get('accelerator'),
-                'chips_per_host': config.get('chips_per_host', 0),
-                'ssh_user': ssh_user,
-            })
-        return record
+                tpu_api.create_node(project, zone, node_name, body)
+        return ProvisionRecord('gcp', cluster_name, region, zone,
+                               resource_id=names[0],
+                               is_resume=all_resumed)
 
     # Plain VM (controllers).
+    if int(config.get('num_slices', 1)) > 1:
+        raise exceptions.ProvisionError(
+            'num_nodes > 1 is only supported for TPU slice tasks; plain '
+            'VM gangs are not implemented.', retryable=False)
     existing = compute_api.get_instance(project, zone, name)
     if existing is not None:
         # Resume: any non-running state (TERMINATED == stopped in GCE,
@@ -143,8 +160,14 @@ def wait_instances(region: str, zone: Optional[str], cluster_name: str,
     if meta is None:
         raise exceptions.ClusterDoesNotExist(cluster_name)
     if meta['kind'] == 'tpu_slice':
-        tpu_api.wait_node_ready(meta['project'], meta['zone'],
-                                meta['resource_id'])
+        for node_name in _slice_ids(meta):
+            tpu_api.wait_node_ready(meta['project'], meta['zone'],
+                                    node_name)
+
+
+def _slice_ids(meta: Dict) -> List[str]:
+    """Slice resource names, oldest-metadata compatible."""
+    return meta.get('resource_ids') or [meta['resource_id']]
 
 
 def get_cluster_info(region: str, zone: Optional[str],
@@ -155,17 +178,21 @@ def get_cluster_info(region: str, zone: Optional[str],
     project = meta['project']
     private_key, _ = authentication.get_key_paths()
     if meta['kind'] == 'tpu_slice':
-        node = tpu_api.get_node(project, meta['zone'], meta['resource_id'])
-        if node is None:
-            raise exceptions.ClusterDoesNotExist(cluster_name)
+        slice_ids = _slice_ids(meta)
         instances = []
-        for i, ep in enumerate(tpu_api.node_endpoints(node)):
-            instances.append(
-                InstanceInfo(
-                    instance_id=f'{meta["resource_id"]}-w{i}',
-                    internal_ip=ep['internal'] or '',
-                    external_ip=ep['external'],
-                ))
+        for s, node_name in enumerate(slice_ids):
+            node = tpu_api.get_node(project, meta['zone'], node_name)
+            if node is None:
+                raise exceptions.ClusterDoesNotExist(
+                    f'{cluster_name} (slice {node_name})')
+            for i, ep in enumerate(tpu_api.node_endpoints(node)):
+                instances.append(
+                    InstanceInfo(
+                        instance_id=f'{node_name}-w{i}',
+                        internal_ip=ep['internal'] or '',
+                        external_ip=ep['external'],
+                        tags={'slice': str(s)},
+                    ))
         return ClusterInfo(cluster_name=cluster_name,
                            provider='gcp',
                            region=meta['region'],
@@ -174,7 +201,8 @@ def get_cluster_info(region: str, zone: Optional[str],
                            ssh_user=meta['ssh_user'],
                            ssh_private_key=private_key,
                            accelerator=meta.get('accelerator'),
-                           chips_per_host=meta.get('chips_per_host', 0))
+                           chips_per_host=meta.get('chips_per_host', 0),
+                           num_slices=len(slice_ids))
     inst = compute_api.get_instance(project, meta['zone'],
                                     meta['resource_id'])
     if inst is None:
@@ -223,14 +251,16 @@ def query_instances(cluster_name: str,
         return {}
     project = meta['project']
     if meta['kind'] == 'tpu_slice':
-        node = tpu_api.get_node(project, meta['zone'], meta['resource_id'])
-        if node is None:
-            return {}
-        status = _TPU_STATE_MAP.get(node.get('state', ''), 'unknown')
-        n_hosts = max(len(node.get('networkEndpoints', [])), 1)
-        return {
-            f'{meta["resource_id"]}-w{i}': status for i in range(n_hosts)
-        }
+        out: Dict[str, str] = {}
+        for node_name in _slice_ids(meta):
+            node = tpu_api.get_node(project, meta['zone'], node_name)
+            if node is None:
+                continue
+            status = _TPU_STATE_MAP.get(node.get('state', ''), 'unknown')
+            n_hosts = max(len(node.get('networkEndpoints', [])), 1)
+            out.update(
+                {f'{node_name}-w{i}': status for i in range(n_hosts)})
+        return out
     inst = compute_api.get_instance(project, meta['zone'],
                                     meta['resource_id'])
     if inst is None:
@@ -259,11 +289,11 @@ def terminate_instances(cluster_name: str,
     if meta is None:
         return
     if meta['kind'] == 'tpu_slice':
-        if meta.get('queued_resource'):
-            tpu_api.delete_queued_resource(meta['project'], meta['zone'],
-                                           meta['resource_id'])
-        tpu_api.delete_node(meta['project'], meta['zone'],
-                            meta['resource_id'])
+        for node_name in _slice_ids(meta):
+            if meta.get('queued_resource'):
+                tpu_api.delete_queued_resource(meta['project'],
+                                               meta['zone'], node_name)
+            tpu_api.delete_node(meta['project'], meta['zone'], node_name)
     else:
         compute_api.delete_instance(meta['project'], meta['zone'],
                                     meta['resource_id'])

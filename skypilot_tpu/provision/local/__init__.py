@@ -61,7 +61,8 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
             raise exceptions.TpuStockoutError(
                 f'[local fault injection] no capacity in {zone}')
     existing = _load_metadata(cluster_name)
-    num_hosts = int(config.get('num_hosts', 1))
+    num_hosts = int(config.get('num_hosts', 1))      # hosts PER slice
+    num_slices = int(config.get('num_slices', 1))
     if existing is not None and existing.get('status') == 'running':
         return ProvisionRecord('local', cluster_name, region, zone,
                                resource_id=cluster_name, is_resume=True)
@@ -70,11 +71,12 @@ def run_instances(region: str, zone: Optional[str], cluster_name: str,
         'region': region,
         'zone': zone,
         'num_hosts': num_hosts,
+        'num_slices': num_slices,
         'chips_per_host': int(config.get('chips_per_host') or 0),
         'accelerator': config.get('accelerator'),
         'created_at': time.time(),
     }
-    for i in range(num_hosts):
+    for i in range(num_hosts * num_slices):
         os.makedirs(os.path.join(_cluster_dir(cluster_name), f'host{i}'),
                     exist_ok=True)
     _save_metadata(cluster_name, meta)
@@ -93,13 +95,16 @@ def get_cluster_info(region: str, zone: Optional[str],
     meta = _load_metadata(cluster_name)
     if meta is None:
         raise exceptions.ClusterDoesNotExist(cluster_name)
+    num_slices = int(meta.get('num_slices', 1))
+    per_slice = meta['num_hosts']
     instances = []
-    for i in range(meta['num_hosts']):
+    for i in range(per_slice * num_slices):
         host_dir = os.path.join(_cluster_dir(cluster_name), f'host{i}')
         instances.append(
             InstanceInfo(instance_id=f'{cluster_name}-host{i}',
                          internal_ip='127.0.0.1',
                          external_ip='127.0.0.1',
+                         tags={'slice': str(i // per_slice)},
                          local_dir=host_dir))
     return ClusterInfo(cluster_name=cluster_name,
                        provider='local',
@@ -107,7 +112,8 @@ def get_cluster_info(region: str, zone: Optional[str],
                        zone=meta['zone'],
                        instances=instances,
                        accelerator=meta.get('accelerator'),
-                       chips_per_host=meta.get('chips_per_host', 0))
+                       chips_per_host=meta.get('chips_per_host', 0),
+                       num_slices=num_slices)
 
 
 def query_instances(cluster_name: str,
@@ -117,9 +123,8 @@ def query_instances(cluster_name: str,
     if meta is None:
         return {}
     status = meta.get('status', 'terminated')
-    return {
-        f'{cluster_name}-host{i}': status for i in range(meta['num_hosts'])
-    }
+    total = meta['num_hosts'] * int(meta.get('num_slices', 1))
+    return {f'{cluster_name}-host{i}': status for i in range(total)}
 
 
 def _kill_cluster_processes(cluster_name: str) -> None:

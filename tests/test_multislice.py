@@ -1,0 +1,208 @@
+"""Multi-slice (num_nodes > 1) tests: N slices provision as one cluster,
+every host of every slice runs the job with global rank + DCN topology env
+(SKYTPU_SLICE_ID / NUM_SLICES), and teardown removes everything.
+
+Parity model: the reference's TPU-pod host fan-out (num_actual_nodes =
+num_nodes * num_ips_per_node, cloud_vm_ray_backend.py:4786) extended to
+slice granularity; tier-2 on the local cloud.
+"""
+import collections
+import glob
+import os
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import state
+
+
+def _host_envs(home, cluster, job_id=1):
+    """Parse the env each host saw from its job log."""
+    envs = {}
+    logs = glob.glob(f'{home}/local_cloud/{cluster}/host*/'
+                     f'.skytpu/jobs/{job_id}/host*.local.log')
+    for p in sorted(logs):
+        for line in open(p, encoding='utf-8'):
+            if line.startswith('ENVDUMP '):
+                _, rank, slice_id, n_slices, n_nodes, proc, nproc = (
+                    line.split())
+                envs[int(rank)] = {
+                    'slice': int(slice_id), 'num_slices': int(n_slices),
+                    'num_nodes': int(n_nodes), 'process_id': int(proc),
+                    'num_processes': int(nproc),
+                }
+    return envs
+
+
+_DUMP = ('echo ENVDUMP $SKYTPU_NODE_RANK $SKYTPU_SLICE_ID '
+         '$SKYTPU_NUM_SLICES $SKYTPU_NUM_NODES $SKYTPU_PROCESS_ID '
+         '$SKYTPU_NUM_PROCESSES')
+
+
+@pytest.mark.e2e
+def test_two_slices_gang_run(skytpu_home, enable_local_cloud):
+    task = sky.Task(name='ms', run=_DUMP, num_nodes=2)
+    task.set_resources(sky.Resources(cloud='local',
+                                     accelerator='tpu-v5e-16'))
+    sky.launch(task, cluster_name='msc', stream_logs=False)
+
+    envs = _host_envs(skytpu_home, 'msc')
+    # 2 slices x 4 hosts = 8 global ranks, slice-major.
+    assert sorted(envs) == list(range(8)), envs
+    for rank, e in envs.items():
+        assert e['slice'] == rank // 4
+        assert e['num_slices'] == 2
+        assert e['num_nodes'] == 8          # total hosts
+        assert e['process_id'] == rank      # global jax process id
+        assert e['num_processes'] == 8
+    by_slice = collections.Counter(e['slice'] for e in envs.values())
+    assert by_slice == {0: 4, 1: 4}
+
+    # History records the gang width for cost accounting.
+    rec = [r for r in sky.cost_report() if r['name'] == 'msc']
+    assert rec and rec[0]['resources'] is not None
+
+    sky.down('msc')
+    assert not os.path.exists(f'{skytpu_home}/local_cloud/msc')
+
+
+@pytest.mark.e2e
+def test_multislice_cluster_reuse_checks_width(skytpu_home,
+                                               enable_local_cloud):
+    from skypilot_tpu import exceptions
+    t1 = sky.Task(name='a', run='true', num_nodes=1)
+    t1.set_resources(sky.Resources(cloud='local', accelerator='tpu-v5e-8'))
+    sky.launch(t1, cluster_name='w1', stream_logs=False)
+    t2 = sky.Task(name='b', run='true', num_nodes=2)
+    t2.set_resources(sky.Resources(cloud='local', accelerator='tpu-v5e-8'))
+    with pytest.raises(exceptions.ResourcesMismatchError, match='slice'):
+        sky.launch(t2, cluster_name='w1', stream_logs=False)
+    sky.down('w1')
+
+
+def test_gcp_multislice_request_bodies(skytpu_home, monkeypatch):
+    """GCP seam test: num_slices=3 creates 3 TPU nodes named -s0/-s1/-s2,
+    and terminate deletes all three."""
+    from skypilot_tpu.provision import gcp as gcp_provision
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    created, deleted = [], []
+    monkeypatch.setattr(tpu_api, 'get_node', lambda *a: None)
+    monkeypatch.setattr(tpu_api, 'create_node',
+                        lambda project, zone, name, body: created.append(
+                            (name, body['acceleratorType'])))
+    monkeypatch.setattr(tpu_api, 'delete_node',
+                        lambda project, zone, name: deleted.append(name))
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'default_ssh_user', lambda: 'u')
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'public_key_openssh',
+        lambda: 'ssh-ed25519 AAAA')
+
+    config = {
+        'project_id': 'proj', 'node_kind': 'tpu_slice',
+        'tpu_type': 'v5litepod-16', 'runtime_version': 'v2-alpha',
+        'accelerator': 'tpu-v5e-16', 'chips_per_host': 4,
+        'num_slices': 3,
+    }
+    rec = gcp_provision.run_instances('us-west4', 'us-west4-a', 'ms3',
+                                      config)
+    assert [n for n, _ in created] == [
+        'skytpu-ms3-s0', 'skytpu-ms3-s1', 'skytpu-ms3-s2']
+    assert all(t == 'v5litepod-16' for _, t in created)
+    assert rec.resource_id == 'skytpu-ms3-s0'
+
+    gcp_provision.terminate_instances('ms3')
+    assert deleted == ['skytpu-ms3-s0', 'skytpu-ms3-s1', 'skytpu-ms3-s2']
+
+
+def test_partial_slice_failure_keeps_created_slices_tracked(
+        skytpu_home, monkeypatch):
+    """Stockout on slice 2 of 3: slices 0-1 already exist and MUST remain
+    in the provider metadata so cleanup can delete them (no billing leak)."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.provision import gcp as gcp_provision
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    created, deleted = [], []
+
+    def _create(project, zone, name, body):
+        if name.endswith('-s2'):
+            raise exceptions.TpuStockoutError('no capacity')
+        created.append(name)
+
+    monkeypatch.setattr(tpu_api, 'get_node', lambda *a: None)
+    monkeypatch.setattr(tpu_api, 'create_node', _create)
+    monkeypatch.setattr(tpu_api, 'delete_node',
+                        lambda project, zone, name: deleted.append(name))
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'default_ssh_user', lambda: 'u')
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'public_key_openssh',
+        lambda: 'ssh-ed25519 AAAA')
+    config = {
+        'project_id': 'proj', 'node_kind': 'tpu_slice',
+        'tpu_type': 'v5litepod-16', 'runtime_version': 'v2-alpha',
+        'num_slices': 3,
+    }
+    with pytest.raises(exceptions.TpuStockoutError):
+        gcp_provision.run_instances('us-west4', 'us-west4-a', 'pf', config)
+    assert created == ['skytpu-pf-s0', 'skytpu-pf-s1']
+    # Metadata survived the failure: terminate reaches every slice name.
+    gcp_provision.terminate_instances('pf')
+    assert deleted == ['skytpu-pf-s0', 'skytpu-pf-s1', 'skytpu-pf-s2']
+
+
+def test_vm_gang_rejected(skytpu_home, monkeypatch):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.provision import gcp as gcp_provision
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'default_ssh_user', lambda: 'u')
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'public_key_openssh',
+        lambda: 'ssh-ed25519 AAAA')
+    config = {'project_id': 'proj', 'node_kind': 'vm',
+              'instance_type': 'n2-standard-8', 'num_slices': 2}
+    with pytest.raises(exceptions.ProvisionError, match='TPU slice'):
+        gcp_provision.run_instances('us-west4', 'us-west4-a', 'vmg',
+                                    config)
+
+
+@pytest.mark.e2e
+def test_reuse_keeps_existing_gang_width(skytpu_home, enable_local_cloud):
+    """A narrower task on a wider cluster reuses ALL existing slices
+    (shrinking would orphan slice resources)."""
+    t2 = sky.Task(name='w', run=_DUMP, num_nodes=2)
+    t2.set_resources(sky.Resources(cloud='local', accelerator='tpu-v5e-8'))
+    sky.launch(t2, cluster_name='wk', stream_logs=False)
+    t1 = sky.Task(name='n', run=_DUMP, num_nodes=1)
+    t1.set_resources(sky.Resources(cloud='local', accelerator='tpu-v5e-8'))
+    sky.launch(t1, cluster_name='wk', stream_logs=False)  # reuse, no error
+    envs = _host_envs(skytpu_home, 'wk', job_id=2)
+    # Second job still ran across both slices with the original width.
+    assert sorted(envs) == [0, 1]
+    assert all(e['num_slices'] == 2 for e in envs.values())
+    sky.down('wk')
+
+
+def test_single_slice_keeps_plain_name(skytpu_home, monkeypatch):
+    from skypilot_tpu.provision import gcp as gcp_provision
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    created = []
+    monkeypatch.setattr(tpu_api, 'get_node', lambda *a: None)
+    monkeypatch.setattr(tpu_api, 'create_node',
+                        lambda project, zone, name, body: created.append(
+                            name))
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'default_ssh_user', lambda: 'u')
+    monkeypatch.setattr(
+        gcp_provision.authentication, 'public_key_openssh',
+        lambda: 'ssh-ed25519 AAAA')
+    config = {
+        'project_id': 'proj', 'node_kind': 'tpu_slice',
+        'tpu_type': 'v5litepod-8', 'runtime_version': 'v2-alpha',
+        'num_slices': 1,
+    }
+    gcp_provision.run_instances('us-west4', 'us-west4-a', 'one', config)
+    assert created == ['skytpu-one']
