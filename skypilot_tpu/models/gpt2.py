@@ -120,7 +120,8 @@ class GPT2(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, positions: Optional[jax.Array] = None):
+    def __call__(self, tokens, positions: Optional[jax.Array] = None,
+                 hidden_only: bool = False):
         cfg = self.config
         if tokens.shape[1] > cfg.max_seq_len:
             # Learned-position table: out-of-range indexing would clamp
@@ -148,4 +149,6 @@ class GPT2(nn.Module):
                          prevent_cse=True)(block, x)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          name='ln_f')(x)
+        if hidden_only:
+            return x
         return x.astype(jnp.float32) @ wte.astype(jnp.float32).T

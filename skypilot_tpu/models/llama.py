@@ -38,6 +38,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    # Rematerialization policy for decoder blocks: 'full' saves nothing
+    # (min HBM, max recompute), 'dots' saves matmul outputs and recomputes
+    # elementwise ops (the usual best FLOPs/HBM trade when memory allows),
+    # 'none' disables remat (fastest when the model fits).
+    remat_policy: str = 'full'
 
     @property
     def head_dim_(self) -> int:
@@ -242,8 +247,14 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, cache=None):
+    def __call__(self, tokens, positions=None, cache=None,
+                 hidden_only=False):
         """Training/scoring: __call__(tokens) -> logits.
+
+        hidden_only=True returns the final-norm hidden states [B, S, H]
+        instead of logits — the fused/chunked loss path computes the
+        vocab projection chunk-by-chunk so [B, S, V] float32 logits are
+        never materialized in HBM (see train.trainer.chunked_cross_entropy).
 
         Incremental inference: __call__(tokens, positions, cache) ->
         (logits, new_cache) where `cache` is a per-layer list of
@@ -268,11 +279,22 @@ class Llama(nn.Module):
             if cache is not None:
                 x, layer_cache = layer(x, positions, cache[i])
                 new_cache.append(layer_cache)
+            elif cfg.remat_policy == 'none':
+                x = layer(x, positions)
             else:
+                if cfg.remat_policy not in ('full', 'dots'):
+                    raise ValueError(
+                        f'Unknown remat_policy {cfg.remat_policy!r}; '
+                        f"expected 'full', 'dots', or 'none'.")
+                policy = (jax.checkpoint_policies
+                          .dots_with_no_batch_dims_saveable
+                          if cfg.remat_policy == 'dots' else None)
                 x = nn.remat(  # rematerialize each block: HBM for FLOPs
                     lambda mdl, h, pos: mdl(h, pos),
-                    prevent_cse=True)(layer, x, positions)
+                    prevent_cse=True, policy=policy)(layer, x, positions)
         x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
+        if hidden_only:
+            return x
         if cfg.tie_embeddings:
             logits = x.astype(jnp.float32) @ embed.astype(jnp.float32).T
         else:

@@ -189,7 +189,7 @@ class Mixtral(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, hidden_only=False):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(
@@ -206,6 +206,8 @@ class Mixtral(nn.Module):
             x = nn.remat(lambda mdl, h, pos: mdl(h, pos),
                          prevent_cse=True)(layer, x, positions)
         x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
+        if hidden_only:
+            return x
         if cfg.tie_embeddings:
             return x.astype(jnp.float32) @ embed.astype(jnp.float32).T
         return nn.DenseGeneral(
