@@ -768,14 +768,17 @@ def infer():
 @click.option('--tokenizer', default=None, help='HF tokenizer (optional).')
 @click.option('--eos-id', default=None, type=int,
               help='Stop token (defaults to the tokenizer\'s EOS).')
+@click.option('--decode-steps', default=8, type=int,
+              help='Decode tokens per device dispatch (latency knob).')
 def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
-                eos_id):
+                eos_id, decode_steps):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     click.echo(f'serving {model} on {host}:{port}')
     infer_server.run(model=model, host=host, port=port,
                      num_slots=num_slots, max_cache_len=max_cache_len,
-                     tokenizer_name=tokenizer, eos_id=eos_id)
+                     tokenizer_name=tokenizer, eos_id=eos_id,
+                     decode_steps=decode_steps)
 
 
 @infer.command('bench')
@@ -785,15 +788,17 @@ def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
 @click.option('--new-tokens', default=64, type=int)
 @click.option('--num-slots', default=8, type=int)
 @click.option('--max-cache-len', default=2048, type=int)
+@click.option('--decode-steps', default=8, type=int)
 def infer_bench(model, num_requests, prompt_len, new_tokens, num_slots,
-                max_cache_len):
+                max_cache_len, decode_steps):
     """Benchmark the engine (req/s, tok/s, TTFT) with synthetic prompts."""
     import json as json_lib
 
     from skypilot_tpu.infer import InferConfig, InferenceEngine
     from skypilot_tpu.models import get_model_config
     cfg = InferConfig(model=model, num_slots=num_slots,
-                      max_cache_len=max_cache_len)
+                      max_cache_len=max_cache_len,
+                      decode_steps=decode_steps)
     engine = InferenceEngine(get_model_config(model), cfg)
     metrics = engine.benchmark(num_requests=num_requests,
                                prompt_len=prompt_len,

@@ -181,7 +181,8 @@ def serve(engine: InferenceEngine, host: str = '0.0.0.0', port: int = 8100,
 def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         num_slots: int = 8, max_cache_len: int = 2048,
         tokenizer_name: Optional[str] = None,
-        eos_id: Optional[int] = None) -> None:
+        eos_id: Optional[int] = None,
+        decode_steps: int = 8) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI."""
     from skypilot_tpu.models import get_model_config
@@ -192,7 +193,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         if eos_id is None:
             eos_id = getattr(tokenizer, 'eos_token_id', None)
     cfg = InferConfig(model=model, num_slots=num_slots,
-                      max_cache_len=max_cache_len, eos_id=eos_id)
+                      max_cache_len=max_cache_len, eos_id=eos_id,
+                      decode_steps=decode_steps)
     engine = InferenceEngine(get_model_config(model), cfg)
     serve(engine, host=host, port=port, tokenizer=tokenizer)
 
@@ -207,10 +209,12 @@ def main() -> None:
     parser.add_argument('--tokenizer', default=None,
                         help='HF tokenizer name (optional)')
     parser.add_argument('--eos-id', type=int, default=None)
+    parser.add_argument('--decode-steps', type=int, default=8)
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
-        tokenizer_name=args.tokenizer, eos_id=args.eos_id)
+        tokenizer_name=args.tokenizer, eos_id=args.eos_id,
+        decode_steps=args.decode_steps)
 
 
 if __name__ == '__main__':

@@ -175,3 +175,19 @@ def test_http_server_generate(tiny_config):
     finally:
         httpd.shutdown()
         srv.stop()
+
+
+def test_decode_steps_window_matches_single_step(tiny_config):
+    """Greedy generation must be identical for decode_steps=1 and K>1
+    (the scan window only amortizes dispatch, never changes tokens)."""
+    results = {}
+    for k in (1, 8):
+        cfg = InferConfig(num_slots=2, max_cache_len=64,
+                          prefill_buckets=(8,), max_new_tokens=12,
+                          cache_dtype=jnp.float32, decode_steps=k)
+        eng = InferenceEngine(tiny_config, cfg,
+                              rng=jax.random.PRNGKey(3))
+        out = eng.generate([Request(tokens=[1, 2, 3], request_id='a'),
+                            Request(tokens=[5, 4, 3, 2], request_id='b')])
+        results[k] = {r.request_id: r.output_tokens for r in out}
+    assert results[1] == results[8], results
