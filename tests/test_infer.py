@@ -191,3 +191,51 @@ def test_decode_steps_window_matches_single_step(tiny_config):
                             Request(tokens=[5, 4, 3, 2], request_id='b')])
         results[k] = {r.request_id: r.output_tokens for r in out}
     assert results[1] == results[8], results
+
+
+def test_generate_stream_burst_with_prefill_cap(tiny_config):
+    """The serving loop must drain a burst larger than the slot count,
+    with prefills capped per decode gap (in-flight latency protection),
+    and deliver every result exactly once."""
+    import queue as queue_lib
+    import threading
+
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=6, cache_dtype=jnp.float32,
+                      decode_steps=4, prefills_per_gap=1)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(0))
+
+    # Instrument: record the prefill/decode interleaving so the cap is
+    # actually asserted (not just final results).
+    events = []
+    orig_start, orig_decode = eng._start_request, eng._decode_step
+    eng._start_request = lambda *a, **k: (events.append('p'),
+                                          orig_start(*a, **k))[1]
+    eng._decode_step = lambda: (events.append('d'), orig_decode())[1]
+    q = queue_lib.Queue()
+    results = {}
+    done = threading.Event()
+    stop = threading.Event()
+
+    def cb(res):
+        results[res.request_id] = res
+        if len(results) == 6:
+            done.set()
+
+    for i in range(6):
+        q.put(Request(tokens=[1, 2, i + 1], request_id=str(i)))
+    t = threading.Thread(target=eng.generate_stream,
+                         args=(q, cb, stop), daemon=True)
+    t.start()
+    assert done.wait(timeout=120), f'only {len(results)}/6 finished'
+    stop.set()
+    t.join(timeout=30)
+    assert sorted(results) == [str(i) for i in range(6)]
+    for res in results.values():
+        assert res.finish_reason == 'length'
+        assert len(res.output_tokens) == 6
+    # The cap held: after the first prefill, never more than
+    # prefills_per_gap consecutive prefills between decode windows.
+    runs = [len(r) for r in ''.join(events).split('d') if r]
+    assert events and max(runs[1:], default=0) <= cfg.prefills_per_gap, \
+        (events, runs)
