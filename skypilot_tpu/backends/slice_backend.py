@@ -20,7 +20,7 @@ from skypilot_tpu.provision import provisioner
 from skypilot_tpu.provision.common import ClusterInfo
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.status_lib import ClusterStatus
-from skypilot_tpu.utils import (command_runner, common, locks,
+from skypilot_tpu.utils import (command_runner, common, locks, ssh_config,
                                 subprocess_utils, timeline, ux)
 
 logger = logsys.init_logger(__name__)
@@ -263,6 +263,10 @@ class SliceBackend(Backend[SliceResourceHandle]):
                 zip(info.internal_ips(), info.external_ips()))
             state.add_or_update_cluster(cluster_name, handle,
                                         set(task.resources), ready=True)
+            # `ssh <cluster>` / `ssh <cluster>-workerN` aliases (parity:
+            # SSHConfigHelper, backend_utils.py:399).
+            ssh_config.add_cluster(cluster_name, info.external_ips(),
+                                   info.ssh_user, info.ssh_private_key)
             logger.info('%s Cluster %r is UP (%d host(s)%s).',
                         ux.ok('[done]'), cluster_name, info.num_hosts,
                         f' across {info.num_slices} slices'
@@ -492,6 +496,8 @@ class SliceBackend(Backend[SliceResourceHandle]):
                     raise
                 logger.warning('Teardown error ignored due to purge: %s', e)
             state.remove_cluster(cluster_name, terminate=terminate)
+            if terminate:
+                ssh_config.remove_cluster(cluster_name)
         verb = 'Terminated' if terminate else 'Stopped'
         logger.info('%s %s cluster %r.', ux.ok('[down]'), verb, cluster_name)
 

@@ -1,0 +1,115 @@
+"""~/.ssh/config integration: `ssh <cluster>` reaches the head host.
+
+Parity: SSHConfigHelper (sky/backends/backend_utils.py:399) — per-cluster
+config files under ~/.ssh/skytpu/ plus one managed Include line in
+~/.ssh/config.  Workers are addressable as `<cluster>-worker<N>`.
+
+Safety: the user's ~/.ssh/config is rewritten atomically under a lock
+(a crash mid-write must never truncate it), every interpolated value is
+validated against directive injection, and all of this is best-effort
+convenience — callers must not fail a launch over it.
+"""
+import os
+import re
+from typing import List, Optional
+
+from skypilot_tpu import logsys
+from skypilot_tpu.utils import common, locks
+
+logger = logsys.init_logger(__name__)
+
+_INCLUDE_LINE = 'Include skytpu/*.conf'
+_MARK = '# Added by skytpu: cluster ssh aliases'
+# ssh config values must stay single-token: a newline or '#' would start
+# a new directive/comment (ProxyCommand injection via crafted ssh_user).
+_SAFE_VALUE = re.compile(r'^[A-Za-z0-9@._/~-]+$')
+
+
+def _ssh_dir() -> str:
+    return os.path.expanduser(os.environ.get('SKYTPU_SSH_DIR', '~/.ssh'))
+
+
+def _conf_dir() -> str:
+    d = os.path.join(_ssh_dir(), 'skytpu')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _conf_path(cluster_name: str) -> str:
+    return os.path.join(_conf_dir(), f'{cluster_name}.conf')
+
+
+def _ensure_include() -> None:
+    """Prepend the Include to ~/.ssh/config once (ssh applies the FIRST
+    matching option per host, so the include must come before any
+    user-defined catch-all Host blocks).  Atomic rewrite under a lock:
+    this file may hold the user's entire ssh world."""
+    path = os.path.join(_ssh_dir(), 'config')
+    with locks.named_lock('ssh-config'):
+        existing = ''
+        if os.path.exists(path):
+            with open(path, 'r', encoding='utf-8') as f:
+                existing = f.read()
+            if _INCLUDE_LINE in existing:
+                return
+        os.makedirs(_ssh_dir(), exist_ok=True)
+        tmp = f'{path}.skytpu.{os.getpid()}'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            f.write(f'{_MARK}\n{_INCLUDE_LINE}\n\n{existing}')
+        os.chmod(tmp, 0o600)
+        os.replace(tmp, path)
+
+
+def _host_block(alias: str, ip: str, user: str, key: str, port: int) -> str:
+    return (f'Host {alias}\n'
+            f'  HostName {ip}\n'
+            f'  User {user}\n'
+            f'  IdentityFile {key}\n'
+            f'  Port {port}\n'
+            f'  IdentitiesOnly yes\n'
+            f'  StrictHostKeyChecking no\n'
+            f'  UserKnownHostsFile /dev/null\n'
+            f'  LogLevel ERROR\n')
+
+
+def add_cluster(cluster_name: str, ips: List[str], ssh_user: str,
+                key_path: str, port: int = 22) -> Optional[str]:
+    """Write `<cluster>` (head) + `<cluster>-worker<N>` aliases.
+    Returns the config file path, or None when skipped: no real ssh
+    endpoint (the local test cloud) or any value that cannot be written
+    safely.  Never raises — this is a convenience layer."""
+    try:
+        if not ips or not ssh_user:
+            return None
+        values = [cluster_name, ssh_user, key_path, *ips]
+        if (not common.is_valid_cluster_name(cluster_name) or
+                not all(v and _SAFE_VALUE.fullmatch(str(v))
+                        for v in values)):
+            logger.warning(
+                'Not writing ssh aliases for %r: value failed the '
+                'single-token safety check.', cluster_name)
+            return None
+        _ensure_include()
+        blocks = [_host_block(cluster_name, ips[0], ssh_user, key_path,
+                              port)]
+        for i, ip in enumerate(ips[1:], start=1):
+            blocks.append(_host_block(f'{cluster_name}-worker{i}', ip,
+                                      ssh_user, key_path, port))
+        path = _conf_path(cluster_name)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(f'{_MARK}\n' + '\n'.join(blocks))
+        os.chmod(path, 0o600)
+        return path
+    except OSError as e:
+        logger.warning('Could not write ssh aliases for %r: %s',
+                       cluster_name, e)
+        return None
+
+
+def remove_cluster(cluster_name: str) -> None:
+    if not common.is_valid_cluster_name(cluster_name):
+        return  # never let a crafted name traverse out of the conf dir
+    try:
+        os.remove(_conf_path(cluster_name))
+    except OSError:
+        pass

