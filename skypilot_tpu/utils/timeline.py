@@ -131,10 +131,28 @@ class FileLockEvent:
 
 
 def save() -> None:
+    """Dump, merging with any existing trace at the path: successive CLI
+    invocations accumulate into one viewable timeline instead of each
+    process clobbering the last.  The file grows until the user deletes
+    it (delete = start a new session).  Cross-process safe: the
+    read-merge-replace runs under a file lock next to the trace."""
     path = _file_path()
     if not path:
         return
     path = os.path.expanduser(path)
     os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
-    with _lock, open(path, 'w', encoding='utf-8') as f:
-        json.dump({'traceEvents': _events}, f)
+    import filelock
+    with _lock, filelock.FileLock(path + '.lock'):
+        prior: List[dict] = []
+        try:
+            with open(path, 'r', encoding='utf-8') as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict) and isinstance(
+                    loaded.get('traceEvents'), list):
+                prior = loaded['traceEvents']
+        except (OSError, ValueError):
+            pass  # unreadable/corrupt prior trace: start fresh
+        tmp = f'{path}.tmp.{os.getpid()}'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump({'traceEvents': prior + _events}, f)
+        os.replace(tmp, path)

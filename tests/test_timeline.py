@@ -1,0 +1,81 @@
+"""Timeline tracing tests (parity: sky/utils/timeline.py)."""
+import json
+
+from skypilot_tpu.utils import timeline
+
+
+def test_save_merges_across_processes(tmp_path, monkeypatch):
+    """A session of several CLI invocations (each its own process) must
+    accumulate into one trace file, not clobber it."""
+    path = tmp_path / 'trace.json'
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(path))
+    path.write_text(json.dumps(
+        {'traceEvents': [{'name': 'earlier-process', 'ph': 'B'}]}))
+    monkeypatch.setattr(timeline, '_events',
+                        [{'name': 'this-process', 'ph': 'B'}])
+    timeline.save()
+    events = json.loads(path.read_text())['traceEvents']
+    assert [e['name'] for e in events] == ['earlier-process',
+                                          'this-process']
+
+
+def test_save_tolerates_corrupt_prior_file(tmp_path, monkeypatch):
+    path = tmp_path / 'trace.json'
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(path))
+    path.write_text('{not json')
+    monkeypatch.setattr(timeline, '_events', [{'name': 'x', 'ph': 'B'}])
+    timeline.save()
+    assert json.loads(path.read_text())['traceEvents'] == [
+        {'name': 'x', 'ph': 'B'}]
+
+
+def test_event_decorator_records_pairs(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(tmp_path / 't.json'))
+    monkeypatch.setattr(timeline, '_events', [])
+    monkeypatch.setattr(timeline, '_enabled', None)
+
+    @timeline.event
+    def work():
+        return 42
+
+    assert work() == 42
+    phases = [e['ph'] for e in timeline._events]
+    assert phases == ['B', 'E']
+
+
+def test_save_tolerates_wrong_shape_prior_file(tmp_path, monkeypatch):
+    """Valid JSON of the wrong shape must not crash the atexit handler."""
+    path = tmp_path / 'trace.json'
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE', str(path))
+    for bad in ('["x"]', '{"traceEvents": {}}', '5'):
+        path.write_text(bad)
+        monkeypatch.setattr(timeline, '_events', [{'name': 'y', 'ph': 'B'}])
+        timeline.save()
+        assert json.loads(path.read_text())['traceEvents'] == [
+            {'name': 'y', 'ph': 'B'}]
+
+
+def test_concurrent_saves_do_not_drop_events(tmp_path, monkeypatch):
+    """Two processes exiting together must both land in the trace (file
+    lock around read-merge-replace)."""
+    import multiprocessing as mp
+
+    path = tmp_path / 'trace.json'
+
+    def _save(tag):
+        import os
+        os.environ['SKYTPU_TIMELINE_FILE'] = str(path)
+        from skypilot_tpu.utils import timeline as tl
+        tl._events.append({'name': tag, 'ph': 'B'})
+        tl.save()
+
+    ctx = mp.get_context('fork')  # closures aren't picklable under spawn
+    ps = [ctx.Process(target=_save, args=(f'p{i}',)) for i in range(4)]
+    for p in ps:
+        p.start()
+    for p in ps:
+        p.join(timeout=60)
+        assert p.exitcode == 0
+    names = {e['name'] for e in
+             json.loads(path.read_text())['traceEvents']}
+    assert names == {'p0', 'p1', 'p2', 'p3'}
