@@ -34,7 +34,10 @@ def main() -> None:
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
     model_name = 'llama-800m'
-    batch_size = 16 * n_dev
+    # 24 seq/chip is the measured HBM sweet spot on v5e (16 GB): +6%
+    # MFU over 16/chip; 28+ no longer compiles (params + adam state +
+    # remat'd activations exceed HBM).
+    batch_size = 24 * n_dev
     seq_len = 2048
     steps = 20
 
