@@ -770,15 +770,18 @@ def infer():
               help='Stop token (defaults to the tokenizer\'s EOS).')
 @click.option('--decode-steps', default=8, type=int,
               help='Decode tokens per device dispatch (latency knob).')
+@click.option('--hf-model', default=None,
+              help='HF Llama checkpoint (local path or warm cache): serve '
+                   'real pretrained weights; implies its tokenizer.')
 def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
-                eos_id, decode_steps):
+                eos_id, decode_steps, hf_model):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
-    click.echo(f'serving {model} on {host}:{port}')
+    click.echo(f'serving {hf_model or model} on {host}:{port}')
     infer_server.run(model=model, host=host, port=port,
                      num_slots=num_slots, max_cache_len=max_cache_len,
                      tokenizer_name=tokenizer, eos_id=eos_id,
-                     decode_steps=decode_steps)
+                     decode_steps=decode_steps, hf_model=hf_model)
 
 
 @infer.command('bench')

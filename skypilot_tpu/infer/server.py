@@ -182,20 +182,63 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         num_slots: int = 8, max_cache_len: int = 2048,
         tokenizer_name: Optional[str] = None,
         eos_id: Optional[int] = None,
-        decode_steps: int = 8) -> None:
+        decode_steps: int = 8,
+        hf_model: Optional[str] = None) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
-    module entry point and the `skytpu infer serve` CLI."""
-    from skypilot_tpu.models import get_model_config
+    module entry point and the `skytpu infer serve` CLI.
+
+    hf_model: HuggingFace Llama checkpoint (local path or warm cache) —
+    real pretrained weights instead of the registry's random init.  The
+    tokenizer defaults to the same checkpoint.
+    """
+    import jax.numpy as jnp
+
+    params = None
+    tokenizer_implied = False   # tokenizer_name defaulted from hf_model
+    if hf_model:
+        import jax
+        import transformers
+
+        from skypilot_tpu.models import hf_import
+        # Family check from config.json alone — fail in milliseconds,
+        # before the (potentially tens-of-GB) weight load.
+        mt = getattr(transformers.AutoConfig.from_pretrained(hf_model),
+                     'model_type', None)
+        if mt != 'llama':
+            raise ValueError(
+                f'--hf-model must be a llama-family checkpoint; got '
+                f'model_type={mt!r}')
+        # Serving: bf16 weights end to end (half the host RAM and HBM,
+        # MXU-native).
+        model_config, tree = hf_import.load_hf_model(
+            hf_model, param_dtype=jnp.bfloat16)
+        params = {'params': jax.tree.map(jnp.asarray, tree)}
+        del tree  # free the host copy for the server's lifetime
+        model = model_config.name
+        if tokenizer_name is None:
+            tokenizer_name = hf_model
+            tokenizer_implied = True
+    else:
+        from skypilot_tpu.models import get_model_config
+        model_config = get_model_config(model)
     tokenizer = None
     if tokenizer_name:
         from transformers import AutoTokenizer
-        tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
-        if eos_id is None:
+        try:
+            tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
+        except Exception as e:  # noqa: BLE001 — tokenizer is optional
+            if not tokenizer_implied:
+                raise  # explicitly requested: fail loudly
+            # Checkpoint dir without tokenizer files: serve token-only.
+            print(f'warning: no tokenizer in {tokenizer_name} ({e}); '
+                  '/generate_text disabled, /generate (token API) works')
+            tokenizer = None
+        if eos_id is None and tokenizer is not None:
             eos_id = getattr(tokenizer, 'eos_token_id', None)
     cfg = InferConfig(model=model, num_slots=num_slots,
                       max_cache_len=max_cache_len, eos_id=eos_id,
                       decode_steps=decode_steps)
-    engine = InferenceEngine(get_model_config(model), cfg)
+    engine = InferenceEngine(model_config, cfg, params=params)
     serve(engine, host=host, port=port, tokenizer=tokenizer)
 
 
@@ -210,11 +253,14 @@ def main() -> None:
                         help='HF tokenizer name (optional)')
     parser.add_argument('--eos-id', type=int, default=None)
     parser.add_argument('--decode-steps', type=int, default=8)
+    parser.add_argument('--hf-model', default=None,
+                        help='HF Llama checkpoint (local path/cache): '
+                             'serve real pretrained weights')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
         tokenizer_name=args.tokenizer, eos_id=args.eos_id,
-        decode_steps=args.decode_steps)
+        decode_steps=args.decode_steps, hf_model=args.hf_model)
 
 
 if __name__ == '__main__':

@@ -1,0 +1,480 @@
+"""HuggingFace checkpoint import: torch state_dicts → this framework's
+flax parameter trees.
+
+Role parity: the reference's serving/finetune recipes consume HF
+checkpoints through vLLM / JetStream / HF Trainer (llm/vllm/,
+examples/tpu/v6e/serve-llama2-7b.yaml, llm/llama-3_1-finetuning/) — the
+weights path into the stack.  Here the bridge is explicit: convert once,
+then train (`create_sharded_state` donates the tree its shardings) or
+serve (`InferenceEngine(params=...)`).
+
+Two layers:
+
+- ``convert_state_dict(cfg, state_dict)`` — pure tensor-layout
+  conversion (torch [out, in] linears → flax [in, out] DenseGeneral
+  kernels, fused head reshapes, RMSNorm "+1" reparameterization).  No
+  torch/transformers import needed; values may be torch tensors or
+  numpy arrays.
+- ``load_hf_model(name_or_path)`` — loads a transformers model (local
+  path or cache; this environment has no egress, so pass a local
+  checkout or rely on a warm cache), derives the matching config via
+  ``config_from_hf``, and converts.
+
+Conventions verified against the model defs (llama.py / gpt2.py /
+mixtral.py / bert.py):
+
+- RoPE is the rotate-half convention on both sides — no head-dim
+  permutation is needed for HF Llama/Mixtral weights.
+- Our RMSNorm stores ``scale = w - 1`` (zero-init == identity), so HF
+  norm weights convert as ``w - 1``; LayerNorms (GPT-2/BERT) convert
+  as-is.
+- HF GPT-2 uses Conv1D ([in, out]) — its weights are NOT transposed;
+  everything else is torch Linear ([out, in]) and is.
+- Our Mixtral MoE is capacity-limited (dense einsum dispatch); HF's is
+  capacity-unlimited.  Converted weights are exact, but forward parity
+  holds only when ``capacity_factor >= num_experts/experts_per_token``
+  (no dropped tokens) — raise it when serving converted checkpoints.
+"""
+import contextvars
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu.models.bert import BertConfig
+from skypilot_tpu.models.gpt2 import GPT2Config
+from skypilot_tpu.models.llama import LlamaConfig
+from skypilot_tpu.models.mixtral import MixtralConfig
+
+
+# Target dtype for converted weight leaves (None = float32).  Set per
+# conversion by convert_state_dict(param_dtype=...); a bf16 target keeps
+# peak host RAM at one transient f32 tensor instead of a full f32 tree.
+# ContextVar: concurrent conversions in different threads/contexts each
+# see their own value.
+_PARAM_DTYPE: 'contextvars.ContextVar[Optional[Any]]' = \
+    contextvars.ContextVar('hf_import_param_dtype', default=None)
+
+
+def _np(x) -> np.ndarray:
+    """torch tensor / numpy array → numpy in the target param dtype
+    (bf16-safe: goes through a single transient f32 copy per tensor)."""
+    if hasattr(x, 'detach'):
+        x = x.detach().to('cpu').float().numpy()
+    x = np.asarray(x, dtype=np.float32)
+    dt = _PARAM_DTYPE.get()
+    return x.astype(dt) if dt is not None else x
+
+
+def _norm_scale(x) -> np.ndarray:
+    """RMSNorm weight → our '+1' reparam, always f32 (tiny arrays; the
+    subtraction must not round in bf16)."""
+    return np.asarray(_np(x), np.float32) - 1.0
+
+
+def _linear(w) -> np.ndarray:
+    """torch Linear [out, in] → flax kernel [in, out]."""
+    return _np(w).T
+
+
+def _qkv_kernel(w, num_heads: int, head_dim: int) -> np.ndarray:
+    """[H*d_total, H_in] → [H_in, num_heads, head_dim]."""
+    w = _linear(w)
+    return w.reshape(w.shape[0], num_heads, head_dim)
+
+
+def _oproj_kernel(w, num_heads: int, head_dim: int) -> np.ndarray:
+    """[H_out, heads*d] → [heads, d, H_out]."""
+    w = _linear(w)
+    return w.reshape(num_heads, head_dim, w.shape[1])
+
+
+class _SD:
+    """state_dict view that strips an optional prefix and tracks usage."""
+
+    def __init__(self, sd: Dict[str, Any]):
+        self._sd = dict(sd)
+        self.used = set()
+
+    def __call__(self, key: str):
+        for k in (key, f'model.{key}', f'transformer.{key}'):
+            if k in self._sd:
+                self.used.add(k)
+                return self._sd[k]
+        raise KeyError(
+            f'{key!r} not in checkpoint (tried bare/model./transformer. '
+            f'prefixes); sample keys: {sorted(self._sd)[:5]}')
+
+    def has(self, key: str) -> bool:
+        return any(f'{p}{key}' in self._sd
+                   for p in ('', 'model.', 'transformer.'))
+
+    def unused(self):
+        return sorted(set(self._sd) - self.used)
+
+
+# ------------------------------------------------------------------ llama
+
+
+def _convert_llama(cfg: LlamaConfig, sd: _SD) -> Dict[str, Any]:
+    d = cfg.head_dim_
+    params: Dict[str, Any] = {
+        'embedding': _np(sd('embed_tokens.weight')),
+        'final_norm': {'scale': _norm_scale(sd('norm.weight'))},
+    }
+    for i in range(cfg.num_layers):
+        p = f'layers.{i}.'
+        params[f'layer_{i}'] = {
+            'input_norm': {
+                'scale': _norm_scale(sd(p + 'input_layernorm.weight'))},
+            'post_attn_norm': {
+                'scale': _norm_scale(
+                    sd(p + 'post_attention_layernorm.weight'))},
+            'attn': {
+                'q_proj': {'kernel': _qkv_kernel(
+                    sd(p + 'self_attn.q_proj.weight'), cfg.num_heads, d)},
+                'k_proj': {'kernel': _qkv_kernel(
+                    sd(p + 'self_attn.k_proj.weight'), cfg.num_kv_heads,
+                    d)},
+                'v_proj': {'kernel': _qkv_kernel(
+                    sd(p + 'self_attn.v_proj.weight'), cfg.num_kv_heads,
+                    d)},
+                'o_proj': {'kernel': _oproj_kernel(
+                    sd(p + 'self_attn.o_proj.weight'), cfg.num_heads, d)},
+            },
+            'mlp': {
+                'gate_proj': {
+                    'kernel': _linear(sd(p + 'mlp.gate_proj.weight'))},
+                'up_proj': {
+                    'kernel': _linear(sd(p + 'mlp.up_proj.weight'))},
+                'down_proj': {
+                    'kernel': _linear(sd(p + 'mlp.down_proj.weight'))},
+            },
+        }
+    if not cfg.tie_embeddings:
+        params['lm_head'] = {'kernel': _linear(sd('lm_head.weight'))}
+    return params
+
+
+# ------------------------------------------------------------------ gpt2
+
+
+def _convert_gpt2(cfg: GPT2Config, sd: _SD) -> Dict[str, Any]:
+    h, nh, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim_
+    params: Dict[str, Any] = {
+        'wte': _np(sd('wte.weight')),
+        'wpe': _np(sd('wpe.weight')),
+        'ln_f': {'scale': _np(sd('ln_f.weight')),
+                 'bias': _np(sd('ln_f.bias'))},
+    }
+    for i in range(cfg.num_layers):
+        p = f'h.{i}.'
+        # HF GPT-2 Conv1D stores [in, out] — no transpose anywhere here.
+        c_attn_w = _np(sd(p + 'attn.c_attn.weight'))     # [H, 3H]
+        c_attn_b = _np(sd(p + 'attn.c_attn.bias'))       # [3H]
+        c_proj_w = _np(sd(p + 'attn.c_proj.weight'))     # [H, H]
+        params[f'h_{i}'] = {
+            'ln_1': {'scale': _np(sd(p + 'ln_1.weight')),
+                     'bias': _np(sd(p + 'ln_1.bias'))},
+            'ln_2': {'scale': _np(sd(p + 'ln_2.weight')),
+                     'bias': _np(sd(p + 'ln_2.bias'))},
+            'attn': {
+                'c_attn': {'kernel': c_attn_w.reshape(h, 3, nh, d),
+                           'bias': c_attn_b.reshape(3, nh, d)},
+                'c_proj': {'kernel': c_proj_w.reshape(nh, d, h),
+                           'bias': _np(sd(p + 'attn.c_proj.bias'))},
+            },
+            'mlp': {
+                'c_fc': {'kernel': _np(sd(p + 'mlp.c_fc.weight')),
+                         'bias': _np(sd(p + 'mlp.c_fc.bias'))},
+                'c_proj': {'kernel': _np(sd(p + 'mlp.c_proj.weight')),
+                           'bias': _np(sd(p + 'mlp.c_proj.bias'))},
+            },
+        }
+    return params
+
+
+# ---------------------------------------------------------------- mixtral
+
+
+def _convert_mixtral(cfg: MixtralConfig, sd: _SD) -> Dict[str, Any]:
+    d = cfg.head_dim_
+    params: Dict[str, Any] = {
+        'embedding': _np(sd('embed_tokens.weight')),
+        'final_norm': {'scale': _norm_scale(sd('norm.weight'))},
+    }
+    for i in range(cfg.num_layers):
+        p = f'layers.{i}.'
+        experts = range(cfg.num_experts)
+        moe = p + 'block_sparse_moe.'
+        params[f'layer_{i}'] = {
+            'input_norm': {
+                'scale': _norm_scale(sd(p + 'input_layernorm.weight'))},
+            'post_attn_norm': {
+                'scale': _norm_scale(
+                    sd(p + 'post_attention_layernorm.weight'))},
+            'attn': {
+                'q_proj': {'kernel': _qkv_kernel(
+                    sd(p + 'self_attn.q_proj.weight'), cfg.num_heads, d)},
+                'k_proj': {'kernel': _qkv_kernel(
+                    sd(p + 'self_attn.k_proj.weight'), cfg.num_kv_heads,
+                    d)},
+                'v_proj': {'kernel': _qkv_kernel(
+                    sd(p + 'self_attn.v_proj.weight'), cfg.num_kv_heads,
+                    d)},
+                'o_proj': {'kernel': _oproj_kernel(
+                    sd(p + 'self_attn.o_proj.weight'), cfg.num_heads, d)},
+            },
+            'moe': {
+                'router': {'kernel': _linear(sd(moe + 'gate.weight'))},
+                # HF expert naming: w1=gate, w3=up, w2=down.
+                'w_gate': np.stack([
+                    _linear(sd(moe + f'experts.{e}.w1.weight'))
+                    for e in experts]),
+                'w_up': np.stack([
+                    _linear(sd(moe + f'experts.{e}.w3.weight'))
+                    for e in experts]),
+                'w_down': np.stack([
+                    _linear(sd(moe + f'experts.{e}.w2.weight'))
+                    for e in experts]),
+            },
+        }
+    if not cfg.tie_embeddings:
+        params['lm_head'] = {'kernel': _linear(sd('lm_head.weight'))}
+    return params
+
+
+# ------------------------------------------------------------------- bert
+
+
+def _convert_bert(cfg: BertConfig, sd: _SD) -> Dict[str, Any]:
+    nh, d = cfg.num_heads, cfg.head_dim_
+
+    def norm(key):
+        return {'scale': _np(sd(key + '.weight')),
+                'bias': _np(sd(key + '.bias'))}
+
+    bert: Dict[str, Any] = {
+        'word_embeddings': _np(sd('bert.embeddings.word_embeddings.weight')),
+        'position_embeddings':
+            _np(sd('bert.embeddings.position_embeddings.weight')),
+        'token_type_embeddings':
+            _np(sd('bert.embeddings.token_type_embeddings.weight')),
+        'embeddings_norm': norm('bert.embeddings.LayerNorm'),
+    }
+    for i in range(cfg.num_layers):
+        p = f'bert.encoder.layer.{i}.'
+        bert[f'layer_{i}'] = {
+            'attention': {
+                'query': {
+                    'kernel': _qkv_kernel(
+                        sd(p + 'attention.self.query.weight'), nh, d),
+                    'bias': _np(
+                        sd(p + 'attention.self.query.bias')).reshape(nh, d)},
+                'key': {
+                    'kernel': _qkv_kernel(
+                        sd(p + 'attention.self.key.weight'), nh, d),
+                    'bias': _np(
+                        sd(p + 'attention.self.key.bias')).reshape(nh, d)},
+                'value': {
+                    'kernel': _qkv_kernel(
+                        sd(p + 'attention.self.value.weight'), nh, d),
+                    'bias': _np(
+                        sd(p + 'attention.self.value.bias')).reshape(nh, d)},
+                'output': {
+                    'kernel': _oproj_kernel(
+                        sd(p + 'attention.output.dense.weight'), nh, d),
+                    'bias': _np(sd(p + 'attention.output.dense.bias'))},
+            },
+            'attention_norm': norm(p + 'attention.output.LayerNorm'),
+            'intermediate': {
+                'kernel': _linear(sd(p + 'intermediate.dense.weight')),
+                'bias': _np(sd(p + 'intermediate.dense.bias'))},
+            'output': {
+                'kernel': _linear(sd(p + 'output.dense.weight')),
+                'bias': _np(sd(p + 'output.dense.bias'))},
+            'output_norm': norm(p + 'output.LayerNorm'),
+        }
+    params: Dict[str, Any] = {'bert': bert}
+    if sd.has('cls.predictions.transform.dense.weight'):   # MLM head
+        params['transform'] = {
+            'kernel': _linear(sd('cls.predictions.transform.dense.weight')),
+            'bias': _np(sd('cls.predictions.transform.dense.bias'))}
+        params['transform_norm'] = norm('cls.predictions.transform.LayerNorm')
+        params['decoder'] = {
+            'kernel': _linear(sd('cls.predictions.decoder.weight')),
+            'bias': _np(sd('cls.predictions.bias'))}
+    return params
+
+
+_CONVERTERS = {
+    LlamaConfig: _convert_llama,
+    GPT2Config: _convert_gpt2,
+    MixtralConfig: _convert_mixtral,
+    BertConfig: _convert_bert,
+}
+
+
+# Non-weight buffers / storage-shared duplicates that legitimately remain
+# unconverted (matched as suffixes against checkpoint keys).
+_IGNORABLE_SUFFIXES = (
+    'rotary_emb.inv_freq',          # old llama/mixtral checkpoints
+    '.attn.bias',                   # gpt2 causal-mask buffer
+    '.attn.masked_bias',            # gpt2 mask fill buffer
+    'embeddings.position_ids',      # bert position buffer
+    'cls.predictions.decoder.bias',  # same tensor as cls.predictions.bias
+)
+
+
+def convert_state_dict(cfg, state_dict: Dict[str, Any],
+                       strict: bool = True,
+                       param_dtype: Optional[Any] = None) -> Dict[str, Any]:
+    """Convert an HF torch state_dict to this framework's param tree.
+
+    Returns the inner params dict — wrap as ``{'params': tree}`` for
+    ``model.apply``, or pass to ``InferenceEngine(params={'params': tree})``.
+
+    strict: raise if the checkpoint contains weights with no converter
+    target (e.g. ``attention_bias=True`` q/k/v biases, extra heads) —
+    silently dropping weights would serve a wrong model.  Pass False to
+    convert best-effort anyway.
+
+    param_dtype: numpy-compatible dtype for the converted weight leaves
+    (e.g. ``jnp.bfloat16`` for serving — halves host RAM vs the float32
+    default; norm scales stay f32 regardless).
+    """
+    conv = _CONVERTERS.get(type(cfg))
+    if conv is None:
+        raise ValueError(
+            f'no HF converter for {type(cfg).__name__}; supported: '
+            f'{[c.__name__ for c in _CONVERTERS]}')
+    sd = _SD(state_dict)
+    token = _PARAM_DTYPE.set(param_dtype)
+    try:
+        params = conv(cfg, sd)
+    finally:
+        _PARAM_DTYPE.reset(token)
+    # GPT-2 is always weight-tied (no config field); BERT ties its MLM
+    # decoder to the word embeddings but the decoder weight IS converted.
+    tied = (isinstance(cfg, GPT2Config) or
+            bool(getattr(cfg, 'tie_embeddings', False)))
+    leftover = [
+        k for k in sd.unused()
+        if not k.endswith(_IGNORABLE_SUFFIXES)
+        and not (tied and k.endswith('lm_head.weight'))  # shared storage
+    ]
+    if leftover and strict:
+        raise ValueError(
+            f'checkpoint weights with no converter target (would be '
+            f'silently dropped): {leftover[:8]}'
+            f'{" ..." if len(leftover) > 8 else ""}; pass strict=False '
+            f'to convert anyway')
+    return params
+
+
+# -------------------------------------------------------- config bridging
+
+
+def config_from_hf(hf_config, name: Optional[str] = None):
+    """Map a transformers config object to the matching framework config."""
+    mt = getattr(hf_config, 'model_type', None)
+    name = name or f'hf-{mt}'
+    if mt == 'llama':
+        scaling_kw = {}
+        rs = getattr(hf_config, 'rope_scaling', None)
+        rope_type = rs.get('rope_type', rs.get('type')) if rs else None
+        if rope_type == 'default':   # HF 'default' == unscaled RoPE
+            rs = None
+        if rs:
+            if rope_type != 'llama3':
+                raise ValueError(
+                    f'unsupported rope_scaling type {rope_type!r} (only '
+                    f"'llama3' frequency scaling is implemented); refusing "
+                    'to load with wrong RoPE frequencies')
+            scaling_kw = dict(
+                rope_scaling_factor=float(rs['factor']),
+                rope_scaling_low_freq=float(rs['low_freq_factor']),
+                rope_scaling_high_freq=float(rs['high_freq_factor']),
+                rope_scaling_original_max_len=int(
+                    rs['original_max_position_embeddings']))
+        return LlamaConfig(
+            name=name, vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            head_dim=getattr(hf_config, 'head_dim', None),
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=getattr(hf_config, 'rope_theta', 10000.0),
+            norm_eps=hf_config.rms_norm_eps,
+            tie_embeddings=getattr(hf_config, 'tie_word_embeddings', False),
+            **scaling_kw)
+    if mt == 'gpt2':
+        return GPT2Config(
+            name=name, vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd, num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head, max_seq_len=hf_config.n_positions,
+            norm_eps=hf_config.layer_norm_epsilon)
+    if mt == 'mixtral':
+        return MixtralConfig(
+            name=name, vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            num_experts=hf_config.num_local_experts,
+            experts_per_token=hf_config.num_experts_per_tok,
+            # No dropped tokens: exact parity with HF's unlimited-capacity
+            # routing (see module docstring).
+            capacity_factor=float(hf_config.num_local_experts) /
+            hf_config.num_experts_per_tok,
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=getattr(hf_config, 'rope_theta', 1e6),
+            norm_eps=hf_config.rms_norm_eps,
+            router_aux_loss_weight=getattr(hf_config,
+                                           'router_aux_loss_coef', 0.02),
+            tie_embeddings=getattr(hf_config, 'tie_word_embeddings', False))
+    if mt == 'bert':
+        return BertConfig(
+            name=name, vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            type_vocab_size=hf_config.type_vocab_size,
+            norm_eps=hf_config.layer_norm_eps)
+    raise ValueError(f'unsupported HF model_type: {mt!r}')
+
+
+def load_hf_model(name_or_path: str, dtype=None,
+                  param_dtype: Optional[Any] = None
+                  ) -> Tuple[Any, Dict[str, Any]]:
+    """Load a transformers checkpoint (local path or warm cache) and
+    return ``(framework_config, params)``.
+
+    dtype: the framework config's compute dtype; param_dtype: the dtype
+    the converted weights are stored in (see convert_state_dict).
+
+    No egress in this environment: pass a local snapshot directory, or a
+    model id already present in the HF cache.
+    """
+    import transformers
+    hf_cfg = transformers.AutoConfig.from_pretrained(name_or_path)
+    mt = getattr(hf_cfg, 'model_type', None)
+    cls = (transformers.AutoModelForMaskedLM if mt == 'bert'
+           else transformers.AutoModelForCausalLM)
+    # torch_dtype='auto' keeps the checkpoint's stored precision (bf16 for
+    # modern llamas — half the host RAM of the fp32 default);
+    # low_cpu_mem_usage avoids a second full-size init allocation.
+    model = cls.from_pretrained(name_or_path, torch_dtype='auto',
+                                low_cpu_mem_usage=True)
+    cfg = config_from_hf(hf_cfg, name=name_or_path)
+    if dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    params = convert_state_dict(cfg, model.state_dict(),
+                                param_dtype=param_dtype)
+    del model  # free the torch copy before the caller device-puts params
+    return cfg, params

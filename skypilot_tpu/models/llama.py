@@ -14,7 +14,7 @@ Role parity: the workload layer of the reference's llm/ recipes
 docs/source/reference/tpu.rst:121) rebuilt natively.
 """
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -35,6 +35,13 @@ class LlamaConfig:
     head_dim: Optional[int] = None
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
+    # Llama-3.1-style RoPE frequency scaling for long context (HF
+    # rope_scaling with rope_type='llama3').  Enabled when factor and
+    # original_max_len are both set.
+    rope_scaling_factor: Optional[float] = None
+    rope_scaling_low_freq: float = 1.0
+    rope_scaling_high_freq: float = 4.0
+    rope_scaling_original_max_len: Optional[int] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: jnp.dtype = jnp.bfloat16
@@ -47,6 +54,16 @@ class LlamaConfig:
     @property
     def head_dim_(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def rope_scaling_(self) -> Optional[Tuple[float, float, float, int]]:
+        """(factor, low_freq, high_freq, original_max_len) or None."""
+        if (self.rope_scaling_factor is None or
+                self.rope_scaling_original_max_len is None):
+            return None
+        return (self.rope_scaling_factor, self.rope_scaling_low_freq,
+                self.rope_scaling_high_freq,
+                self.rope_scaling_original_max_len)
 
     @property
     def num_params(self) -> int:
@@ -85,11 +102,35 @@ class RMSNorm(nn.Module):
         return rmsnorm(x, weight, self.eps)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def rope_frequencies(head_dim: int, theta: float,
+                     scaling: Optional[Tuple[float, float, float, int]] = None
+                     ) -> jax.Array:
+    """Inverse RoPE frequencies [head_dim//2], with optional Llama-3.1
+    scaling: low-frequency (long-wavelength) components are slowed by
+    `factor`, high-frequency ones kept, with a smooth ramp between — the
+    published long-context extension (HF rope_type='llama3')."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if scaling is None:
+        return freqs
+    factor, low_f, high_f, orig_len = scaling
+    wavelen = 2.0 * jnp.pi / freqs
+    low_wavelen = orig_len / low_f
+    high_wavelen = orig_len / high_f
+    smooth = (orig_len / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1.0 - smooth) * freqs / factor + smooth * freqs
+    scaled = jnp.where(wavelen > low_wavelen, freqs / factor, freqs)
+    is_medium = (wavelen >= high_wavelen) & (wavelen <= low_wavelen)
+    return jnp.where(is_medium, smoothed, scaled)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         scaling: Optional[Tuple[float, float, float, int]] = None
+         ) -> jax.Array:
     """Rotary embeddings. x: [B, H, S, D]; positions: [B, S]."""
     d = x.shape[-1]
     half = d // 2
-    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    freqs = rope_frequencies(d, theta, scaling)
     angles = positions[:, None, :, None].astype(jnp.float32) * freqs
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = x[..., :half], x[..., half:]
@@ -147,8 +188,8 @@ class Attention(nn.Module):
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling_)
+        k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling_)
         new_cache = None
         if kv_cache is not None:
             # Incremental decode/prefill: write the (roped) new K/V rows

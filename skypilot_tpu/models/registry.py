@@ -34,11 +34,19 @@ _register(
     LlamaConfig(name='llama2-7b', vocab_size=32000, hidden_size=4096,
                 intermediate_size=11008, num_layers=32, num_heads=32,
                 num_kv_heads=32, max_seq_len=4096))
-# Llama 3 8B / 3.1 8B (the headline training metric).
+# Llama 3 8B (the headline training metric).
 _register(
     LlamaConfig(name='llama3-8b', vocab_size=128256, hidden_size=4096,
                 intermediate_size=14336, num_layers=32, num_heads=32,
                 num_kv_heads=8, max_seq_len=8192, rope_theta=500000.0))
+# Llama 3.1 8B: long context via llama3 RoPE frequency scaling.
+_register(
+    LlamaConfig(name='llama3.1-8b', vocab_size=128256, hidden_size=4096,
+                intermediate_size=14336, num_layers=32, num_heads=32,
+                num_kv_heads=8, max_seq_len=131072, rope_theta=500000.0,
+                rope_scaling_factor=8.0, rope_scaling_low_freq=1.0,
+                rope_scaling_high_freq=4.0,
+                rope_scaling_original_max_len=8192))
 # ~1.1B config (TinyLlama-class): the graft-entry flagship forward model.
 _register(
     LlamaConfig(name='llama-1b', vocab_size=32000, hidden_size=2048,

@@ -1,0 +1,260 @@
+"""HF checkpoint import: converted weights must reproduce transformers
+logits exactly (f32, CPU) for every supported family.
+
+This is the strongest possible test of the layout conversion — a wrong
+transpose/reshape/reparam anywhere moves the logits.
+"""
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip('torch')
+transformers = pytest.importorskip('transformers')
+
+from skypilot_tpu.models import hf_import  # noqa: E402
+
+
+def _assert_close(ours, theirs, atol=2e-3):
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=atol,
+                               rtol=1e-3)
+
+
+def _tokens(vocab, shape=(2, 12), seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, vocab, size=shape).astype(np.int64)
+
+
+def test_llama_logit_parity():
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = hf_import.config_from_hf(hf_cfg, name='tiny')
+    assert cfg.num_kv_heads == 2 and not cfg.tie_embeddings
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.models.llama import Llama
+    tokens = _tokens(128)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    got = Llama(cfg).apply({'params': params}, jnp.asarray(tokens))
+    _assert_close(got, want)
+
+
+def test_gpt2_logit_parity():
+    torch.manual_seed(0)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    cfg = hf_import.config_from_hf(hf_cfg, name='tiny')
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.models.gpt2 import GPT2
+    tokens = _tokens(128)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    got = GPT2(cfg).apply({'params': params}, jnp.asarray(tokens))
+    _assert_close(got, want)
+
+
+def test_mixtral_logit_parity():
+    torch.manual_seed(0)
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+
+    cfg = hf_import.config_from_hf(hf_cfg, name='tiny')
+    # config_from_hf must pick the no-token-dropping capacity.
+    assert cfg.capacity_factor == pytest.approx(2.0)
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.models.mixtral import Mixtral
+    tokens = _tokens(128)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    got = Mixtral(cfg).apply({'params': params}, jnp.asarray(tokens))
+    _assert_close(got, want)
+
+
+def test_bert_mlm_logit_parity():
+    torch.manual_seed(0)
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, type_vocab_size=2)
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+
+    cfg = hf_import.config_from_hf(hf_cfg, name='tiny')
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.models.bert import BertForMaskedLM
+    tokens = _tokens(128, shape=(2, 16))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    got = BertForMaskedLM(cfg).apply({'params': params},
+                                     jnp.asarray(tokens))
+    _assert_close(got, want)
+
+
+def test_llama_generation_through_engine_cache_path():
+    """Converted weights must also agree on the incremental-decode path
+    (rope positions + cache insert), not just teacher-forced scoring."""
+    torch.manual_seed(1)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32, tie_word_embeddings=True)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = dataclasses.replace(hf_import.config_from_hf(hf_cfg, name='t'),
+                              dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    prompt = _tokens(64, shape=(1, 8), seed=3)
+    with torch.no_grad():
+        want = hf.generate(torch.from_numpy(prompt), max_new_tokens=6,
+                           do_sample=False).numpy()[0, 8:]
+
+    from skypilot_tpu.models.llama import Llama, init_cache
+    model = Llama(cfg)
+    cache = init_cache(cfg, 1, 32, dtype=jnp.float32)
+    toks = jnp.asarray(prompt)
+    positions = jnp.arange(8)[None]
+    logits, cache = model.apply({'params': params}, toks, positions, cache)
+    out = []
+    last = jnp.argmax(logits[:, -1], -1)
+    for step in range(6):
+        out.append(int(last[0]))
+        pos = jnp.array([[8 + step]])
+        logits, cache = model.apply({'params': params}, last[:, None],
+                                    pos, cache)
+        last = jnp.argmax(logits[:, -1], -1)
+    assert out == list(want), (out, list(want))
+
+
+def test_converted_weights_through_inference_engine():
+    """The serving path: converted HF weights via InferenceEngine(params=)
+    must produce HF's greedy continuation."""
+    torch.manual_seed(2)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, tie_word_embeddings=True)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = dataclasses.replace(hf_import.config_from_hf(hf_cfg, name='t'),
+                              dtype=jnp.float32)
+    tree = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.infer import InferConfig, InferenceEngine, Request
+    engine = InferenceEngine(
+        cfg,
+        InferConfig(model='t', num_slots=2, max_cache_len=32,
+                    prefill_buckets=(16,), max_new_tokens=6,
+                    cache_dtype=jnp.float32, decode_steps=2),
+        params={'params': tree})
+    prompt = _tokens(64, shape=(1, 8), seed=5)[0].tolist()
+    with torch.no_grad():
+        want = hf.generate(torch.tensor([prompt]), max_new_tokens=6,
+                           do_sample=False).numpy()[0, 8:]
+    [res] = engine.generate([Request(tokens=prompt, max_new_tokens=6)])
+    assert res.output_tokens == list(want), (res.output_tokens, list(want))
+
+
+def test_llama31_rope_scaling_logit_parity():
+    """rope_scaling rope_type='llama3' must match HF's scaled frequencies
+    (positions past original_max_position_embeddings are the regime the
+    scaling changes most, so score a long sequence)."""
+    torch.manual_seed(4)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        rope_theta=10000.0,
+        rope_scaling={'rope_type': 'llama3', 'factor': 4.0,
+                      'low_freq_factor': 1.0, 'high_freq_factor': 4.0,
+                      'original_max_position_embeddings': 16})
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    cfg = hf_import.config_from_hf(hf_cfg, name='tiny31')
+    assert cfg.rope_scaling_ == (4.0, 1.0, 4.0, 16)
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.models.llama import Llama
+    tokens = _tokens(64, shape=(1, 48), seed=7)   # 3x the original window
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    got = Llama(cfg).apply({'params': params}, jnp.asarray(tokens))
+    _assert_close(got, want)
+
+
+def test_unsupported_rope_scaling_rejected():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        rope_scaling={'rope_type': 'linear', 'factor': 2.0})
+    with pytest.raises(ValueError, match='rope_scaling'):
+        hf_import.config_from_hf(hf_cfg)
+
+
+def test_unconverted_weights_rejected():
+    """Weights with no converter target (attention biases) must raise
+    rather than be silently dropped."""
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        attention_bias=True)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    cfg = hf_import.config_from_hf(hf_cfg)
+    with pytest.raises(ValueError, match='no converter target'):
+        hf_import.convert_state_dict(cfg, hf.state_dict())
+    # strict=False converts best-effort.
+    params = hf_import.convert_state_dict(cfg, hf.state_dict(),
+                                          strict=False)
+    assert 'layer_0' in params
+
+
+def test_param_dtype_bf16_conversion():
+    """Serving path: weights convert to bf16 leaves; norm scales stay f32
+    (the '+1' reparam subtraction must not round)."""
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        tie_word_embeddings=True)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    cfg = hf_import.config_from_hf(hf_cfg)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict(),
+                                          param_dtype=jnp.bfloat16)
+    assert params['embedding'].dtype == jnp.bfloat16
+    assert params['layer_0']['mlp']['gate_proj']['kernel'].dtype == \
+        jnp.bfloat16
+    assert params['final_norm']['scale'].dtype == np.float32
+
+
+def test_default_rope_type_is_no_scaling():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        rope_scaling={'rope_type': 'default'})
+    cfg = hf_import.config_from_hf(hf_cfg)
+    assert cfg.rope_scaling_ is None
+
+
+def test_unknown_config_rejected():
+    with pytest.raises(ValueError, match='no HF converter'):
+        hf_import.convert_state_dict(object(), {})
