@@ -4,7 +4,10 @@ The reference delegates all parallelism to workload recipes over NCCL
 (SURVEY.md §2.9); here it is a first-class subsystem: jax.sharding over an
 ICI/DCN-aware Mesh, with XLA emitting the collectives.
 """
-from skypilot_tpu.parallel.mesh import (MeshSpec, make_mesh,
-                                        logical_axis_rules, mesh_context)
+from skypilot_tpu.parallel.mesh import (MeshSpec,
+                                        initialize_distributed_from_env,
+                                        make_mesh, logical_axis_rules,
+                                        mesh_context)
 
-__all__ = ['MeshSpec', 'make_mesh', 'logical_axis_rules', 'mesh_context']
+__all__ = ['MeshSpec', 'initialize_distributed_from_env', 'make_mesh',
+           'logical_axis_rules', 'mesh_context']
