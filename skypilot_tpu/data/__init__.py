@@ -1,1 +1,3 @@
-"""Data/storage layer (parity: sky/data/)."""
+"""Data/storage layer (parity: sky/data/), plus the token-corpus loading
+subsystem (loader.py — beyond the reference, which delegates data loading
+to each recipe)."""
