@@ -466,11 +466,15 @@ def load_hf_model(name_or_path: str, dtype=None,
     mt = getattr(hf_cfg, 'model_type', None)
     cls = (transformers.AutoModelForMaskedLM if mt == 'bert'
            else transformers.AutoModelForCausalLM)
-    # torch_dtype='auto' keeps the checkpoint's stored precision (bf16 for
+    # dtype='auto' keeps the checkpoint's stored precision (bf16 for
     # modern llamas — half the host RAM of the fp32 default);
     # low_cpu_mem_usage avoids a second full-size init allocation.
-    model = cls.from_pretrained(name_or_path, torch_dtype='auto',
-                                low_cpu_mem_usage=True)
+    try:
+        model = cls.from_pretrained(name_or_path, dtype='auto',
+                                    low_cpu_mem_usage=True)
+    except TypeError:   # transformers < the torch_dtype→dtype rename
+        model = cls.from_pretrained(name_or_path, torch_dtype='auto',
+                                    low_cpu_mem_usage=True)
     cfg = config_from_hf(hf_cfg, name=name_or_path)
     if dtype is not None:
         cfg = dataclasses.replace(cfg, dtype=dtype)

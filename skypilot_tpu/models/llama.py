@@ -45,6 +45,13 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    # LoRA adapters (train/lora.py): rank 0 disables.  Targets name the
+    # projections that get a sibling '<name>_lora' adapter; the base
+    # param tree is unchanged, so checkpoints/HF import are unaffected.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ('q_proj', 'k_proj', 'v_proj',
+                                     'o_proj')
     # Rematerialization policy for decoder blocks: 'full' saves nothing
     # (min HBM, max recompute), 'dots' saves matmul outputs and recomputes
     # elementwise ops (the usual best FLOPs/HBM trade when memory allows),
@@ -166,6 +173,29 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return out.reshape(b, hq, t, d).astype(q.dtype)
 
 
+def _proj(cfg: LlamaConfig, name: str, feats, axes, *, axis=-1,
+          init_std: float = 0.02):
+    """A named projection: DenseGeneral plus, when `name` is a configured
+    LoRA target, a sibling '<name>_lora' adapter added to its output.
+    Must be called from inside the owning module's @nn.compact __call__
+    (both submodules register as its children).  The single wiring point
+    for every adapted projection in the family."""
+    base = nn.DenseGeneral(
+        feats, axis=axis, use_bias=False, dtype=cfg.dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(init_std), axes),
+        name=name)
+    if not (cfg.lora_rank and name in cfg.lora_targets):
+        return base
+    from skypilot_tpu.train.lora import LoRAAdapter
+    adapter = LoRAAdapter(
+        features=feats if isinstance(feats, tuple) else (feats,),
+        rank=cfg.lora_rank, alpha=cfg.lora_alpha,
+        num_contract_dims=len(axis) if isinstance(axis, tuple) else 1,
+        dtype=cfg.dtype, name=f'{name}_lora')
+    return lambda inp: base(inp) + adapter(inp)
+
+
 class Attention(nn.Module):
     config: LlamaConfig
 
@@ -173,17 +203,13 @@ class Attention(nn.Module):
     def __call__(self, x, positions, kv_cache=None):
         cfg = self.config
         d = cfg.head_dim_
-        dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
-            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), axes),
-            name=name)
-        q = dense((cfg.num_heads, d), ('embed', 'heads', 'qkv_embed'),
-                  'q_proj')(x)
-        k = dense((cfg.num_kv_heads, d), ('embed', 'kv_heads', 'qkv_embed'),
-                  'k_proj')(x)
-        v = dense((cfg.num_kv_heads, d), ('embed', 'kv_heads', 'qkv_embed'),
-                  'v_proj')(x)
+
+        q = _proj(cfg, 'q_proj', (cfg.num_heads, d),
+                  ('embed', 'heads', 'qkv_embed'))(x)
+        k = _proj(cfg, 'k_proj', (cfg.num_kv_heads, d),
+                  ('embed', 'kv_heads', 'qkv_embed'))(x)
+        v = _proj(cfg, 'v_proj', (cfg.num_kv_heads, d),
+                  ('embed', 'kv_heads', 'qkv_embed'))(x)
         # [B, S, H, D] -> [B, H, S, D]
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))
@@ -222,15 +248,11 @@ class Attention(nn.Module):
             # (ops/ring_attention.py); otherwise plain (pallas) flash.
             out = sequence_parallel_attention(q, k, v, causal=True)
         out = jnp.transpose(out, (0, 2, 1, 3))  # [B, S, H, D]
-        out = nn.DenseGeneral(
-            cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
-            # Depth-scaled init on the residual-branch output (GPT-2 style):
-            # std 0.02/sqrt(2L) keeps residual variance bounded with depth.
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(
-                    0.02 / (2 * cfg.num_layers) ** 0.5),
-                ('heads', 'qkv_embed', 'embed')),
-            name='o_proj')(out)
+        # Depth-scaled init on the residual-branch output (GPT-2 style):
+        # std 0.02/sqrt(2L) keeps residual variance bounded with depth.
+        out = _proj(cfg, 'o_proj', cfg.hidden_size,
+                    ('heads', 'qkv_embed', 'embed'), axis=(-2, -1),
+                    init_std=0.02 / (2 * cfg.num_layers) ** 0.5)(out)
         if kv_cache is not None:
             return out, new_cache
         return out
@@ -242,24 +264,15 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        gate = nn.DenseGeneral(
-            cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ('embed', 'mlp')),
-            name='gate_proj')(x)
-        up = nn.DenseGeneral(
-            cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ('embed', 'mlp')),
-            name='up_proj')(x)
+        gate = _proj(cfg, 'gate_proj', cfg.intermediate_size,
+                     ('embed', 'mlp'))(x)
+        up = _proj(cfg, 'up_proj', cfg.intermediate_size,
+                   ('embed', 'mlp'))(x)
         h = nn.silu(gate) * up
         h = nn.with_logical_constraint(
             h, ('activation_batch', 'activation_seq', 'activation_mlp'))
-        return nn.DenseGeneral(
-            cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ('mlp', 'embed')),
-            name='down_proj')(h)
+        return _proj(cfg, 'down_proj', cfg.hidden_size,
+                     ('mlp', 'embed'))(h)
 
 
 class DecoderLayer(nn.Module):

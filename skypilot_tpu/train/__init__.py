@@ -1,7 +1,8 @@
-"""Training engine: sharded train step, data, checkpointing."""
+"""Training engine: sharded train step, data, checkpointing, LoRA."""
+from skypilot_tpu.train import lora
 from skypilot_tpu.train.trainer import (Trainer, TrainConfig,
                                         create_sharded_state,
                                         make_train_step)
 
 __all__ = ['Trainer', 'TrainConfig', 'create_sharded_state',
-           'make_train_step']
+           'make_train_step', 'lora']

@@ -1,0 +1,110 @@
+"""LoRA: low-rank adapters for parameter-efficient finetuning.
+
+Role parity: the reference's headline finetuning recipe is LoRA on
+Llama-3.1 (llm/llama-3_1-finetuning/lora.yaml, delegated to torchtune on
+provisioned VMs); here adapters are native to the model stack.
+
+Design (TPU-first):
+- An adapter is a sibling module of its base projection
+  (``q_proj`` + ``q_proj_lora``) computing
+  ``y = base(x) + (alpha/rank) * (x·A)·B`` with A ~ N(0, 0.02), B = 0 —
+  the delta starts at exactly zero, so a LoRA model with grafted base
+  weights reproduces the base model's logits bit-for-bit at init.
+- The BASE param tree is unchanged (same names/shapes), so HF checkpoint
+  import, orbax checkpoints, and the serving path all work untouched;
+  ``merge_base_params`` grafts a base tree into a LoRA-enabled state.
+- Training freezes everything except ``*_lora`` leaves via
+  optax.multi_transform: frozen params carry NO Adam moments — optimizer
+  state for an 8B base drops from ~2x params to ~2x adapter size.
+- Adapter matmuls are two skinny GEMMs fused by XLA into the surrounding
+  computation; adapters are replicated (tiny), activations inherit the
+  base output's sharding.
+"""
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LoRAAdapter(nn.Module):
+    """Low-rank delta for a DenseGeneral: contracts the same input axes,
+    produces the same output feature dims."""
+    features: Tuple[int, ...]      # output feature dims of the base proj
+    rank: int
+    alpha: float
+    num_contract_dims: int = 1     # trailing input dims to contract
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.num_contract_dims
+        batch_shape = x.shape[:-k]
+        in_dim = int(np.prod(x.shape[-k:]))
+        out_dim = int(np.prod(self.features))
+        xf = x.reshape(*batch_shape, in_dim)
+        a = self.param(
+            'lora_a',
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         (None, None)),
+            (in_dim, self.rank))
+        b = self.param(
+            'lora_b',
+            nn.with_logical_partitioning(nn.initializers.zeros,
+                                         (None, None)),
+            (self.rank, out_dim))
+        y = (xf.astype(self.dtype) @ a.astype(self.dtype)) \
+            @ b.astype(self.dtype)
+        y = y * (self.alpha / self.rank)
+        return y.reshape(*batch_shape, *self.features)
+
+
+def is_lora_path(path) -> bool:
+    """True if a param-tree path belongs to an adapter (module name ends
+    with '_lora').  Accepts jax key paths (DictKey) AND flattened string
+    tuples (flax traverse_util)."""
+    return any(
+        str(getattr(k, 'key', k)).endswith('_lora') for k in path)
+
+
+def lora_label_tree(params):
+    """'train' on adapter leaves, 'freeze' elsewhere (optax labels)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: 'train' if is_lora_path(path) else 'freeze',
+        params)
+
+
+def merge_base_params(state_params, base_params):
+    """Graft a base (non-LoRA) param tree into a LoRA-enabled tree:
+    every base leaf replaces its same-named counterpart (placed onto the
+    existing leaf's sharding); adapter leaves keep their init."""
+
+    def merge(tree, base):
+        out = dict(tree)
+        for key, val in base.items():
+            if key not in tree:
+                raise KeyError(f'base param {key!r} missing from the '
+                               'LoRA model tree')
+            if isinstance(val, dict):
+                out[key] = merge(tree[key], val)
+            else:
+                leaf = tree[key]
+                sharding = getattr(leaf, 'sharding', None)
+                # Keep the value on HOST until device_put places it onto
+                # the target sharding directly: no transient full-size
+                # device copy, and each process supplies only its
+                # addressable shards on multi-host meshes.
+                arr = np.asarray(val).astype(leaf.dtype)
+                out[key] = (jax.device_put(arr, sharding)
+                            if sharding is not None else jnp.asarray(arr))
+        return out
+
+    return merge(state_params, base_params)
+
+
+def num_adapter_params(params) -> int:
+    """Total adapter (trainable) parameter count."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return sum(int(np.prod(v.shape)) for path, v in leaves
+               if is_lora_path(path))

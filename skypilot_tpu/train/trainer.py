@@ -49,17 +49,28 @@ class TrainConfig:
     grad_accum_steps: int = 1
 
 
-def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(cfg: TrainConfig,
+                   model_config: Optional[Any] = None
+                   ) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=cfg.learning_rate,
         warmup_steps=cfg.warmup_steps,
         decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
         end_value=cfg.learning_rate * 0.1)
-    return optax.chain(
+    base = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
         optax.adamw(schedule, b1=0.9, b2=0.95, eps=1e-8,
                     weight_decay=cfg.weight_decay),
     )
+    if model_config is not None and getattr(model_config, 'lora_rank', 0):
+        # LoRA: only adapter leaves train; frozen params get set_to_zero
+        # (and thus carry NO Adam moments — the optimizer-state memory
+        # win is the point of parameter-efficient finetuning).
+        from skypilot_tpu.train import lora
+        return optax.multi_transform(
+            {'train': base, 'freeze': optax.set_to_zero()},
+            lora.lora_label_tree)
+    return base
 
 
 def create_sharded_state(
@@ -74,7 +85,7 @@ def create_sharded_state(
     params are *born sharded* — no single-host materialization.
     """
     model = model_registry.build_model(model_config)
-    tx = make_optimizer(train_cfg)
+    tx = make_optimizer(train_cfg, model_config)
     sample = jnp.zeros((1, train_cfg.seq_len), jnp.int32)
 
     def init_fn(rng):
@@ -170,7 +181,9 @@ def chunked_cross_entropy(hidden: jax.Array, proj: jax.Array,
 
 def make_train_step(mesh: jax.sharding.Mesh,
                     loss_chunk: Optional[int] = 128,
-                    grad_accum_steps: int = 1
+                    grad_accum_steps: int = 1,
+                    trainable: Optional[Callable[[Tuple[str, ...]], bool]]
+                    = None
                     ) -> Callable[[TrainState, Dict[str, jax.Array]],
                                   Tuple[TrainState, Dict[str, jax.Array]]]:
     """The jit'd train step: next-token loss, grads, adamw update.
@@ -185,7 +198,25 @@ def make_train_step(mesh: jax.sharding.Mesh,
     the single optimizer update — K-fold less activation memory for the
     same numerics (token-masked batches assume equal mask weight per
     microbatch, the standard approximation).
+
+    trainable: optional predicate on flattened param paths (tuples of
+    key strings).  When set (LoRA), only matching leaves are
+    differentiated — frozen params are closed over as constants, so the
+    backward pass computes and accumulates NO gradients for them (the
+    zero-filled frozen entries handed to the optimizer are
+    constant-folded by XLA).  grad_norm then measures trainable leaves
+    only.
     """
+    from flax import traverse_util
+
+    def split_params(params):
+        flat = traverse_util.flatten_dict(params)
+        tr = {k: v for k, v in flat.items() if trainable(k)}
+        fz = {k: v for k, v in flat.items() if not trainable(k)}
+        return tr, fz
+
+    def join_params(tr, fz):
+        return traverse_util.unflatten_dict({**fz, **tr})
 
     def make_loss_fn(state, inputs, targets, mask):
 
@@ -223,9 +254,19 @@ def make_train_step(mesh: jax.sharding.Mesh,
         if mask is not None:
             mask = mask[:, 1:]
 
+        if trainable is None:
+            diff_params, frozen = state.params, {}
+            to_full = lambda p: p                          # noqa: E731
+        else:
+            diff_params, frozen = split_params(state.params)
+            to_full = lambda tr: join_params(tr, frozen)   # noqa: E731
+
+        def diff_loss_fn(dp, mi, mt, mm):
+            return make_loss_fn(state, mi, mt, mm)(to_full(dp))
+
         if grad_accum_steps <= 1:
-            loss, grads = jax.value_and_grad(
-                make_loss_fn(state, inputs, targets, mask))(state.params)
+            loss, grads = jax.value_and_grad(diff_loss_fn)(
+                diff_params, inputs, targets, mask)
         else:
             b = inputs.shape[0]
             if b % grad_accum_steps:
@@ -240,13 +281,13 @@ def make_train_step(mesh: jax.sharding.Mesh,
             def micro(carry, xs):
                 acc_loss, acc_grads = carry
                 mi, mt, mm = xs
-                loss, grads = jax.value_and_grad(
-                    make_loss_fn(state, mi, mt, mm))(state.params)
+                loss, grads = jax.value_and_grad(diff_loss_fn)(
+                    diff_params, mi, mt, mm)
                 return (acc_loss + loss,
                         jax.tree.map(jnp.add, acc_grads, grads)), None
 
             zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                lambda p: jnp.zeros(p.shape, jnp.float32), diff_params)
             if mask is None:   # all-ones mask == unmasked mean loss
                 mask = jnp.ones((b, targets.shape[1]), jnp.float32)
             (loss, grads), _ = jax.lax.scan(
@@ -255,8 +296,14 @@ def make_train_step(mesh: jax.sharding.Mesh,
             loss = loss / k
             grads = jax.tree.map(lambda g: g / k, grads)
 
+        grad_norm = optax.global_norm(grads)   # trainable leaves only
+        if trainable is not None:
+            # Zero entries for frozen leaves: set_to_zero ignores the
+            # values and add(p, 0) folds away — XLA materializes nothing.
+            frozen_zeros = {k: jnp.zeros_like(v) for k, v in
+                            frozen.items()}
+            grads = join_params(grads, frozen_zeros)
         new_state = state.apply_gradients(grads=grads)
-        grad_norm = optax.global_norm(grads)
         return new_state, {'loss': loss, 'grad_norm': grad_norm}
 
     # The data sharding is given as a pytree PREFIX so it applies to every
@@ -325,9 +372,14 @@ class Trainer:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.state, self._shardings = create_sharded_state(
             self.model_config, self.cfg, self.mesh, rng)
+        trainable = None
+        if getattr(self.model_config, 'lora_rank', 0):
+            from skypilot_tpu.train import lora
+            trainable = lora.is_lora_path
         self._step_fn = make_train_step(
             self.mesh, loss_chunk=self.cfg.loss_chunk,
-            grad_accum_steps=self.cfg.grad_accum_steps)
+            grad_accum_steps=self.cfg.grad_accum_steps,
+            trainable=trainable)
         if self._ckpt_mgr is not None:
             self.maybe_restore()
 
