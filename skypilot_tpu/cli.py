@@ -467,6 +467,37 @@ def storage_delete(names, yes):
         click.echo(f'Storage {n!r} deleted.')
 
 
+@cli.group(cls=_NaturalOrderGroup)
+def data():
+    """Token-corpus tooling (data/loader.py)."""
+
+
+@data.command('tokenize')
+@click.argument('text_path')
+@click.argument('out_path')
+@click.option('--tokenizer', '-t', required=True,
+              help='HF tokenizer (name, local dir, or cached id).')
+@click.option('--no-eos', is_flag=True, default=False,
+              help="Don't append the tokenizer's EOS token.")
+def data_tokenize(text_path, out_path, tokenizer, no_eos):
+    """Tokenize a UTF-8 text file into a memmap-able token file."""
+    from skypilot_tpu.data import loader
+    n = loader.tokenize_text_file(text_path, out_path, tokenizer,
+                                  append_eos=not no_eos)
+    click.echo(f'{out_path}: {n} tokens')
+
+
+@data.command('inspect')
+@click.argument('path')
+def data_inspect(path):
+    """Token count / dtype / sequence capacity of a token file."""
+    from skypilot_tpu.data import loader
+    ds = loader.TokenDataset(path)
+    click.echo(f'{path}: {len(ds)} tokens, dtype {ds.tokens.dtype}')
+    for seq in (1024, 2048, 4096, 8192):
+        click.echo(f'  seq {seq}: {ds.num_sequences(seq)} sequences')
+
+
 # --------------------------------------------------------------- jobs group
 
 

@@ -38,6 +38,11 @@ class MixtralConfig:
     norm_eps: float = 1e-5
     router_aux_loss_weight: float = 0.02
     tie_embeddings: bool = False
+    # LoRA (attention projections only; experts stay frozen-dense).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ('q_proj', 'k_proj', 'v_proj',
+                                     'o_proj')
     dtype: jnp.dtype = jnp.bfloat16
 
     @property
@@ -45,7 +50,8 @@ class MixtralConfig:
         return self.hidden_size // self.num_heads
 
     def as_llama(self) -> LlamaConfig:
-        """Attention/norm hyperparams reused by the shared Llama blocks."""
+        """Attention/norm hyperparams reused by the shared Llama blocks
+        (LoRA fields forwarded: adapters on MoE attention projections)."""
         return LlamaConfig(
             name=self.name, vocab_size=self.vocab_size,
             hidden_size=self.hidden_size,
@@ -53,6 +59,8 @@ class MixtralConfig:
             num_layers=self.num_layers, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, max_seq_len=self.max_seq_len,
             rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            lora_targets=self.lora_targets,
             dtype=self.dtype)
 
     @property

@@ -2,7 +2,7 @@
 from skypilot_tpu.train import lora
 from skypilot_tpu.train.trainer import (Trainer, TrainConfig,
                                         create_sharded_state,
-                                        make_train_step)
+                                        make_eval_step, make_train_step)
 
 __all__ = ['Trainer', 'TrainConfig', 'create_sharded_state',
-           'make_train_step', 'lora']
+           'make_eval_step', 'make_train_step', 'lora']

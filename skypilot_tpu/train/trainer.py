@@ -316,6 +316,33 @@ def make_train_step(mesh: jax.sharding.Mesh,
     )
 
 
+def make_eval_step(mesh: jax.sharding.Mesh,
+                   loss_chunk: Optional[int] = 128
+                   ) -> Callable[[TrainState, Dict[str, jax.Array]],
+                                 jax.Array]:
+    """Loss-only forward (no grads, no state update) for held-out
+    evaluation; same fused-loss path as training."""
+
+    def eval_step(state: TrainState, batch: Dict[str, jax.Array]):
+        tokens = batch['tokens']
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = batch.get('mask')
+        if mask is not None:
+            mask = mask[:, 1:]
+        if loss_chunk:
+            hidden = state.apply_fn({'params': state.params}, inputs,
+                                    hidden_only=True)
+            return chunked_cross_entropy(hidden,
+                                         output_projection(state.params),
+                                         targets, mask,
+                                         chunk_t=loss_chunk)
+        logits = state.apply_fn({'params': state.params}, inputs)
+        return cross_entropy_loss(logits, targets, mask)
+
+    data_sharding = mesh_lib.named_sharding(mesh, 'batch', None)
+    return jax.jit(eval_step, in_shardings=(None, data_sharding))
+
+
 def synthetic_data(batch_size: int, seq_len: int, vocab_size: int,
                    seed: int = 0) -> Iterator[Dict[str, jax.Array]]:
     """Deterministic synthetic token stream (benchmarks + tests)."""
@@ -360,6 +387,7 @@ class Trainer:
         self.mesh = mesh_lib.make_mesh(spec)
         self.state: Optional[TrainState] = None
         self._step_fn = None
+        self._eval_fn = None
         self._ckpt_mgr = None
         if cfg.checkpoint_dir:
             import orbax.checkpoint as ocp
@@ -405,6 +433,29 @@ class Trainer:
         checkpoint — the one preemption recovery needs most."""
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait_until_finished()
+
+    def evaluate(self, data: Iterator,
+                 num_batches: int = 50) -> Dict[str, float]:
+        """Mean held-out loss + perplexity over num_batches."""
+        if self.state is None:
+            self.setup()
+        if self._eval_fn is None:
+            self._eval_fn = make_eval_step(self.mesh,
+                                           loss_chunk=self.cfg.loss_chunk)
+        losses = []
+        with self.mesh:
+            for _ in range(num_batches):
+                try:
+                    batch = next(data)
+                except StopIteration:   # short iterator: use what we got
+                    break
+                losses.append(float(self._eval_fn(self.state, batch)))
+        mean = sum(losses) / max(len(losses), 1)
+        return {
+            'eval_loss': mean,
+            'perplexity': float(jnp.exp(jnp.asarray(mean))),
+            'batches': len(losses),
+        }
 
     def train(self, data: Optional[Iterator] = None,
               num_steps: Optional[int] = None,

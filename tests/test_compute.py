@@ -187,3 +187,34 @@ def test_grad_accum_divisibility_error():
     with pytest.raises(ValueError, match='divisible'):
         with mesh:
             step(state, batch)
+
+
+def test_eval_step_matches_train_loss_path():
+    """make_eval_step must produce the same loss as the train step's
+    forward on identical params/batch (and change no state)."""
+    from skypilot_tpu.train import make_eval_step
+    cfg = get_model_config('llama-debug')
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32)
+    state, _ = create_sharded_state(cfg, tcfg, mesh, jax.random.PRNGKey(0))
+    batch = next(synthetic_data(8, 32, cfg.vocab_size))
+    eval_fn = make_eval_step(mesh)
+    step = make_train_step(mesh)
+    with mesh:
+        eval_loss = float(eval_fn(state, batch))
+        _, metrics = step(state, batch)
+    np.testing.assert_allclose(eval_loss, float(metrics['loss']),
+                               rtol=1e-5)
+
+
+def test_trainer_evaluate_reports_perplexity():
+    from skypilot_tpu.train.trainer import Trainer
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32)
+    t = Trainer(tcfg)
+    t.setup()
+    cfg = get_model_config('llama-debug')
+    out = t.evaluate(synthetic_data(8, 32, cfg.vocab_size), num_batches=2)
+    assert out['batches'] == 2
+    assert np.isfinite(out['eval_loss'])
+    np.testing.assert_allclose(out['perplexity'],
+                               np.exp(out['eval_loss']), rtol=1e-5)

@@ -127,3 +127,35 @@ def test_default_shard_is_current_process(token_file, monkeypatch):
                                                   count=2)))['tokens']
     assert got.shape == (4, 17)   # local rows only, not the global batch
     np.testing.assert_array_equal(got, want)
+
+
+def test_cli_data_inspect_and_tokenize(tmp_path, token_file):
+    from click.testing import CliRunner
+    from skypilot_tpu.cli import cli
+    path, _ = token_file
+    r = CliRunner().invoke(cli, ['data', 'inspect', path])
+    assert r.exit_code == 0, r.output
+    assert '1000 tokens' in r.output
+
+    transformers = pytest.importorskip('transformers')
+    tokenizers = pytest.importorskip('tokenizers')
+    tok = tokenizers.Tokenizer(tokenizers.models.BPE(
+        vocab={chr(i): i for i in range(256)}, merges=[]))
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.ByteLevel(
+        add_prefix_space=False)
+    tok_dir = str(tmp_path / 'tok')
+    transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tok, eos_token=chr(0)).save_pretrained(tok_dir)
+    text = tmp_path / 'c.txt'
+    text.write_text('abc ' * 100)
+    out = str(tmp_path / 'c.bin')
+    r = CliRunner().invoke(cli, ['data', 'tokenize', str(text), out,
+                                 '-t', tok_dir])
+    assert r.exit_code == 0, r.output
+    n_eos = int(r.output.split(':')[-1].split()[0])
+    r = CliRunner().invoke(cli, ['data', 'tokenize', str(text),
+                                 out + '2', '-t', tok_dir, '--no-eos'])
+    assert r.exit_code == 0, r.output
+    n_plain = int(r.output.split(':')[-1].split()[0])
+    assert n_eos == n_plain + 1   # --no-eos drops exactly the EOS token
+    assert len(loader.TokenDataset(out)) == n_eos

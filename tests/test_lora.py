@@ -120,3 +120,64 @@ def test_decode_path_works_with_lora():
     np.testing.assert_allclose(np.asarray(full[:, -1]),
                                np.asarray(logits[:, -1]), atol=2e-3,
                                rtol=1e-3)
+
+
+def test_mixtral_lora_forwards_to_attention():
+    from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+    cfg = MixtralConfig(name='moe-lora', vocab_size=64, hidden_size=32,
+                        intermediate_size=64, num_layers=1, num_heads=4,
+                        num_kv_heads=2, num_experts=2,
+                        experts_per_token=1, max_seq_len=32,
+                        tie_embeddings=True, lora_rank=4)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = nn.meta.unbox(
+        Mixtral(cfg).init(jax.random.PRNGKey(0), tokens)['params'])
+    assert 'q_proj_lora' in params['layer_0']['attn']
+    assert lora.num_adapter_params(params) > 0
+
+
+def test_subtree_gradient_path_matches_optimizer_masking():
+    """The production LoRA path (make_train_step(trainable=is_lora_path),
+    what Trainer.setup wires) must behave like the optimizer-mask-only
+    path: identical loss, adapters move, frozen params don't."""
+    _, lora_cfg = _cfgs()
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32,
+                       learning_rate=1e-2, warmup_steps=1)
+    batch = next(synthetic_data(8, 32, lora_cfg.vocab_size))
+
+    def run(trainable, accum):
+        state, _ = create_sharded_state(lora_cfg, tcfg, mesh,
+                                        jax.random.PRNGKey(0))
+        step = make_train_step(mesh, grad_accum_steps=accum,
+                               trainable=trainable)
+        with mesh:
+            return step(state, batch)
+
+    s_mask, m_mask = run(None, 1)
+    s_sub, m_sub = run(lora.is_lora_path, 1)
+    s_sub2, m_sub2 = run(lora.is_lora_path, 2)   # + the scan variant
+    np.testing.assert_allclose(float(m_mask['loss']),
+                               float(m_sub['loss']), rtol=1e-5)
+    np.testing.assert_allclose(float(m_sub['loss']),
+                               float(m_sub2['loss']), rtol=1e-5)
+    flat = lambda s: {  # noqa: E731
+        jax.tree_util.keystr(p): np.asarray(v)
+        for p, v in jax.tree_util.tree_flatten_with_path(s.params)[0]
+    }
+    a, b = flat(s_mask), flat(s_sub)
+    for key in a:
+        np.testing.assert_allclose(a[key], b[key], atol=1e-6,
+                                   err_msg=key)
+
+
+def test_trainer_evaluate_short_iterator():
+    from skypilot_tpu.train.trainer import Trainer, synthetic_data
+    import itertools
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32)
+    t = Trainer(tcfg)
+    t.setup()
+    cfg = get_model_config('llama-debug')
+    short = itertools.islice(synthetic_data(8, 32, cfg.vocab_size), 3)
+    out = t.evaluate(short, num_batches=10)
+    assert out['batches'] == 3
