@@ -29,7 +29,8 @@ import dataclasses
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, List, Optional,
+                    Sequence, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -71,6 +72,11 @@ class Request:
     max_new_tokens: Optional[int] = None
     temperature: float = 0.0
     request_id: Optional[str] = None
+    # Streaming: called (from the engine thread, under the engine lock)
+    # with each batch of newly generated token ids for THIS request —
+    # keep it cheap (a queue put).  The final RequestResult still
+    # arrives through the normal path after the last chunk.
+    stream_cb: Optional[Callable[[List[int]], None]] = None
 
 
 @dataclasses.dataclass
@@ -87,7 +93,7 @@ class RequestResult:
 
 class _Slot:
     __slots__ = ('request', 'length', 'generated', 'submit_time',
-                 'first_token_time', 'max_new')
+                 'first_token_time', 'max_new', 'streamed')
 
     def __init__(self, request: Request, length: int, submit_time: float,
                  max_new: int):
@@ -97,6 +103,7 @@ class _Slot:
         self.submit_time = submit_time
         self.first_token_time: Optional[float] = None
         self.max_new = max_new
+        self.streamed = 0                  # tokens already stream_cb'd
 
 
 class InferenceEngine:
@@ -319,10 +326,31 @@ class InferenceEngine:
                     self._last_tokens[slot] = s.generated[0]
                     self._temps[slot] = req.temperature
 
+    def _flush_streams(self) -> None:
+        """Deliver newly generated tokens of every active streaming slot.
+        Callback errors are swallowed: a broken consumer must not kill
+        the engine loop (its request still finishes normally)."""
+        for s in self._slots:
+            if s is None or s.request.stream_cb is None:
+                continue
+            if len(s.generated) > s.streamed:
+                chunk = s.generated[s.streamed:]
+                s.streamed = len(s.generated)
+                try:
+                    s.request.stream_cb(list(chunk))
+                except Exception:  # noqa: BLE001
+                    pass
+
     def _finish_slot(self, i: int,
                      reason: str) -> Tuple[Request, RequestResult]:
         s = self._slots[i]
         assert s is not None
+        if s.request.stream_cb is not None and \
+                len(s.generated) > s.streamed:
+            try:
+                s.request.stream_cb(list(s.generated[s.streamed:]))
+            except Exception:  # noqa: BLE001
+                pass
         now = time.time()
         res = RequestResult(
             request_id=s.request.request_id,
@@ -483,10 +511,12 @@ class InferenceEngine:
                             finish_reason='error', error=str(e),
                             error_class='internal'))
             with self._lock:
+                self._flush_streams()            # prefill first tokens
                 for _, res in self._harvest():   # prefill-only finishes
                     result_cb(res)
                 if any(s is not None for s in self._slots):
                     self._decode_step()
+                    self._flush_streams()
                     for _, res in self._harvest():
                         result_cb(res)
                     moved = True

@@ -21,6 +21,7 @@ import argparse
 import json
 import queue
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
@@ -39,6 +40,7 @@ class InferenceServer:
         self._queue: 'queue.Queue[Request]' = queue.Queue()
         self._results: Dict[str, RequestResult] = {}
         self._events: Dict[str, threading.Event] = {}
+        self._stream_queues: Dict[str, 'queue.Queue'] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -60,6 +62,10 @@ class InferenceServer:
     def _deliver(self, res: RequestResult) -> None:
         rid = res.request_id
         if rid is None:
+            return
+        sq = self._stream_queues.get(rid)
+        if sq is not None:          # streaming request: sentinel in-band
+            sq.put(('done', res))
             return
         # Store BEFORE checking the event: if the waiter times out
         # between our check and store, it pops _results after popping
@@ -86,6 +92,37 @@ class InferenceServer:
         self._events.pop(rid, None)
         return self._results.pop(rid, None)
 
+    def submit_stream(self, req: Request, timeout: float = 300.0):
+        """Submit and yield ('tokens', [ids]) chunks as they decode,
+        terminated by ('done', RequestResult) — or ('timeout', None) if
+        the deadline passes between events.
+
+        One queue carries both chunks and the terminal sentinel: the
+        engine enqueues every chunk (under its lock) BEFORE _deliver
+        runs, so ('done', res) is ordered after the last chunk — no
+        polling, and the final event goes out the moment it exists.
+        """
+        rid = req.request_id or uuid.uuid4().hex
+        req.request_id = rid
+        chunks: 'queue.Queue' = queue.Queue()
+        req.stream_cb = lambda toks: chunks.put(('tokens', toks))
+        self._stream_queues[rid] = chunks
+        deadline = time.monotonic() + timeout
+        self._queue.put(req)
+        try:
+            while True:
+                try:
+                    item = chunks.get(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                except queue.Empty:
+                    yield ('timeout', None)
+                    return
+                yield item
+                if item[0] == 'done':
+                    return
+        finally:
+            self._stream_queues.pop(rid, None)
+
 
 def _make_handler(server: InferenceServer):
 
@@ -101,6 +138,58 @@ def _make_handler(server: InferenceServer):
             self.send_header('Content-Length', str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _stream(self, req: Request) -> None:
+            """Server-sent events: one `data:` line per token chunk, a
+            final `data:` with the full result, then connection close
+            (no Content-Length — SSE semantics)."""
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Cache-Control', 'no-cache')
+            self.end_headers()
+
+            def emit(payload: dict) -> None:
+                self.wfile.write(
+                    b'data: ' + json.dumps(payload).encode() + b'\n\n')
+                self.wfile.flush()
+
+            streamed: list = []
+            prev_text = ''
+            try:
+                for kind, value in server.submit_stream(req):
+                    if kind == 'tokens':
+                        streamed.extend(value)
+                        out = {'tokens': value}
+                        if server.tokenizer is not None:
+                            # Incremental detokenization: decode the FULL
+                            # prefix and emit the suffix delta — chunk-
+                            # local decoding breaks BPE merges and
+                            # multi-byte characters at window boundaries.
+                            text = server.tokenizer.decode(streamed)
+                            out['text'] = text[len(prev_text):]
+                            prev_text = text
+                        emit(out)
+                    elif kind == 'done':
+                        final = {
+                            'done': True,
+                            'output_tokens': value.output_tokens,
+                            'ttft_s': value.ttft_s,
+                            'latency_s': value.latency_s,
+                            'finish_reason': value.finish_reason,
+                        }
+                        if value.error:
+                            final['error'] = value.error
+                        if server.tokenizer is not None:
+                            final['text'] = server.tokenizer.decode(
+                                value.output_tokens)
+                        emit(final)
+                    else:   # timeout — acknowledge what was streamed
+                        emit({'done': True, 'error': 'timed out',
+                              'finish_reason': 'error',
+                              'output_tokens': streamed,
+                              'ttft_s': 0.0, 'latency_s': 0.0})
+            except (BrokenPipeError, ConnectionResetError):
+                pass   # client went away mid-stream; engine finishes solo
 
         def do_GET(self):
             if self.path in ('/health', '/'):
@@ -146,6 +235,9 @@ def _make_handler(server: InferenceServer):
                 return
             req = Request(tokens=tokens, max_new_tokens=max_new,
                           temperature=temperature)
+            if payload.get('stream'):
+                self._stream(req)
+                return
             res = server.submit(req)
             if res is None:
                 self._json(504, {'error': 'timed out'})

@@ -105,7 +105,11 @@ class SkyTpuLoadBalancer:
                 handler.close_connection = True
             handler.end_headers()
             while True:
-                chunk = resp.read(64 * 1024)
+                # read1: return as soon as ANY bytes are available (up
+                # to the cap) instead of blocking until 64 KiB or EOF —
+                # SSE/streamed token events must flow through per-event,
+                # not in one burst at connection close.
+                chunk = resp.read1(64 * 1024)
                 if not chunk:
                     break
                 handler.wfile.write(chunk)

@@ -246,3 +246,71 @@ def test_generate_stream_burst_with_prefill_cap(tiny_config):
     busy = [n for n, was_busy in admissions if was_busy]
     assert busy, f'cap branch never exercised: {admissions}'
     assert max(busy) <= cfg.prefills_per_gap, admissions
+
+
+def test_streaming_chunks_concatenate_to_result(tiny_config):
+    """SSE path: streamed token chunks must concatenate exactly to the
+    final result's output_tokens, and match the non-streamed greedy
+    output for the same prompt."""
+    from skypilot_tpu.infer.server import InferenceServer
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=12, cache_dtype=jnp.float32,
+                      decode_steps=3)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(5))
+    srv = InferenceServer(eng)
+    srv.start()
+    try:
+        assert srv.ready.wait(120)
+        want = srv.submit(Request(tokens=[4, 5, 6], max_new_tokens=12))
+        chunks, final = [], None
+        for kind, value in srv.submit_stream(
+                Request(tokens=[4, 5, 6], max_new_tokens=12)):
+            if kind == 'tokens':
+                chunks.append(value)
+            elif kind == 'done':
+                final = value
+        assert final is not None and final.finish_reason == 'length'
+        streamed = [t for c in chunks for t in c]
+        assert streamed == final.output_tokens == want.output_tokens
+        # Genuinely incremental: more than one chunk for 12 tokens with
+        # a 3-step decode window.
+        assert len(chunks) >= 3
+    finally:
+        srv.stop()
+
+
+def test_streaming_http_sse(tiny_config):
+    from http.server import ThreadingHTTPServer
+
+    from skypilot_tpu.infer.server import InferenceServer, _make_handler
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=8, cache_dtype=jnp.float32,
+                      decode_steps=2)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(5))
+    srv = InferenceServer(eng)
+    srv.start()
+    httpd = ThreadingHTTPServer(('127.0.0.1', 0), _make_handler(srv))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        assert srv.ready.wait(120)
+        body = json.dumps({'tokens': [4, 5, 6], 'max_new_tokens': 6,
+                           'stream': True}).encode()
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/generate', data=body,
+            headers={'Content-Type': 'application/json'})
+        events = []
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.headers['Content-Type'] == 'text/event-stream'
+            for line in r:
+                line = line.strip()
+                if line.startswith(b'data: '):
+                    events.append(json.loads(line[6:]))
+        assert events and events[-1].get('done')
+        streamed = [t for e in events if 'tokens' in e
+                    for t in e['tokens']]
+        assert streamed == events[-1]['output_tokens']
+        assert len(streamed) == 6
+    finally:
+        httpd.shutdown()
+        srv.stop()
