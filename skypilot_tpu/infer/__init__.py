@@ -6,6 +6,8 @@ engine is part of the framework: slotted KV cache, bucketed prefill,
 jitted single-token decode over the whole batch, continuous batching.
 """
 from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
-                                       Request, RequestResult)
+                                       Request, RequestResult,
+                                       resolve_cache_dtype)
 
-__all__ = ['InferConfig', 'InferenceEngine', 'Request', 'RequestResult']
+__all__ = ['InferConfig', 'InferenceEngine', 'Request', 'RequestResult',
+           'resolve_cache_dtype']

@@ -27,7 +27,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
-                                       Request, RequestResult)
+                                       Request, RequestResult,
+                                       resolve_cache_dtype)
 
 
 class InferenceServer:
@@ -275,7 +276,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         tokenizer_name: Optional[str] = None,
         eos_id: Optional[int] = None,
         decode_steps: int = 8,
-        hf_model: Optional[str] = None) -> None:
+        hf_model: Optional[str] = None,
+        cache_dtype: str = 'bfloat16') -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -329,7 +331,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
             eos_id = getattr(tokenizer, 'eos_token_id', None)
     cfg = InferConfig(model=model, num_slots=num_slots,
                       max_cache_len=max_cache_len, eos_id=eos_id,
-                      decode_steps=decode_steps)
+                      decode_steps=decode_steps,
+                      cache_dtype=resolve_cache_dtype(cache_dtype))
     engine = InferenceEngine(model_config, cfg, params=params)
     serve(engine, host=host, port=port, tokenizer=tokenizer)
 
@@ -348,11 +351,14 @@ def main() -> None:
     parser.add_argument('--hf-model', default=None,
                         help='HF Llama checkpoint (local path/cache): '
                              'serve real pretrained weights')
+    parser.add_argument('--cache-dtype', default='bfloat16',
+                        choices=['bfloat16', 'fp8'])
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
         tokenizer_name=args.tokenizer, eos_id=args.eos_id,
-        decode_steps=args.decode_steps, hf_model=args.hf_model)
+        decode_steps=args.decode_steps, hf_model=args.hf_model,
+        cache_dtype=args.cache_dtype)
 
 
 if __name__ == '__main__':

@@ -314,3 +314,24 @@ def test_streaming_http_sse(tiny_config):
     finally:
         httpd.shutdown()
         srv.stop()
+
+
+def test_fp8_cache_generates(tiny_config):
+    """fp8 (e4m3) KV cache: valid generations of the requested length
+    (exact token match vs bf16 is not guaranteed — quantization)."""
+    from skypilot_tpu.infer import resolve_cache_dtype
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=8,
+                      cache_dtype=resolve_cache_dtype('fp8'),
+                      decode_steps=2)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(3))
+    [res] = eng.generate([Request(tokens=[4, 5, 6], max_new_tokens=8)])
+    assert res.finish_reason == 'length'
+    assert len(res.output_tokens) == 8
+    assert all(0 <= t < tiny_config.vocab_size for t in res.output_tokens)
+
+
+def test_resolve_cache_dtype_rejects_unknown():
+    from skypilot_tpu.infer import resolve_cache_dtype
+    with pytest.raises(ValueError, match='unknown cache dtype'):
+        resolve_cache_dtype('int4')
