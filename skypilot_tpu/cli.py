@@ -467,6 +467,21 @@ def storage_delete(names, yes):
         click.echo(f'Storage {n!r} deleted.')
 
 
+@cli.command()
+@click.argument('shell', type=click.Choice(['bash', 'zsh', 'fish']))
+def completion(shell):
+    """Print the shell-completion script (parity: sky/cli.py:305-460).
+
+    Install:  eval "$(skytpu completion bash)"   (or zsh/fish)
+    """
+    from click.shell_completion import get_completion_class
+    comp_cls = get_completion_class(shell)
+    if comp_cls is None:
+        raise click.UsageError(f'no completion support for {shell!r}')
+    comp = comp_cls(cli, {}, 'skytpu', '_SKYTPU_COMPLETE')
+    click.echo(comp.source())
+
+
 @cli.group(cls=_NaturalOrderGroup)
 def data():
     """Token-corpus tooling (data/loader.py)."""

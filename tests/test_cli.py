@@ -142,3 +142,10 @@ def test_cli_end_to_end_local(runner, enable_local_cloud):
     finally:
         runner.invoke(cli.cli, ['down', 'clit', '-y', '--purge'])
     assert state.get_cluster_from_name('clit') is None
+
+
+def test_completion_scripts(runner):
+    for shell in ('bash', 'zsh', 'fish'):
+        r = runner.invoke(cli.cli, ['completion', shell])
+        assert r.exit_code == 0, (shell, r.output)
+        assert 'skytpu' in r.output
