@@ -47,6 +47,13 @@ class TrainConfig:
     # gradients — activation memory drops ~K-fold for the same global
     # batch, at no extra communication (grads all-reduce once).
     grad_accum_steps: int = 1
+    # Device-level profiling: capture a jax.profiler trace (XLA ops, HBM,
+    # ICI) of steps [profile_start, profile_start+profile_steps) into
+    # this dir — view with tensorboard/xprof.  Complements the host-side
+    # Chrome-trace timeline (utils/timeline.py).
+    profile_dir: Optional[str] = None
+    profile_start: int = 10
+    profile_steps: int = 3
 
 
 def make_optimizer(cfg: TrainConfig,
@@ -470,17 +477,41 @@ class Trainer:
         t0 = None
         losses = []
         with self.mesh:
-            for i in range(start_step, start_step + num_steps):
-                batch = next(data)
-                self.state, metrics = self._step_fn(self.state, batch)
-                if i == start_step:  # exclude compile from throughput
-                    # Host transfer = reliable sync (block_until_ready can
-                    # return early on tunneled TPU platforms).
-                    float(metrics['loss'])
-                    t0 = time.time()
-                if (i + 1) % log_every == 0:
-                    losses.append(float(metrics['loss']))
-                self.save(i + 1)
+            profiling = False
+            try:
+                for i in range(start_step, start_step + num_steps):
+                    if self.cfg.profile_dir and i - start_step == \
+                            self.cfg.profile_start:
+                        jax.profiler.start_trace(self.cfg.profile_dir)
+                        profiling = True
+                    batch = next(data)
+                    self.state, metrics = self._step_fn(self.state, batch)
+                    if i == start_step:  # exclude compile from throughput
+                        # Host transfer = reliable sync
+                        # (block_until_ready can return early on
+                        # tunneled TPU platforms).
+                        float(metrics['loss'])
+                        t0 = time.time()
+                    if profiling and i - start_step == \
+                            self.cfg.profile_start + \
+                            self.cfg.profile_steps - 1:
+                        float(metrics['loss'])  # sync profiled window
+                        jax.profiler.stop_trace()
+                        profiling = False
+                    if (i + 1) % log_every == 0:
+                        losses.append(float(metrics['loss']))
+                    self.save(i + 1)
+            finally:
+                if profiling:
+                    # Run ended (or raised) inside the window: sync so
+                    # in-flight steps land in the trace, then stop — a
+                    # dangling process-global profiler would also break
+                    # any later start_trace.
+                    try:
+                        float(metrics['loss'])
+                    except Exception:  # noqa: BLE001
+                        pass
+                    jax.profiler.stop_trace()
         float(metrics['loss'])  # sync the dispatched chain before timing
         elapsed = time.time() - (t0 or time.time())
         self.flush_checkpoints()

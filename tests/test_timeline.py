@@ -79,3 +79,20 @@ def test_concurrent_saves_do_not_drop_events(tmp_path, monkeypatch):
     names = {e['name'] for e in
              json.loads(path.read_text())['traceEvents']}
     assert names == {'p0', 'p1', 'p2', 'p3'}
+
+
+def test_trainer_device_profile_capture(tmp_path):
+    """profile_dir captures a jax.profiler trace of the configured step
+    window (device-level complement of the Chrome timeline)."""
+    import glob as globlib
+
+    from skypilot_tpu.train import TrainConfig
+    from skypilot_tpu.train.trainer import Trainer
+    prof = str(tmp_path / 'prof')
+    t = Trainer(TrainConfig(model='llama-debug', batch_size=8, seq_len=32,
+                            profile_dir=prof, profile_start=1,
+                            profile_steps=2))
+    t.setup()
+    t.train(num_steps=4)
+    traces = globlib.glob(prof + '/**/*.xplane.pb', recursive=True)
+    assert traces, f'no xplane trace written under {prof}'
