@@ -824,8 +824,11 @@ def infer():
               help='KV-cache storage dtype. fp8 (e4m3) halves cache HBM '
                    'per slot (~+9% decode throughput at equal slots); '
                    'minor quality loss possible.')
+@click.option('--tensor-parallel', default=0, type=int,
+              help='Shard the model over N local chips (TP serving).')
 def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
-                eos_id, decode_steps, hf_model, cache_dtype):
+                eos_id, decode_steps, hf_model, cache_dtype,
+                tensor_parallel):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     click.echo(f'serving {hf_model or model} on {host}:{port}')
@@ -833,7 +836,8 @@ def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
                      num_slots=num_slots, max_cache_len=max_cache_len,
                      tokenizer_name=tokenizer, eos_id=eos_id,
                      decode_steps=decode_steps, hf_model=hf_model,
-                     cache_dtype=cache_dtype)
+                     cache_dtype=cache_dtype,
+                     tensor_parallel=tensor_parallel)
 
 
 @infer.command('bench')

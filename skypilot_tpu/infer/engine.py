@@ -25,6 +25,7 @@ Role parity: replaces the reference's delegation to vLLM/JetStream
 (llm/vllm/, examples/tpu/v6e/serve-llama2-7b.yaml); the serve plane's
 replicas run this engine via `python -m skypilot_tpu.infer.server`.
 """
+import contextlib
 import dataclasses
 import queue
 import threading
@@ -130,21 +131,31 @@ class _Slot:
 class InferenceEngine:
     """Single-process engine over the local device(s).
 
-    With a mesh spanning multiple chips the params/cache shardings follow
-    the model's logical axes (tensor-parallel serving); on one chip
-    everything is resident locally.
+    mesh: a Mesh with a 'tensor' axis enables tensor-parallel serving —
+    params shard by their logical axes (heads/mlp/vocab over 'tensor'),
+    the KV cache shards on its kv-heads dim, and XLA inserts the
+    activation collectives over ICI; num_kv_heads must be divisible by
+    the tensor degree.  mesh=None: everything resident on one chip.
     """
 
     def __init__(self, model_config: LlamaConfig,
                  cfg: Optional[InferConfig] = None,
                  params: Optional[Any] = None,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None,
+                 mesh: Optional[jax.sharding.Mesh] = None):
+        self._mesh = mesh
         self.model_config = model_config
         self.cfg = cfg or InferConfig()
         if not isinstance(model_config, LlamaConfig):
             raise TypeError(
                 'InferenceEngine currently supports the Llama family '
                 f'(KV-cache decode path); got {type(model_config).__name__}')
+        if mesh is not None:
+            tp = dict(mesh.shape).get('tensor', 1)
+            if model_config.num_kv_heads % max(tp, 1):
+                raise ValueError(
+                    f'num_kv_heads {model_config.num_kv_heads} not '
+                    f'divisible by tensor degree {tp}')
         if self.cfg.max_cache_len > model_config.max_seq_len:
             raise ValueError(
                 f'max_cache_len {self.cfg.max_cache_len} exceeds model '
@@ -172,13 +183,30 @@ class InferenceEngine:
         self.cfg.prefill_buckets = buckets
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._rng = rng
+        sample = jnp.zeros((1, 8), jnp.int32)
         if params is None:
-            sample = jnp.zeros((1, 8), jnp.int32)
-            params = jax.jit(self.model.init)(rng, sample)
+            if mesh is None:
+                params = jax.jit(self.model.init)(rng, sample)
+            else:
+                params = self._init_sharded_params(rng, sample)
+        elif mesh is not None:
+            params = self._shard_given_params(params, rng, sample)
         self.params = params
         b = self.cfg.num_slots
         self.cache = init_cache(model_config, b, self.cfg.max_cache_len,
                                 self.cfg.cache_dtype)
+        if mesh is not None:
+            # Cache [B, Hkv, S, D]: kv heads shard like the weights'
+            # 'kv_heads' logical axis (the per-shard K/V the sharded
+            # projections produce) — resolved through the same rules as
+            # every other sharding, not a hand-named mesh axis.
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            cache_sharding = mesh_lib.named_sharding(
+                mesh, None, 'kv_heads', None, None)
+            self.cache = [
+                (jax.device_put(k, cache_sharding),
+                 jax.device_put(v, cache_sharding)) for k, v in self.cache
+            ]
         self._slots: List[Optional[_Slot]] = [None] * b
         # Host mirrors of per-slot decode state (pushed to device each
         # step as small arrays).
@@ -186,7 +214,78 @@ class InferenceEngine:
         self._last_tokens = np.zeros((b,), np.int32)
         self._temps = np.zeros((b,), np.float32)
         self._lock = threading.Lock()
-        self._jit_fns()
+        self._jit_fns()   # lazy wrappers; tracing happens (under _ctx)
+                          # at the _start_batch/_decode_step call sites
+
+    # ---------------------------------------------------------- sharding
+
+    def _ctx(self):
+        """Mesh + flax logical-axis-rules context (trace-time logical
+        constraints inside the model need the rules active); a
+        nullcontext when unsharded."""
+        if self._mesh is None:
+            return contextlib.nullcontext()
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        return mesh_lib.mesh_context(self._mesh)
+
+    def _param_shardings(self, rng, sample):
+        import flax.linen as nn
+
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        abstract = jax.eval_shape(self.model.init, rng, sample)
+        logical = nn.get_partition_spec(abstract)
+        shardings = jax.tree.map(
+            lambda spec: nn.logical_to_mesh_sharding(
+                spec, self._mesh, mesh_lib.logical_axis_rules()),
+            logical,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        # Replicate any dim the mesh doesn't divide evenly (e.g. an odd
+        # vocab under tensor parallelism) instead of failing placement.
+        return jax.tree.map(
+            lambda leaf, sh: self._fit_sharding(leaf.shape, sh),
+            nn.meta.unbox(abstract), nn.meta.unbox(shardings))
+
+    def _fit_sharding(self, shape, sharding):
+        mesh_shape = dict(self._mesh.shape)
+
+        def degree(ax):
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            d = 1
+            for a in axes:
+                d *= mesh_shape.get(a, 1)
+            return d
+
+        spec = tuple(sharding.spec) + (None,) * (len(shape) -
+                                                 len(sharding.spec))
+        fitted = tuple(
+            ax if ax is not None and dim % degree(ax) == 0 else None
+            for dim, ax in zip(shape, spec))
+        return jax.sharding.NamedSharding(
+            self._mesh, jax.sharding.PartitionSpec(*fitted))
+
+    def _init_sharded_params(self, rng, sample):
+        """Params born sharded over the mesh (a 70B never materializes
+        on one device)."""
+        import flax.linen as nn
+        shardings = self._param_shardings(rng, sample)
+
+        def init_unboxed(r):
+            # Unbox INSIDE jit so the output pytree structure matches
+            # the (unboxed) shardings tree.
+            return nn.meta.unbox(self.model.init(r, sample))
+
+        with self._ctx():
+            return jax.jit(init_unboxed, out_shardings=shardings)(rng)
+
+    def _shard_given_params(self, params, rng, sample):
+        """Place a given (host or single-device) param tree onto the
+        mesh by its logical axes — the HF-import serving path."""
+        import flax.linen as nn
+        params = nn.meta.unbox(params)   # strip partitioning boxes
+        shardings = self._param_shardings(rng, sample)
+        return jax.tree.map(
+            lambda p, s: jax.device_put(np.asarray(p), s), params,
+            shardings)
 
     # ------------------------------------------------------------- jitted
 
@@ -330,10 +429,11 @@ class InferenceEngine:
                 pcache = init_cache(self.model_config, width, bucket,
                                     self.cfg.cache_dtype)
                 self._rng, key = jax.random.split(self._rng)
-                first, self.cache = self._prefill_insert(
-                    self.params, jnp.asarray(tokens),
-                    jnp.asarray(true_lens), pcache, self.cache,
-                    jnp.asarray(slots), jnp.asarray(temps), key)
+                with self._ctx():   # mesh+rules active at trace time
+                    first, self.cache = self._prefill_insert(
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray(true_lens), pcache, self.cache,
+                        jnp.asarray(slots), jnp.asarray(temps), key)
                 first_np = np.asarray(first)
                 now = time.time()
                 for i, (req, slot, submit_time, n, _, max_new) in \
@@ -393,9 +493,10 @@ class InferenceEngine:
         the cache rows they wrote are dead and get overwritten when the
         slot is recycled)."""
         self._rng, key = jax.random.split(self._rng)
-        toks, self.cache = self._decode(
-            self.params, self.cache, jnp.asarray(self._last_tokens),
-            jnp.asarray(self._lengths), jnp.asarray(self._temps), key)
+        with self._ctx():           # mesh+rules active at trace time
+            toks, self.cache = self._decode(
+                self.params, self.cache, jnp.asarray(self._last_tokens),
+                jnp.asarray(self._lengths), jnp.asarray(self._temps), key)
         toks_np = np.asarray(toks)                           # [K, B]
         for i, s in enumerate(self._slots):
             if s is None:

@@ -277,13 +277,18 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         eos_id: Optional[int] = None,
         decode_steps: int = 8,
         hf_model: Optional[str] = None,
-        cache_dtype: str = 'bfloat16') -> None:
+        cache_dtype: str = 'bfloat16',
+        tensor_parallel: int = 0) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
     hf_model: HuggingFace Llama checkpoint (local path or warm cache) —
     real pretrained weights instead of the registry's random init.  The
     tokenizer defaults to the same checkpoint.
+
+    tensor_parallel: shard the model over this many local chips (a
+    'tensor' mesh axis); 0/1 = single-chip.  Requires num_kv_heads
+    divisible by the degree.
     """
     import jax.numpy as jnp
 
@@ -306,8 +311,14 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         # MXU-native).
         model_config, tree = hf_import.load_hf_model(
             hf_model, param_dtype=jnp.bfloat16)
-        params = {'params': jax.tree.map(jnp.asarray, tree)}
-        del tree  # free the host copy for the server's lifetime
+        if tensor_parallel and tensor_parallel > 1:
+            # Keep the tree HOST-side: the engine device_puts each leaf
+            # straight onto its mesh sharding — a 70B must never
+            # materialize on chip 0.
+            params = {'params': tree}
+        else:
+            params = {'params': jax.tree.map(jnp.asarray, tree)}
+            del tree  # free the host copy for the server's lifetime
         model = model_config.name
         if tokenizer_name is None:
             tokenizer_name = hf_model
@@ -333,7 +344,14 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       max_cache_len=max_cache_len, eos_id=eos_id,
                       decode_steps=decode_steps,
                       cache_dtype=resolve_cache_dtype(cache_dtype))
-    engine = InferenceEngine(model_config, cfg, params=params)
+    mesh = None
+    if tensor_parallel and tensor_parallel > 1:
+        import jax
+
+        from skypilot_tpu.parallel import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(tensor=tensor_parallel),
+                         devices=jax.devices()[:tensor_parallel])
+    engine = InferenceEngine(model_config, cfg, params=params, mesh=mesh)
     serve(engine, host=host, port=port, tokenizer=tokenizer)
 
 
@@ -353,12 +371,15 @@ def main() -> None:
                              'serve real pretrained weights')
     parser.add_argument('--cache-dtype', default='bfloat16',
                         choices=['bfloat16', 'fp8'])
+    parser.add_argument('--tensor-parallel', type=int, default=0,
+                        help='shard the model over N local chips')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
         tokenizer_name=args.tokenizer, eos_id=args.eos_id,
         decode_steps=args.decode_steps, hf_model=args.hf_model,
-        cache_dtype=args.cache_dtype)
+        cache_dtype=args.cache_dtype,
+        tensor_parallel=args.tensor_parallel)
 
 
 if __name__ == '__main__':
