@@ -303,10 +303,10 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         # before the (potentially tens-of-GB) weight load.
         mt = getattr(transformers.AutoConfig.from_pretrained(hf_model),
                      'model_type', None)
-        if mt != 'llama':
+        if mt not in ('llama', 'qwen2'):
             raise ValueError(
-                f'--hf-model must be a llama-family checkpoint; got '
-                f'model_type={mt!r}')
+                f'--hf-model must be a llama-family checkpoint '
+                f"(model_type 'llama' or 'qwen2'); got model_type={mt!r}")
         # Serving: bf16 weights end to end (half the host RAM and HBM,
         # MXU-native).
         model_config, tree = hf_import.load_hf_model(

@@ -151,6 +151,13 @@ def _convert_llama(cfg: LlamaConfig, sd: _SD) -> Dict[str, Any]:
                     'kernel': _linear(sd(p + 'mlp.down_proj.weight'))},
             },
         }
+        if cfg.attention_bias:   # Qwen2-style q/k/v biases
+            attn = params[f'layer_{i}']['attn']
+            for proj, heads in (('q_proj', cfg.num_heads),
+                                ('k_proj', cfg.num_kv_heads),
+                                ('v_proj', cfg.num_kv_heads)):
+                attn[proj]['bias'] = _np(
+                    sd(p + f'self_attn.{proj}.bias')).reshape(heads, d)
     if not cfg.tie_embeddings:
         params['lm_head'] = {'kernel': _linear(sd('lm_head.weight'))}
     return params
@@ -379,7 +386,15 @@ def config_from_hf(hf_config, name: Optional[str] = None):
     """Map a transformers config object to the matching framework config."""
     mt = getattr(hf_config, 'model_type', None)
     name = name or f'hf-{mt}'
-    if mt == 'llama':
+    if mt in ('llama', 'qwen2'):
+        # Qwen2 is llama-architecture + unconditional q/k/v biases (no
+        # config flag); it shares this whole mapping, including the
+        # refuse-to-load guard on unsupported rope_scaling types.
+        if mt == 'qwen2' and getattr(hf_config, 'use_sliding_window',
+                                     False):
+            raise ValueError(
+                'use_sliding_window=true is not implemented (full '
+                'attention only); refusing to load with wrong masking')
         scaling_kw = {}
         rs = getattr(hf_config, 'rope_scaling', None)
         rope_type = rs.get('rope_type', rs.get('type')) if rs else None
@@ -408,6 +423,8 @@ def config_from_hf(hf_config, name: Optional[str] = None):
             max_seq_len=hf_config.max_position_embeddings,
             rope_theta=getattr(hf_config, 'rope_theta', 10000.0),
             norm_eps=hf_config.rms_norm_eps,
+            attention_bias=(mt == 'qwen2' or
+                            getattr(hf_config, 'attention_bias', False)),
             tie_embeddings=getattr(hf_config, 'tie_word_embeddings', False),
             **scaling_kw)
     if mt == 'gpt2':

@@ -44,6 +44,9 @@ class LlamaConfig:
     rope_scaling_original_max_len: Optional[int] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Biases on the q/k/v projections (Qwen2-style; LLaMA proper has
+    # none anywhere).
+    attention_bias: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     # LoRA adapters (train/lora.py): rank 0 disables.  Targets name the
     # projections that get a sibling '<name>_lora' adapter; the base
@@ -174,16 +177,20 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 
 def _proj(cfg: LlamaConfig, name: str, feats, axes, *, axis=-1,
-          init_std: float = 0.02):
+          init_std: float = 0.02, use_bias: bool = False):
     """A named projection: DenseGeneral plus, when `name` is a configured
     LoRA target, a sibling '<name>_lora' adapter added to its output.
     Must be called from inside the owning module's @nn.compact __call__
     (both submodules register as its children).  The single wiring point
     for every adapted projection in the family."""
+    n_feats = len(feats) if isinstance(feats, tuple) else 1
     base = nn.DenseGeneral(
-        feats, axis=axis, use_bias=False, dtype=cfg.dtype,
+        feats, axis=axis, use_bias=use_bias, dtype=cfg.dtype,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.normal(init_std), axes),
+        # Bias covers the OUTPUT feature dims: the trailing kernel axes.
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros,
+                                               axes[-n_feats:]),
         name=name)
     if not (cfg.lora_rank and name in cfg.lora_targets):
         return base
@@ -205,11 +212,14 @@ class Attention(nn.Module):
         d = cfg.head_dim_
 
         q = _proj(cfg, 'q_proj', (cfg.num_heads, d),
-                  ('embed', 'heads', 'qkv_embed'))(x)
+                  ('embed', 'heads', 'qkv_embed'),
+                  use_bias=cfg.attention_bias)(x)
         k = _proj(cfg, 'k_proj', (cfg.num_kv_heads, d),
-                  ('embed', 'kv_heads', 'qkv_embed'))(x)
+                  ('embed', 'kv_heads', 'qkv_embed'),
+                  use_bias=cfg.attention_bias)(x)
         v = _proj(cfg, 'v_proj', (cfg.num_kv_heads, d),
-                  ('embed', 'kv_heads', 'qkv_embed'))(x)
+                  ('embed', 'kv_heads', 'qkv_embed'),
+                  use_bias=cfg.attention_bias)(x)
         # [B, S, H, D] -> [B, H, S, D]
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))

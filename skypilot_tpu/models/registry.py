@@ -47,6 +47,12 @@ _register(
                 rope_scaling_factor=8.0, rope_scaling_low_freq=1.0,
                 rope_scaling_high_freq=4.0,
                 rope_scaling_original_max_len=8192))
+# Qwen2/2.5 7B: llama architecture + q/k/v biases.
+_register(
+    LlamaConfig(name='qwen2-7b', vocab_size=152064, hidden_size=3584,
+                intermediate_size=18944, num_layers=28, num_heads=28,
+                num_kv_heads=4, max_seq_len=32768, rope_theta=1e6,
+                norm_eps=1e-6, attention_bias=True))
 # ~1.1B config (TinyLlama-class): the graft-entry flagship forward model.
 _register(
     LlamaConfig(name='llama-1b', vocab_size=32000, hidden_size=2048,

@@ -258,3 +258,65 @@ def test_default_rope_type_is_no_scaling():
 def test_unknown_config_rejected():
     with pytest.raises(ValueError, match='no HF converter'):
         hf_import.convert_state_dict(object(), {})
+
+
+def test_qwen2_logit_parity():
+    """Qwen2 = llama arch + q/k/v biases; converted weights must match
+    transformers logits exactly."""
+    torch.manual_seed(6)
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=False)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+
+    cfg = hf_import.config_from_hf(hf_cfg, name='tiny-qwen')
+    assert cfg.attention_bias
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+    assert 'bias' in params['layer_0']['attn']['q_proj']
+
+    from skypilot_tpu.models.llama import Llama
+    tokens = _tokens(128, seed=11)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    got = Llama(cfg).apply({'params': params}, jnp.asarray(tokens))
+    _assert_close(got, want)
+
+
+def test_qwen2_generation_through_engine():
+    """Qwen2 greedy continuation through the serving engine (the cache
+    path threads the biases too)."""
+    torch.manual_seed(7)
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = dataclasses.replace(
+        hf_import.config_from_hf(hf_cfg, name='q'), dtype=jnp.float32)
+    tree = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.infer import InferConfig, InferenceEngine, Request
+    engine = InferenceEngine(
+        cfg,
+        InferConfig(model='q', num_slots=2, max_cache_len=32,
+                    prefill_buckets=(16,), max_new_tokens=6,
+                    cache_dtype=jnp.float32, decode_steps=2),
+        params={'params': tree})
+    prompt = _tokens(64, shape=(1, 8), seed=13)[0].tolist()
+    with torch.no_grad():
+        want = hf.generate(torch.tensor([prompt]), max_new_tokens=6,
+                           do_sample=False).numpy()[0, 8:]
+    [res] = engine.generate([Request(tokens=prompt, max_new_tokens=6)])
+    assert res.output_tokens == list(want), (res.output_tokens, list(want))
+
+
+def test_qwen2_sliding_window_rejected():
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        use_sliding_window=True, sliding_window=16, max_window_layers=0)
+    with pytest.raises(ValueError, match='sliding_window'):
+        hf_import.config_from_hf(hf_cfg)
