@@ -116,7 +116,8 @@ class Bert(nn.Module):
         positions = jnp.arange(tokens.shape[1])[None]
         wte = self.param(
             'word_embeddings', nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ('vocab', 'embed')),
+                nn.initializers.normal(0.02),
+                ('vocab_table', 'embed_table')),
             (cfg.vocab_size, cfg.hidden_size))
         wpe = self.param(
             'position_embeddings', nn.with_logical_partitioning(

@@ -134,7 +134,8 @@ class GPT2(nn.Module):
                 jnp.arange(tokens.shape[1])[None], tokens.shape)
         wte = self.param(
             'wte', nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ('vocab', 'embed')),
+                nn.initializers.normal(0.02),
+                ('vocab_table', 'embed_table')),
             (cfg.vocab_size, cfg.hidden_size))
         wpe = self.param(
             'wpe', nn.with_logical_partitioning(

@@ -290,17 +290,27 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None):
-        attn_in = RMSNorm(self.config.norm_eps, name='input_norm')(x)
+        # Residual-stream activations are anchored to the batch-sharded
+        # layout at BOTH norm seams, not just the layer output: without
+        # an anchor on the norm outputs, the backward of the qkv/mlp
+        # dots propagates the weights' fsdp-sharded 'embed' dim into the
+        # activation gradients, and the gradient add at the residual
+        # join needs a batch-shard <-> embed-shard reshard the SPMD
+        # partitioner can only do by full rematerialization
+        # (replicate-then-repartition: wasted HBM + ICI).
+        resid = ('activation_batch', 'activation_seq', 'activation_embed')
+        attn_in = nn.with_logical_constraint(
+            RMSNorm(self.config.norm_eps, name='input_norm')(x), resid)
         attn = Attention(self.config, name='attn')
         if kv_cache is not None:
             attn_out, new_cache = attn(attn_in, positions, kv_cache)
         else:
             attn_out, new_cache = attn(attn_in, positions), None
-        h = x + attn_out
-        out = h + MLP(self.config, name='mlp')(
-            RMSNorm(self.config.norm_eps, name='post_attn_norm')(h))
-        out = nn.with_logical_constraint(
-            out, ('activation_batch', 'activation_seq', 'activation_embed'))
+        h = nn.with_logical_constraint(x + attn_out, resid)
+        mlp_in = nn.with_logical_constraint(
+            RMSNorm(self.config.norm_eps, name='post_attn_norm')(h), resid)
+        out = h + MLP(self.config, name='mlp')(mlp_in)
+        out = nn.with_logical_constraint(out, resid)
         if kv_cache is not None:
             return out, new_cache
         return out
@@ -332,7 +342,7 @@ class Llama(nn.Module):
         embed = self.param(
             'embedding',
             nn.with_logical_partitioning(nn.initializers.normal(0.02),
-                                         ('vocab', 'embed')),
+                                         ('vocab_table', 'embed_table')),
             (cfg.vocab_size, cfg.hidden_size))
         x = embed.astype(cfg.dtype)[tokens]
         x = nn.with_logical_constraint(

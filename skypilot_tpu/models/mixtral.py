@@ -126,8 +126,24 @@ def top_k_routing(router_logits: jax.Array, num_experts: int, k: int,
 
 
 class MoEBlock(nn.Module):
-    """Top-k sparse SwiGLU experts with einsum dispatch."""
+    """Top-k sparse SwiGLU experts with einsum dispatch.
+
+    exact=True (the inference/cache path) switches to drop-free
+    dense-all-experts evaluation: every expert runs on every token and
+    outputs are gate-weighted.  Capacity-factor dispatch is
+    token-GROUP-relative, so a decode step (g = num_slots tokens,
+    including recycled-slot garbage) would overflow expert capacity
+    whenever routing is imbalanced and silently zero the overflow
+    tokens' expert outputs — served generations must never diverge from
+    the model.  Cost analysis: decode is HBM-bound streaming ALL
+    experts' weights regardless of routing, so dense costs no extra
+    bandwidth and negligible FLOPs at decode batch sizes; prefill pays
+    E/k-fold MLP FLOPs, the standard price of exactness without a
+    grouped-GEMM kernel (future pallas work).  Training keeps the
+    GShard capacity path (static shapes, sparse FLOPs).
+    """
     config: MixtralConfig
+    exact: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -144,10 +160,6 @@ class MoEBlock(nn.Module):
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), ('embed', None)),
             name='router')(xf.astype(jnp.float32))
-        dispatch, combine, aux = top_k_routing(
-            router, cfg.num_experts, cfg.experts_per_token, capacity)
-        self.sow('intermediates', 'router_aux_loss',
-                 aux * cfg.router_aux_loss_weight)
 
         def expert_param(name, shape, axes):
             return self.param(
@@ -161,6 +173,33 @@ class MoEBlock(nn.Module):
                             ('expert', 'embed', 'mlp'))
         w_down = expert_param('w_down', (cfg.num_experts, f, h),
                               ('expert', 'mlp', 'embed'))
+
+        if self.exact:
+            probs = jax.nn.softmax(router, axis=-1)
+            gate_vals, gate_idx = jax.lax.top_k(
+                probs, cfg.experts_per_token)                # [G, k]
+            gate_vals = gate_vals / jnp.sum(gate_vals, -1,
+                                            keepdims=True)
+            # [G, E] gates: the token's top-k experts carry their
+            # renormalized probs, every other expert 0.
+            gates = jnp.sum(
+                jax.nn.one_hot(gate_idx, cfg.num_experts,
+                               dtype=jnp.float32) *
+                gate_vals[..., None], axis=1)
+            xc = xf.astype(cfg.dtype)
+            hmid = nn.silu(jnp.einsum('gh,ehf->egf', xc,
+                                      w_gate.astype(cfg.dtype))) * \
+                jnp.einsum('gh,ehf->egf', xc, w_up.astype(cfg.dtype))
+            expert_out = jnp.einsum('egf,efh->egh', hmid,
+                                    w_down.astype(cfg.dtype))
+            out = jnp.einsum('egh,ge->gh', expert_out,
+                             gates.astype(cfg.dtype))
+            return out.reshape(b, s, h)
+
+        dispatch, combine, aux = top_k_routing(
+            router, cfg.num_experts, cfg.experts_per_token, capacity)
+        self.sow('intermediates', 'router_aux_loss',
+                 aux * cfg.router_aux_loss_weight)
         # Dispatch tokens into per-expert slots: [E, C, H].
         expert_in = jnp.einsum('gec,gh->ech',
                                dispatch.astype(cfg.dtype),
@@ -179,15 +218,32 @@ class MixtralLayer(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, kv_cache=None):
         cfg = self.config
         lcfg = cfg.as_llama()
-        h = x + Attention(lcfg, name='attn')(
-            RMSNorm(cfg.norm_eps, name='input_norm')(x), positions)
-        out = h + MoEBlock(cfg, name='moe')(
-            RMSNorm(cfg.norm_eps, name='post_attn_norm')(h))
-        return nn.with_logical_constraint(
-            out, ('activation_batch', 'activation_seq', 'activation_embed'))
+        # Anchor the norm outputs like llama.DecoderLayer: unanchored
+        # norm seams let backward dots propagate weight shardings into
+        # activation grads, forcing involuntary full rematerialization
+        # at the residual joins (see DecoderLayer comment).
+        resid = ('activation_batch', 'activation_seq', 'activation_embed')
+        attn_in = nn.with_logical_constraint(
+            RMSNorm(cfg.norm_eps, name='input_norm')(x), resid)
+        attn = Attention(lcfg, name='attn')
+        if kv_cache is not None:
+            attn_out, new_cache = attn(attn_in, positions, kv_cache)
+        else:
+            attn_out, new_cache = attn(attn_in, positions), None
+        h = nn.with_logical_constraint(x + attn_out, resid)
+        moe_in = nn.with_logical_constraint(
+            RMSNorm(cfg.norm_eps, name='post_attn_norm')(h), resid)
+        # Cache path (serving) uses exact drop-free routing — see
+        # MoEBlock docstring.
+        out = h + MoEBlock(cfg, exact=kv_cache is not None,
+                           name='moe')(moe_in)
+        out = nn.with_logical_constraint(out, resid)
+        if kv_cache is not None:
+            return out, new_cache
+        return out
 
 
 class Mixtral(nn.Module):
@@ -197,29 +253,50 @@ class Mixtral(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, hidden_only=False):
+    def __call__(self, tokens, positions=None, cache=None,
+                 hidden_only=False):
+        """Training/scoring: __call__(tokens) -> logits (router aux loss
+        sowed).  Incremental inference: __call__(tokens, positions,
+        cache) -> (logits, new_cache) — same per-layer [(k, v)] cache
+        contract as Llama (llama.init_cache works: the attention
+        geometry is shared), with the MoE block running its router +
+        experts on the new tokens each step.  Parity intent: the
+        reference serves Mixtral via vLLM/megablocks
+        (llm/mixtral/serve.yaml:38); here the same engine serves it."""
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1])[None], tokens.shape)
         embed = self.param(
             'embedding', nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ('vocab', 'embed')),
+                nn.initializers.normal(0.02),
+                ('vocab_table', 'embed_table')),
             (cfg.vocab_size, cfg.hidden_size))
         x = embed.astype(cfg.dtype)[tokens]
         x = nn.with_logical_constraint(
             x, ('activation_batch', 'activation_seq', 'activation_embed'))
+        new_cache = []
         for i in range(cfg.num_layers):
             layer = MixtralLayer(cfg, name=f'layer_{i}')
-            x = nn.remat(lambda mdl, h, pos: mdl(h, pos),
-                         prevent_cse=True)(layer, x, positions)
+            if cache is not None:
+                x, layer_cache = layer(x, positions, cache[i])
+                new_cache.append(layer_cache)
+            else:
+                x = nn.remat(lambda mdl, h, pos: mdl(h, pos),
+                             prevent_cse=True)(layer, x, positions)
         x = RMSNorm(cfg.norm_eps, name='final_norm')(x)
         if hidden_only:
             return x
         if cfg.tie_embeddings:
-            return x.astype(jnp.float32) @ embed.astype(jnp.float32).T
-        return nn.DenseGeneral(
+            logits = x.astype(jnp.float32) @ embed.astype(jnp.float32).T
+            if cache is not None:
+                return logits, new_cache
+            return logits
+        logits = nn.DenseGeneral(
             cfg.vocab_size, use_bias=False, dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), ('embed', 'vocab')),
             name='lm_head')(x.astype(jnp.float32))
+        if cache is not None:
+            return logits, new_cache
+        return logits

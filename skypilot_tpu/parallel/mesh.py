@@ -47,6 +47,8 @@ _BASE_RULES: List[Tuple[str, object]] = [
     ('kv_heads', 'tensor'),
     ('qkv_embed', None),
     ('vocab', 'tensor'),
+    ('vocab_table', 'fsdp'),
+    ('embed_table', 'tensor'),
     ('expert', 'tensor'),
     ('norm', None),
 ]

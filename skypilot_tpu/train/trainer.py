@@ -47,6 +47,13 @@ class TrainConfig:
     # gradients — activation memory drops ~K-fold for the same global
     # batch, at no extra communication (grads all-reduce once).
     grad_accum_steps: int = 1
+    # Pipeline parallelism (mesh.stage > 1): number of GPipe microbatches
+    # per step; None = stage count (the minimum that fills the pipe;
+    # larger shrinks the bubble, (S-1)/(M+S-1)).  The param tree stays
+    # the standard per-layer layout — checkpoints/optimizer/LoRA are
+    # unchanged — only the jit'd forward pipelines the layer stack
+    # (parallel.pipeline.make_pipelined_apply).
+    pipeline_microbatches: Optional[int] = None
     # Device-level profiling: capture a jax.profiler trace (XLA ops, HBM,
     # ICI) of steps [profile_start, profile_start+profile_steps) into
     # this dir — view with tensorboard/xprof.  Complements the host-side
@@ -83,13 +90,18 @@ def make_optimizer(cfg: TrainConfig,
 def create_sharded_state(
         model_config: Any, train_cfg: TrainConfig,
         mesh: jax.sharding.Mesh,
-        rng: jax.Array) -> Tuple[TrainState, Any]:
+        rng: jax.Array,
+        apply_fn: Optional[Callable] = None) -> Tuple[TrainState, Any]:
     """Initialize a TrainState with every leaf placed by its logical axes.
 
     Works for any causal-LM family (llama/gpt2/mixtral — see
     registry.is_causal_lm).  The init function is jit'd with out_shardings
     derived from the model's logical annotations, so even 70B-class
     params are *born sharded* — no single-host materialization.
+
+    apply_fn: optional forward override with Module.apply's signature
+    (the Trainer passes the pipelined forward here when mesh.stage > 1;
+    params/init are IDENTICAL either way).
     """
     model = model_registry.build_model(model_config)
     tx = make_optimizer(train_cfg, model_config)
@@ -97,7 +109,8 @@ def create_sharded_state(
 
     def init_fn(rng):
         params = model.init(rng, sample)['params']
-        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+        return TrainState.create(apply_fn=apply_fn or model.apply,
+                                 params=params, tx=tx)
 
     abstract = jax.eval_shape(init_fn, rng)
     logical_specs = nn.get_partition_spec(abstract)
@@ -115,12 +128,19 @@ def create_sharded_state(
 
 
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
-                       mask: Optional[jax.Array] = None) -> jax.Array:
+                       mask: Optional[jax.Array] = None,
+                       normalizer: Optional[jax.Array] = None) -> jax.Array:
+    """Masked mean CE; with `normalizer` given, masked SUM * normalizer
+    instead (grad-accum passes 1/global_token_count so microbatch losses
+    add up exactly to the full-batch mean — see make_train_step)."""
     onehot_loss = optax.softmax_cross_entropy_with_integer_labels(
         logits, targets)
-    if mask is not None:
-        return (onehot_loss * mask).sum() / jnp.maximum(mask.sum(), 1)
-    return onehot_loss.mean()
+    if mask is None:
+        mask = jnp.ones(targets.shape, onehot_loss.dtype)
+    total = (onehot_loss * mask).sum()
+    if normalizer is not None:
+        return total * normalizer
+    return total / jnp.maximum(mask.sum(), 1)
 
 
 def output_projection(params: Any) -> jax.Array:
@@ -138,7 +158,9 @@ def output_projection(params: Any) -> jax.Array:
 def chunked_cross_entropy(hidden: jax.Array, proj: jax.Array,
                           targets: jax.Array,
                           mask: Optional[jax.Array] = None,
-                          chunk_t: int = 128) -> jax.Array:
+                          chunk_t: int = 128,
+                          normalizer: Optional[jax.Array] = None
+                          ) -> jax.Array:
     """Next-token CE WITHOUT materializing [B, T, V] float32 logits.
 
     The vocab projection + logsumexp run per sequence-chunk inside a
@@ -183,6 +205,8 @@ def chunked_cross_entropy(hidden: jax.Array, proj: jax.Array,
         return acc + chunk_loss(*xs), None
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hs, ts, ms))
+    if normalizer is not None:   # grad-accum: sum-form, caller normalizes
+        return total * normalizer
     return total / jnp.maximum(mask.sum(), 1.0)
 
 
@@ -201,10 +225,10 @@ def make_train_step(mesh: jax.sharding.Mesh,
     same path the Trainer runs.
 
     grad_accum_steps: K > 1 splits the global batch into K sequential
-    microbatches inside the step (lax.scan), averaging gradients before
-    the single optimizer update — K-fold less activation memory for the
-    same numerics (token-masked batches assume equal mask weight per
-    microbatch, the standard approximation).
+    microbatches inside the step (lax.scan), accumulating sum-form
+    masked losses/grads and normalizing ONCE by the global token count —
+    K-fold less activation memory with numerics exactly equal to the
+    non-accumulated step, including unequal mask counts per microbatch.
 
     trainable: optional predicate on flattened param paths (tuples of
     key strings).  When set (LoRA), only matching leaves are
@@ -225,7 +249,14 @@ def make_train_step(mesh: jax.sharding.Mesh,
     def join_params(tr, fz):
         return traverse_util.unflatten_dict({**fz, **tr})
 
-    def make_loss_fn(state, inputs, targets, mask):
+    def make_loss_fn(state, inputs, targets, mask,
+                     normalizer=None, aux_scale=1.0):
+        """normalizer/aux_scale: grad-accum exactness knobs.  With
+        normalizer = 1/global_token_count and aux_scale = 1/K, the K
+        microbatch losses SUM to exactly the full-batch objective even
+        when mask counts differ across microbatches (the CE term is kept
+        in masked-sum form; the router aux term — a per-token mean that
+        ignores the mask — averages over equal-sized microbatches)."""
 
         def loss_fn(params):
             if loss_chunk:
@@ -235,11 +266,13 @@ def make_train_step(mesh: jax.sharding.Mesh,
                 loss = chunked_cross_entropy(hidden,
                                              output_projection(params),
                                              targets, mask,
-                                             chunk_t=loss_chunk)
+                                             chunk_t=loss_chunk,
+                                             normalizer=normalizer)
             else:
                 logits, mutables = state.apply_fn(
                     {'params': params}, inputs, mutable=['intermediates'])
-                loss = cross_entropy_loss(logits, targets, mask)
+                loss = cross_entropy_loss(logits, targets, mask,
+                                          normalizer=normalizer)
             # MoE families sow per-layer router load-balancing losses.
             # Filter by key: other sowed intermediates (diagnostics)
             # must NOT leak into the loss.
@@ -250,11 +283,21 @@ def make_train_step(mesh: jax.sharding.Mesh,
                     inter)[0]
                 if any(getattr(k, 'key', None) == 'router_aux_loss'
                        for k in path))
-            return loss + aux
+            return loss + aux * aux_scale
 
         return loss_fn
 
     def step(state: TrainState, batch: Dict[str, jax.Array]):
+        # Logical-axis rules must be ACTIVE while this body traces:
+        # flax's with_logical_constraint is a silent no-op with no rules
+        # bound, which discards every activation-sharding anchor in the
+        # model and leaves the SPMD partitioner free to pick conflicting
+        # shardings (symptom: 'Involuntary full rematerialization'
+        # warnings at residual/norm seams on multi-axis meshes).
+        with nn.logical_axis_rules(mesh_lib.logical_axis_rules()):
+            return _step(state, batch)
+
+    def _step(state: TrainState, batch: Dict[str, jax.Array]):
         tokens = batch['tokens']
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         mask = batch.get('mask')
@@ -285,23 +328,33 @@ def make_train_step(mesh: jax.sharding.Mesh,
             def split(x):
                 return x.reshape(k, mb, *x.shape[1:])
 
+            if mask is None:   # all-ones mask == unmasked mean loss
+                mask = jnp.ones((b, targets.shape[1]), jnp.float32)
+            # Exactness across unequal microbatch mask counts: keep each
+            # microbatch's CE in masked-SUM form scaled by 1/global
+            # token count, so the K losses (and grads) ADD to precisely
+            # the full-batch masked mean — no per-microbatch mean that
+            # would weight sparse microbatches' tokens more heavily.
+            inv_total = 1.0 / jnp.maximum(mask.sum(), 1.0)
+
+            def diff_sum_loss_fn(dp, mi, mt, mm):
+                return make_loss_fn(state, mi, mt, mm,
+                                    normalizer=inv_total,
+                                    aux_scale=1.0 / k)(to_full(dp))
+
             def micro(carry, xs):
                 acc_loss, acc_grads = carry
                 mi, mt, mm = xs
-                loss, grads = jax.value_and_grad(diff_loss_fn)(
+                loss, grads = jax.value_and_grad(diff_sum_loss_fn)(
                     diff_params, mi, mt, mm)
                 return (acc_loss + loss,
                         jax.tree.map(jnp.add, acc_grads, grads)), None
 
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), diff_params)
-            if mask is None:   # all-ones mask == unmasked mean loss
-                mask = jnp.ones((b, targets.shape[1]), jnp.float32)
             (loss, grads), _ = jax.lax.scan(
                 micro, (jnp.zeros((), jnp.float32), zeros),
                 (split(inputs), split(targets), split(mask)))
-            loss = loss / k
-            grads = jax.tree.map(lambda g: g / k, grads)
 
         grad_norm = optax.global_norm(grads)   # trainable leaves only
         if trainable is not None:
@@ -331,6 +384,11 @@ def make_eval_step(mesh: jax.sharding.Mesh,
     evaluation; same fused-loss path as training."""
 
     def eval_step(state: TrainState, batch: Dict[str, jax.Array]):
+        # Bind logical rules during tracing (see make_train_step.step).
+        with nn.logical_axis_rules(mesh_lib.logical_axis_rules()):
+            return _eval_step(state, batch)
+
+    def _eval_step(state: TrainState, batch: Dict[str, jax.Array]):
         tokens = batch['tokens']
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         mask = batch.get('mask')
@@ -391,6 +449,36 @@ class Trainer:
                 f'batch_size {cfg.batch_size} not divisible by '
                 f'grad_accum_steps {cfg.grad_accum_steps}')
         spec = cfg.mesh or mesh_lib.MeshSpec.auto(len(jax.devices()))
+        self._pp_microbatches = 0
+        if spec.stage > 1:
+            if spec.tensor > 1 or spec.seq > 1:
+                # The pipelined stage body runs its shards as plain
+                # local compute (shard_map over stage/data/fsdp only) —
+                # a tensor/seq axis would silently REPLICATE all work
+                # across those devices, delivering 1/tensor of the
+                # chips' throughput with no warning.
+                raise ValueError(
+                    'pipeline parallelism (stage > 1) currently '
+                    'composes with data/fsdp only; got '
+                    f'tensor={spec.tensor}, seq={spec.seq}')
+            m = cfg.pipeline_microbatches or spec.stage
+            step_batch = cfg.batch_size // max(cfg.grad_accum_steps, 1)
+            if m < spec.stage:
+                raise ValueError(
+                    f'pipeline_microbatches {m} must be >= stage count '
+                    f'{spec.stage} to fill the pipeline')
+            if step_batch % m:
+                raise ValueError(
+                    f'per-step batch {step_batch} (batch_size / '
+                    f'grad_accum_steps) not divisible by '
+                    f'{m} pipeline microbatches')
+            dp = spec.data * spec.fsdp
+            if (step_batch // m) % dp:
+                raise ValueError(
+                    f'pipeline microbatch size {step_batch // m} not '
+                    f'divisible by the data-sharding degree {dp} '
+                    '(data * fsdp)')
+            self._pp_microbatches = m
         self.mesh = mesh_lib.make_mesh(spec)
         self.state: Optional[TrainState] = None
         self._step_fn = None
@@ -405,8 +493,14 @@ class Trainer:
 
     def setup(self, rng: Optional[jax.Array] = None) -> None:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        apply_fn = None
+        if self._pp_microbatches:
+            from skypilot_tpu.parallel.pipeline import make_pipelined_apply
+            apply_fn = make_pipelined_apply(
+                self.model_config, self.mesh,
+                num_microbatches=self._pp_microbatches)
         self.state, self._shardings = create_sharded_state(
-            self.model_config, self.cfg, self.mesh, rng)
+            self.model_config, self.cfg, self.mesh, rng, apply_fn=apply_fn)
         trainable = None
         if getattr(self.model_config, 'lora_rank', 0):
             from skypilot_tpu.train import lora
@@ -457,7 +551,13 @@ class Trainer:
                 except StopIteration:   # short iterator: use what we got
                     break
                 losses.append(float(self._eval_fn(self.state, batch)))
-        mean = sum(losses) / max(len(losses), 1)
+        if not losses:
+            # An exhausted iterator must not read as a perfect model
+            # (loss 0, ppl 1): report NaN so downstream consumers see
+            # 'no data evaluated' instead of a silently great number.
+            return {'eval_loss': float('nan'),
+                    'perplexity': float('nan'), 'batches': 0}
+        mean = sum(losses) / len(losses)
         return {
             'eval_loss': mean,
             'perplexity': float(jnp.exp(jnp.asarray(mean))),

@@ -1,5 +1,7 @@
 """Compute path tests on the virtual 8-device CPU mesh: model forward,
 sharded init, train step under dp/fsdp/tp meshes."""
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -218,3 +220,91 @@ def test_trainer_evaluate_reports_perplexity():
     assert np.isfinite(out['eval_loss'])
     np.testing.assert_allclose(out['perplexity'],
                                np.exp(out['eval_loss']), rtol=1e-5)
+
+
+def test_grad_accum_masked_matches_full_batch():
+    """Unequal mask counts per microbatch must still reproduce the
+    full-batch masked loss/grads exactly: the accumulation keeps each
+    microbatch's CE in masked-sum form and normalizes once by the global
+    token count (ADVICE r1: the per-microbatch-mean form silently
+    overweights sparse microbatches)."""
+    cfg = get_model_config('llama-debug')
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32,
+                       warmup_steps=2, total_steps=4)
+    state, _ = create_sharded_state(cfg, tcfg, mesh, jax.random.PRNGKey(0))
+    batch = dict(next(synthetic_data(8, 32, cfg.vocab_size)))
+    # Wildly unequal token counts: rows 0-3 keep 30 tokens, rows 4-7
+    # keep 3 — microbatches of a 4-way split see different mask sums.
+    mask = np.zeros((8, 33), np.float32)
+    mask[:4, :30] = 1.0
+    mask[4:, :3] = 1.0
+    batch['mask'] = jnp.asarray(mask)
+    full = make_train_step(mesh)
+    micro = make_train_step(mesh, grad_accum_steps=4)
+    with mesh:
+        s_full, m_full = full(state, batch)
+        state2, _ = create_sharded_state(cfg, tcfg, mesh,
+                                         jax.random.PRNGKey(0))
+        s_micro, m_micro = micro(state2, batch)
+    np.testing.assert_allclose(float(m_full['loss']),
+                               float(m_micro['loss']), rtol=1e-5)
+    np.testing.assert_allclose(float(m_full['grad_norm']),
+                               float(m_micro['grad_norm']), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(s_full.params),
+                    jax.tree.leaves(s_micro.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+
+def test_trainer_evaluate_empty_iterator_is_nan():
+    """An exhausted eval iterator must NOT report loss 0 / ppl 1 (reads
+    as a perfect model); it reports NaN with batches=0 (ADVICE r1)."""
+    from skypilot_tpu.train.trainer import Trainer
+    tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32)
+    t = Trainer(tcfg)
+    t.setup()
+    out = t.evaluate(iter(()), num_batches=2)
+    assert out['batches'] == 0
+    assert np.isnan(out['eval_loss']) and np.isnan(out['perplexity'])
+
+
+@pytest.mark.e2e
+def test_spmd_partitioner_no_full_remat_warnings():
+    """VERDICT r1 #3: the (data=2, fsdp=2, tensor=2) train step must
+    compile without 'Involuntary full rematerialization' SPMD warnings
+    (replicate-then-repartition reshards = wasted HBM + ICI on real
+    multi-chip).  Subprocess: the warning is emitted by XLA's C++ logger,
+    so it can only be observed on a fresh process's stderr."""
+    import subprocess
+    import sys
+    prog = (
+        "import jax, jax.numpy as jnp\n"
+        "from skypilot_tpu.models.llama import LlamaConfig\n"
+        "from skypilot_tpu.parallel import MeshSpec, make_mesh\n"
+        "from skypilot_tpu.train import TrainConfig, create_sharded_state\n"
+        "from skypilot_tpu.train.trainer import make_train_step\n"
+        "cfg = LlamaConfig(name='w', vocab_size=512, hidden_size=128,\n"
+        "                  intermediate_size=256, num_layers=2,\n"
+        "                  num_heads=8, num_kv_heads=4, max_seq_len=128,\n"
+        "                  tie_embeddings=True)\n"
+        "mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))\n"
+        "tcfg = TrainConfig(model='w', batch_size=8, seq_len=64,\n"
+        "                   warmup_steps=1, total_steps=2)\n"
+        "state, _ = create_sharded_state(cfg, tcfg, mesh,\n"
+        "                                jax.random.PRNGKey(0))\n"
+        "step = make_train_step(mesh, grad_accum_steps=2)\n"
+        "with mesh:\n"
+        "    state, m = step(state, {'tokens': jnp.zeros((8, 65),\n"
+        "                                               jnp.int32)})\n"
+        "    jax.block_until_ready(state.params)\n"
+        "print('OK', float(m['loss']))\n")
+    env = dict(os.environ,
+               JAX_PLATFORMS='cpu',
+               XLA_FLAGS='--xla_force_host_platform_device_count=8')
+    res = subprocess.run([sys.executable, '-c', prog], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert 'OK' in res.stdout
+    assert 'Involuntary full rematerialization' not in res.stderr, (
+        [l for l in res.stderr.splitlines() if 'rematerialization' in l])

@@ -7,8 +7,7 @@ import pytest
 
 from skypilot_tpu.models.llama import LlamaConfig
 from skypilot_tpu.parallel import MeshSpec, make_mesh
-from skypilot_tpu.parallel.pipeline import (PipelinedLM,
-                                            make_pipelined_train_step,
+from skypilot_tpu.parallel.pipeline import (make_pipelined_apply,
                                             pipeline)
 
 P = jax.sharding.PartitionSpec
@@ -89,52 +88,85 @@ def test_pipeline_requires_enough_microbatches():
             pipeline(_simple_stage_fn, params, mbs, (), mesh)
 
 
-def test_pipelined_lm_trains():
-    cfg = LlamaConfig(name='pp-test', vocab_size=128, hidden_size=32,
-                      intermediate_size=64, num_layers=4, num_heads=4,
-                      num_kv_heads=2, max_seq_len=64, tie_embeddings=True,
-                      dtype=jnp.float32)
-    mesh = make_mesh(MeshSpec(stage=4, data=2))
-    model = PipelinedLM(cfg, num_stages=4, num_microbatches=4)
-    init_state, step = make_pipelined_train_step(model, mesh,
-                                                 learning_rate=1e-2)
-    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0, 128)
-    with mesh:
-        params, opt_state = init_state(jax.random.PRNGKey(1),
-                                       tokens[:, :-1])
-        losses = []
-        for _ in range(5):
-            params, opt_state, loss = step(params, opt_state, tokens)
-            losses.append(float(loss))
-    assert all(np.isfinite(l) for l in losses)
-    assert losses[-1] < losses[0]
-
-
-def test_pipelined_lm_matches_unpipelined_forward():
-    """The pipelined forward equals running the same stage params
-    sequentially (scheduling adds no numerics)."""
+def test_pipelined_apply_matches_model_forward():
+    """make_pipelined_apply consumes the STANDARD flax param tree and
+    must reproduce Llama.apply logits exactly (scheduling adds no
+    numerics, tree restructuring is a permutation)."""
+    from skypilot_tpu.models.llama import Llama
     cfg = LlamaConfig(name='pp-eq', vocab_size=64, hidden_size=16,
                       intermediate_size=32, num_layers=2, num_heads=2,
                       num_kv_heads=2, max_seq_len=32, tie_embeddings=True,
                       dtype=jnp.float32)
     mesh = make_mesh(MeshSpec(stage=2, data=4))
-    model = PipelinedLM(cfg, num_stages=2, num_microbatches=2)
+    model = Llama(cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(5), (8, 16), 0, 64)
+    variables = model.init(jax.random.PRNGKey(6), tokens)
+    expected = model.apply(variables, tokens)
+    pp_apply = make_pipelined_apply(cfg, mesh, num_microbatches=2)
     with mesh:
-        params = model.init(jax.random.PRNGKey(6), tokens)
-        logits = jax.jit(
-            lambda p, t: model.apply(p, t, mesh))(params, tokens)
-
-    # Sequential re-implementation with the same params.
-    from skypilot_tpu.models.llama import rmsnorm
-    x = params['embed'].astype(cfg.dtype)[tokens]
-    positions = jnp.arange(16)[None]
-    for s in range(2):
-        stage_params = jax.tree.map(lambda a, s=s: a[s], params['stages'])
-        x = model._stage_module.apply({'params': stage_params}, x,
-                                      positions)
-    x = rmsnorm(x, params['final_norm'], cfg.norm_eps)
-    expected = x.astype(jnp.float32) @ params['embed'].astype(
-        jnp.float32).T
+        logits = jax.jit(pp_apply)(variables, tokens)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(expected),
                                atol=2e-4, rtol=2e-4)
+    # hidden_only (the fused-loss path) must match too.
+    expected_h = model.apply(variables, tokens, hidden_only=True)
+    with mesh:
+        hidden = jax.jit(
+            lambda v, t: pp_apply(v, t, hidden_only=True))(variables,
+                                                           tokens)
+    np.testing.assert_allclose(np.asarray(hidden), np.asarray(expected_h),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_trainer_pipeline_matches_single_stage():
+    """VERDICT r1 #4: TrainConfig(mesh=MeshSpec(stage=2, ...)) trains
+    through the ordinary Trainer entry — same init (standard param
+    tree), same optimizer, fused loss — and the loss matches the
+    single-stage run at equal seeds."""
+    from skypilot_tpu.train import TrainConfig
+    from skypilot_tpu.train.trainer import Trainer, synthetic_data
+    kw = dict(model='llama-debug', batch_size=8, seq_len=32,
+              warmup_steps=2, total_steps=3)
+    pp = Trainer(TrainConfig(mesh=MeshSpec(stage=2, data=2, fsdp=2), **kw))
+    pp.setup()
+    out_pp = pp.train(data=synthetic_data(8, 32, 256), num_steps=3)
+    ref = Trainer(TrainConfig(mesh=MeshSpec(data=2, fsdp=4), **kw))
+    ref.setup()
+    out_ref = ref.train(data=synthetic_data(8, 32, 256), num_steps=3)
+    assert np.isfinite(out_pp['final_loss'])
+    np.testing.assert_allclose(out_pp['final_loss'],
+                               out_ref['final_loss'], rtol=2e-2)
+
+
+def test_trainer_pipeline_with_grad_accum():
+    """stage>1 composes with grad_accum_steps (each accumulation
+    microbatch further splits into pipeline microbatches)."""
+    from skypilot_tpu.train import TrainConfig
+    from skypilot_tpu.train.trainer import Trainer, synthetic_data
+    cfg = TrainConfig(model='llama-debug', batch_size=16, seq_len=32,
+                      warmup_steps=2, total_steps=2, grad_accum_steps=2,
+                      mesh=MeshSpec(stage=2, data=2, fsdp=2))
+    t = Trainer(cfg)
+    t.setup()
+    out = t.train(data=synthetic_data(16, 32, 256), num_steps=2)
+    assert np.isfinite(out['final_loss'])
+
+
+def test_trainer_pipeline_validations():
+    from skypilot_tpu.train import TrainConfig
+    from skypilot_tpu.train.trainer import Trainer
+    with pytest.raises(ValueError, match='microbatches'):
+        Trainer(TrainConfig(model='llama-debug', batch_size=6, seq_len=32,
+                            mesh=MeshSpec(stage=2, data=4),
+                            pipeline_microbatches=4))
+    with pytest.raises(ValueError, match='fill the pipeline'):
+        Trainer(TrainConfig(model='llama-debug', batch_size=8, seq_len=32,
+                            mesh=MeshSpec(stage=4, data=2),
+                            pipeline_microbatches=2))
+    with pytest.raises(ValueError, match='llama-family'):
+        t = Trainer(TrainConfig(model='gpt2', batch_size=8, seq_len=32,
+                                mesh=MeshSpec(stage=2, data=4)))
+        t.setup()
+    # tensor/seq axes would silently replicate the pipelined stage body.
+    with pytest.raises(ValueError, match='data/fsdp only'):
+        Trainer(TrainConfig(model='llama-debug', batch_size=8, seq_len=32,
+                            mesh=MeshSpec(stage=2, tensor=4)))
