@@ -143,12 +143,13 @@ class InferenceEngine:
                  params: Optional[Any] = None,
                  rng: Optional[jax.Array] = None,
                  mesh: Optional[jax.sharding.Mesh] = None):
+        from skypilot_tpu.models.mixtral import MixtralConfig
         self._mesh = mesh
         self.model_config = model_config
         self.cfg = cfg or InferConfig()
-        if not isinstance(model_config, LlamaConfig):
+        if not isinstance(model_config, (LlamaConfig, MixtralConfig)):
             raise TypeError(
-                'InferenceEngine currently supports the Llama family '
+                'InferenceEngine supports the Llama and Mixtral families '
                 f'(KV-cache decode path); got {type(model_config).__name__}')
         if mesh is not None:
             tp = dict(mesh.shape).get('tensor', 1)
@@ -173,7 +174,15 @@ class InferenceEngine:
         if self.cfg.prefill_lanes < 1:
             raise ValueError(f'prefill_lanes must be >= 1 '
                              f'(got {self.cfg.prefill_lanes})')
-        self.model = Llama(model_config)
+        # Mixtral rides the same engine: shared attention geometry means
+        # llama.init_cache covers its KV cache, and the MoE block's
+        # router + experts simply run on the new tokens inside the same
+        # jitted prefill/decode (expert weights shard over 'tensor' by
+        # their 'expert' logical axis = expert-parallel TP serving).
+        # Parity: the reference delegates Mixtral serving to vLLM
+        # (llm/mixtral/serve.yaml:38).
+        from skypilot_tpu.models import registry as model_registry
+        self.model = model_registry.build_model(model_config)
         buckets = tuple(b for b in self.cfg.prefill_buckets
                         if b <= self.cfg.max_cache_len)
         if not buckets or buckets[-1] < self.cfg.max_cache_len:

@@ -96,7 +96,13 @@ class InferenceServer:
     def submit_stream(self, req: Request, timeout: float = 300.0):
         """Submit and yield ('tokens', [ids]) chunks as they decode,
         terminated by ('done', RequestResult) — or ('timeout', None) if
-        the deadline passes between events.
+        `timeout` passes with no new chunk.
+
+        `timeout` is an INACTIVITY bound, not a total-duration bound: a
+        generation still actively producing tokens is never cut off; the
+        deadline resets on every received chunk.  (Queue depth under
+        load shows up as time-to-first-chunk, which the same bound
+        covers.)
 
         One queue carries both chunks and the terminal sentinel: the
         engine enqueues every chunk (under its lock) BEFORE _deliver
@@ -108,13 +114,11 @@ class InferenceServer:
         chunks: 'queue.Queue' = queue.Queue()
         req.stream_cb = lambda toks: chunks.put(('tokens', toks))
         self._stream_queues[rid] = chunks
-        deadline = time.monotonic() + timeout
         self._queue.put(req)
         try:
             while True:
                 try:
-                    item = chunks.get(
-                        timeout=max(0.0, deadline - time.monotonic()))
+                    item = chunks.get(timeout=timeout)
                 except queue.Empty:
                     yield ('timeout', None)
                     return
@@ -292,6 +296,17 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
     """
     import jax.numpy as jnp
 
+    if tensor_parallel and tensor_parallel > 1:
+        # Validate BEFORE the (potentially tens-of-GB) weight load below
+        # — a flag typo must fail in milliseconds.
+        import jax
+        n_local = len(jax.devices())
+        if tensor_parallel > n_local:
+            raise ValueError(
+                f'--tensor-parallel {tensor_parallel} exceeds the '
+                f'{n_local} visible device(s); a mesh needs one chip '
+                'per shard')
+
     params = None
     tokenizer_implied = False   # tokenizer_name defaulted from hf_model
     if hf_model:
@@ -303,10 +318,11 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         # before the (potentially tens-of-GB) weight load.
         mt = getattr(transformers.AutoConfig.from_pretrained(hf_model),
                      'model_type', None)
-        if mt not in ('llama', 'qwen2'):
+        if mt not in ('llama', 'qwen2', 'mixtral'):
             raise ValueError(
-                f'--hf-model must be a llama-family checkpoint '
-                f"(model_type 'llama' or 'qwen2'); got model_type={mt!r}")
+                f'--hf-model must be a llama- or mixtral-family '
+                f"checkpoint (model_type 'llama', 'qwen2' or 'mixtral'); "
+                f'got model_type={mt!r}')
         # Serving: bf16 weights end to end (half the host RAM and HBM,
         # MXU-native).
         model_config, tree = hf_import.load_hf_model(

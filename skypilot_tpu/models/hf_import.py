@@ -486,12 +486,30 @@ def load_hf_model(name_or_path: str, dtype=None,
     # dtype='auto' keeps the checkpoint's stored precision (bf16 for
     # modern llamas — half the host RAM of the fp32 default);
     # low_cpu_mem_usage avoids a second full-size init allocation.
-    try:
-        model = cls.from_pretrained(name_or_path, dtype='auto',
-                                    low_cpu_mem_usage=True)
-    except TypeError:   # transformers < the torch_dtype→dtype rename
-        model = cls.from_pretrained(name_or_path, torch_dtype='auto',
-                                    low_cpu_mem_usage=True)
+    # The kwarg was renamed torch_dtype→dtype in transformers 4.56, and
+    # from_pretrained swallows unknown kwargs without raising — so pick
+    # by version (a TypeError fallback would never fire and the old
+    # spelling would silently load fp32 at 2x host RAM).
+    ver = tuple(int(x) for x in transformers.__version__.split('.')[:2])
+    dtype_kw = 'dtype' if ver >= (4, 56) else 'torch_dtype'
+    model = cls.from_pretrained(name_or_path, low_cpu_mem_usage=True,
+                                **{dtype_kw: 'auto'})
+    # Belt-and-braces: if the kwarg was ignored anyway, the model comes
+    # back fp32 even though the checkpoint stores a narrower dtype.
+    stored = getattr(hf_cfg, 'dtype', None) or getattr(
+        hf_cfg, 'torch_dtype', None)
+    first_param = next(iter(model.parameters()), None)
+    loaded = None if first_param is None else first_param.dtype
+    if (stored is not None and loaded is not None
+            and str(stored).replace('torch.', '') != 'float32'
+            and str(loaded) == 'torch.float32'):
+        import warnings
+        warnings.warn(
+            f'{name_or_path}: checkpoint stores {stored} but transformers '
+            f'{transformers.__version__} loaded fp32 (dtype kwarg ignored) '
+            '— converting; expect a transient 2x host-RAM peak')
+        model = model.to(stored if not isinstance(stored, str)
+                         else getattr(__import__('torch'), stored))
     cfg = config_from_hf(hf_cfg, name=name_or_path)
     if dtype is not None:
         cfg = dataclasses.replace(cfg, dtype=dtype)
