@@ -640,19 +640,44 @@ def serve_status():
 @click.argument('service_name')
 @click.argument('entrypoint', nargs=-1, required=True)
 @_resource_flags(include_name=False)
+@click.option('--mode', type=click.Choice(['rolling', 'blue_green']),
+              default='rolling', show_default=True,
+              help='rolling: bounded surge of one; blue_green: full new '
+                   'fleet reaches READY before old replicas drain.')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_update(service_name, entrypoint, workdir, cloud, tpus,
                  cpus, memory, use_spot, region, zone, num_nodes, env,
-                 yes):
-    """Rolling-update a service to a new task/spec."""
+                 mode, yes):
+    """Update a service to a new task/spec (rolling or blue-green)."""
     from skypilot_tpu import serve as serve_lib
     task = _make_task(entrypoint, None, workdir, cloud, tpus, cpus, memory,
                       use_spot, region, zone, num_nodes, env)
     if not yes:
         click.confirm(f'Update service {service_name!r}?', default=True,
                       abort=True)
-    version = serve_lib.update(task, service_name)
-    click.echo(f'Service {service_name!r} rolling to version {version}.')
+    version = serve_lib.update(task, service_name, mode=mode)
+    click.echo(f'Service {service_name!r} updating ({mode}) to version '
+               f'{version}.')
+
+
+@serve.command('terminate-replica')
+@click.argument('service_name')
+@click.argument('replica_id', type=int)
+@click.option('--purge', is_flag=True, default=False,
+              help='Drop the replica record instead of keeping it '
+                   'visible in `serve status`.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_terminate_replica(service_name, replica_id, purge, yes):
+    """Tear down one replica of a service (parity: sky serve
+    terminate-replica, sky/serve/core.py:507)."""
+    from skypilot_tpu import serve as serve_lib
+    if not yes:
+        click.confirm(
+            f'Terminate replica {replica_id} of {service_name!r}?',
+            default=True, abort=True)
+    serve_lib.terminate_replica(service_name, replica_id, purge=purge)
+    click.echo(f'Replica {replica_id} of {service_name!r} is '
+               'terminating.')
 
 
 @serve.command('down')

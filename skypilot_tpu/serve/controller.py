@@ -17,6 +17,7 @@ from skypilot_tpu.serve import autoscalers, constants, serve_state
 from skypilot_tpu.serve.autoscalers import DecisionOperator
 from skypilot_tpu.serve.replica_managers import ReplicaManager
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.serve_utils import UpdateMode
 from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
 
 logger = logsys.init_logger(__name__)
@@ -31,6 +32,11 @@ class ServeController:
         self.spec = spec
         self.port = port
         self.version = 1
+        self.update_mode = UpdateMode.ROLLING
+        # Size of the pre-update fleet, recorded when an update arrives:
+        # replacement sizing and drain pacing both work against the LIVE
+        # (possibly autoscaled-above-min) fleet, not min_replicas.
+        self._update_old_fleet = 0
         self.autoscaler = autoscalers.Autoscaler.make(spec)
         self.replica_manager = ReplicaManager(service_name, spec, task_yaml)
         self._stop = threading.Event()
@@ -51,11 +57,21 @@ class ServeController:
         if path == '/controller/update_service':
             spec = SkyTpuServiceSpec.from_json(payload['spec'])
             task_yaml = payload['task_yaml']
+            self.update_mode = UpdateMode(payload.get('mode', 'rolling'))
             serve_state.set_service_spec(self.service_name, spec.to_json(),
                                          task_yaml)
             svc = serve_state.get_service(self.service_name)
             self.version = svc['version']
             self.spec = spec
+            # Fleet to replace = current READY capacity (any version
+            # older than the new one).  READY, not alive: a second
+            # update issued mid-update must not count the half-built
+            # previous-update fleet too — that would inflate the
+            # replacement target to old+new combined.
+            self._update_old_fleet = sum(
+                1 for r in serve_state.get_replicas(self.service_name)
+                if r['version'] < self.version and
+                ReplicaStatus(r['status']) == ReplicaStatus.READY)
             # Re-make the autoscaler: the update may switch between fixed
             # and request-rate scaling.  Carry the QPS window over so an
             # in-place spec tweak does not forget the current load.
@@ -132,21 +148,30 @@ class ServeController:
                 is_spot=bool(r['is_spot']),
             ) for r in serve_state.get_replicas(self.service_name)
         ]
-        for decision in self.autoscaler.evaluate_scaling(replicas):
-            if decision.operator == DecisionOperator.SCALE_UP:
-                self.replica_manager.scale_up(
-                    use_spot=decision.target.get('use_spot', False))
-            else:
-                self.replica_manager.scale_down(
-                    decision.target['replica_id'])
-
-        self._rolling_update(replicas)
+        update_in_progress = any(
+            r.version < self.version and r.alive for r in replicas)
+        if not update_in_progress:
+            for decision in self.autoscaler.evaluate_scaling(replicas):
+                if decision.operator == DecisionOperator.SCALE_UP:
+                    self.replica_manager.scale_up(
+                        use_spot=decision.target.get('use_spot', False))
+                else:
+                    self.replica_manager.scale_down(
+                        decision.target['replica_id'])
+        else:
+            # While an update is replacing the fleet, _update_replicas
+            # owns sizing: the autoscaler's surplus drain (old+new alive
+            # > its target, _scale_down_order preferring OLD versions)
+            # would otherwise tear down old READY replicas the pacing
+            # below is deliberately keeping alive.  Demand changes defer
+            # until the update completes.
+            self._update_replicas(replicas)
         self._refresh_service_status(replicas)
 
-    def _rolling_update(
+    def _update_replicas(
             self, replicas: List[autoscalers.ReplicaView]) -> None:
-        """Replace old-version replicas once enough latest-version replicas
-        are READY (parity: rolling UpdateMode,
+        """Replace old-version replicas per the active UpdateMode
+        (parity: sky/serve/core.py:309 rolling|blue_green consumed by
         replica_managers.py:1176)."""
         old = [r for r in replicas if r.version < self.version and r.alive]
         if not old:
@@ -156,13 +181,43 @@ class ServeController:
             r.status == ReplicaStatus.READY)
         latest_alive = sum(
             1 for r in replicas if r.version >= self.version and r.alive)
-        # Launch replacements first, then drain old ones.
-        if latest_alive < self.spec.min_replicas:
-            for _ in range(self.spec.min_replicas - latest_alive):
-                self.replica_manager.scale_up()
-        if latest_ready >= self.spec.min_replicas:
-            for r in old:
+        # Replace the LIVE fleet, not min_replicas: an autoscaled service
+        # holding 5 replicas under load gets 5 replacements, and drains
+        # pace against that size (self._update_old_fleet is recorded at
+        # update time; 0 = controller restarted mid-update, degrade to
+        # min_replicas).
+        target = max(self.spec.min_replicas, self._update_old_fleet)
+        if self.update_mode is UpdateMode.BLUE_GREEN:
+            # Bring the full green fleet up first; blue drains only once
+            # green is fully READY (no capacity dip, 2x resources).
+            if latest_alive < target:
+                for _ in range(target - latest_alive):
+                    self.replica_manager.scale_up()
+            if latest_ready >= target:
+                for r in old:
+                    self.replica_manager.scale_down(r.replica_id)
+            return
+        # Rolling: surge of ONE — launch a single new replica at a time
+        # (next one only once it is READY) — with CUMULATIVE drain
+        # pacing: each new READY replica grants exactly one old-drain
+        # permit, and permits already spent (old fleet shrinkage) are
+        # subtracted, so ready capacity never collapses toward
+        # min_replicas faster than replacements arrive.
+        if latest_alive < target and latest_alive == latest_ready:
+            self.replica_manager.scale_up()
+        old_ready = [r for r in old if r.status == ReplicaStatus.READY]
+        old_not_ready = [r for r in old
+                         if r.status != ReplicaStatus.READY]
+        # Not-yet-ready old replicas add no capacity; drain them once a
+        # replacement is in flight.  (Conservative: they consume drain
+        # permits via the fleet-shrinkage accounting below.)
+        if latest_alive > 0:
+            for r in old_not_ready:
                 self.replica_manager.scale_down(r.replica_id)
+        old_drained = max(0, self._update_old_fleet - len(old))
+        permits = latest_ready - old_drained
+        for r in old_ready[:max(0, min(permits, len(old_ready)))]:
+            self.replica_manager.scale_down(r.replica_id)
 
     def _refresh_service_status(
             self, replicas: List[autoscalers.ReplicaView]) -> None:

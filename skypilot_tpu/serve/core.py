@@ -145,8 +145,15 @@ def _controller_envs() -> Dict[str, str]:
 
 
 @usage.entrypoint('serve.update')
-def update(task: Task, service_name: str) -> int:
-    """Rolling update to a new task/spec; returns the new version."""
+def update(task: Task, service_name: str,
+           mode: str = 'rolling') -> int:
+    """Update to a new task/spec; returns the new version.
+
+    mode: 'rolling' (bounded surge of one, default) or 'blue_green'
+    (full new fleet reaches READY before any old replica drains).
+    Parity: sky/serve/core.py:309 UpdateMode.
+    """
+    mode = serve_utils.UpdateMode(mode).value   # validate early
     spec = _validate_service_task(task)
     local_yaml = _dump_task_yaml(task)
     remote_yaml = (f'~/.skytpu/serve/tasks/{service_name}-'
@@ -161,7 +168,7 @@ def update(task: Task, service_name: str) -> int:
     finally:
         os.remove(local_yaml)
     cmd = ServeCodeGen.update_service(service_name, spec.to_json(),
-                                      remote_yaml)
+                                      remote_yaml, mode=mode)
     rc, stdout, stderr = head.run(cmd, require_outputs=True)
     if rc != 0:
         raise exceptions.CommandError(rc, 'serve update', stderr[-800:])

@@ -4,6 +4,7 @@ Parity: sky/serve/serve_utils.py — the ServeCodeGen twin (client executes
 short python programs on the serve-controller host), service name
 validation, and status formatting.
 """
+import enum
 import re
 from typing import Any, Dict, List, Optional
 
@@ -11,6 +12,23 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.podlet import codegen as podlet_codegen
 
 parse_result = podlet_codegen.parse_result
+
+
+class UpdateMode(enum.Enum):
+    """How `serve.update` replaces old-version replicas.
+
+    Parity: sky/serve/serve_utils.py UpdateMode (consumed at
+    sky/serve/core.py:309).
+
+    ROLLING    — bounded surge: launch ONE new-version replica at a time
+                 and drain an old one as each turns READY; total capacity
+                 stays near min_replicas throughout.
+    BLUE_GREEN — bring up a FULL new-version fleet first; old replicas
+                 drain only after every new one is READY (2x resources
+                 during the update, zero capacity dip).
+    """
+    ROLLING = 'rolling'
+    BLUE_GREEN = 'blue_green'
 
 _IMPORTS = ('from skypilot_tpu.serve import serve_state\n'
             'from skypilot_tpu.serve import constants as serve_constants')
@@ -102,7 +120,8 @@ class ServeCodeGen:
         return _wrap(body)
 
     @staticmethod
-    def update_service(name: str, spec_json: str, task_yaml: str) -> str:
+    def update_service(name: str, spec_json: str, task_yaml: str,
+                       mode: str = 'rolling') -> str:
         """POST the new spec to the service's controller API."""
         body = (
             f'import urllib.request\n'
@@ -114,7 +133,7 @@ class ServeCodeGen:
             f'        "http://127.0.0.1:%d/controller/update_service" '
             f'% svc["controller_port"],\n'
             f'        data=json.dumps({{"spec": {spec_json!r}, '
-            f'"task_yaml": {task_yaml!r}}}).encode(),\n'
+            f'"task_yaml": {task_yaml!r}, "mode": {mode!r}}}).encode(),\n'
             f'        headers={{"Content-Type": "application/json"}})\n'
             f'    with urllib.request.urlopen(req, timeout=10) as r:\n'
             f'        _emit(json.loads(r.read()))\n')

@@ -149,3 +149,12 @@ def test_completion_scripts(runner):
         r = runner.invoke(cli.cli, ['completion', shell])
         assert r.exit_code == 0, (shell, r.output)
         assert 'skytpu' in r.output
+
+
+def test_serve_group_lists_terminate_replica_and_update_mode(runner):
+    result = runner.invoke(cli.cli, ['serve', '--help'])
+    assert result.exit_code == 0
+    assert 'terminate-replica' in result.output
+    result = runner.invoke(cli.cli, ['serve', 'update', '--help'])
+    assert result.exit_code == 0
+    assert 'blue_green' in result.output
