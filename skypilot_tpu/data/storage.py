@@ -65,14 +65,18 @@ class Storage:
         self._validate_source()
 
     def _validate_source(self) -> None:
+        from skypilot_tpu.data import data_transfer
         sources = (self.source if isinstance(self.source, list) else
                    [self.source] if self.source else [])
         for src in sources:
             if src.startswith('gs://'):
                 continue
-            if src.startswith(('s3://', 'r2://', 'cos://')):
-                raise exceptions.StorageSourceError(
-                    f'Only gs:// and local sources supported, got {src}')
+            if data_transfer.is_external_cloud_uri(src):
+                # s3:// / r2:// / cos://: ingested into the GCS bucket at
+                # upload time (data_transfer.transfer_to_gcs) — the TPU
+                # slice itself only ever talks to GCS.  Parity:
+                # sky/data/data_transfer.py:39-193.
+                continue
             if not os.path.exists(os.path.expanduser(src)):
                 raise exceptions.StorageSourceError(
                     f'Local source not found: {src}')
@@ -97,12 +101,23 @@ class Storage:
                     f'mb failed: {res.stderr[-500:]}')
 
     def upload(self) -> None:
-        """Sync local source(s) into the bucket."""
+        """Sync local source(s) into the bucket; external-cloud sources
+        (s3:// / r2:// / cos://) are ingested via data_transfer."""
+        from skypilot_tpu.data import data_transfer
         self.ensure_bucket()
         sources = (self.source if isinstance(self.source, list) else
                    [self.source] if self.source else [])
         for src in sources:
             if src.startswith('gs://'):
+                continue
+            if data_transfer.is_external_cloud_uri(src):
+                try:
+                    data_transfer.transfer_to_gcs(src, self.bucket_uri)
+                except exceptions.StorageError as e:
+                    state.add_or_update_storage(
+                        self.name, self.to_handle(),
+                        StorageStatus.UPLOAD_FAILED)
+                    raise exceptions.StorageUploadError(str(e)) from e
                 continue
             src = os.path.expanduser(src)
             dst = self.bucket_uri

