@@ -87,6 +87,21 @@ def build_host_env(info: ClusterInfo, rank: int, job_id: int,
         common.ENV_VAR_NUM_SLICES: str(info.num_slices),
         'SKYTPU_INTERNAL_JOB_ID': str(job_id),
     })
+    if info.num_slices > 1:
+        # Real Cloud TPU multislice: libtpu's DCN transport initializes
+        # from the literal MEGASCALE_* variables when
+        # jax.distributed.initialize() runs — without them, a multi-slice
+        # jax job silently trains as num_slices ISOLATED jobs.  Only
+        # emitted when there genuinely are >1 slices: setting them on a
+        # single slice makes libtpu wait for a nonexistent peer.
+        # (docs/multislice.md has the recipe.)
+        env.update({
+            common.ENV_VAR_MEGASCALE_COORDINATOR:
+                f'{ips[0]}:{common.MEGASCALE_PORT}',
+            common.ENV_VAR_MEGASCALE_NUM_SLICES: str(info.num_slices),
+            common.ENV_VAR_MEGASCALE_SLICE_ID: str(slice_id),
+            common.ENV_VAR_MEGASCALE_PORT: str(common.MEGASCALE_PORT),
+        })
     return env
 
 

@@ -30,8 +30,20 @@ ENV_VAR_NUM_PROCESSES = 'SKYTPU_NUM_PROCESSES'
 # Multi-slice (DCN) topology, MEGASCALE-style.
 ENV_VAR_SLICE_ID = 'SKYTPU_SLICE_ID'
 ENV_VAR_NUM_SLICES = 'SKYTPU_NUM_SLICES'
+# The literal MEGASCALE_* variables libtpu's multislice (DCN) transport
+# keys off — exported VERBATIM (not SKYTPU_-prefixed) on multi-slice
+# clusters so `jax.distributed.initialize()` on a real Cloud TPU
+# multislice works with no recipe code.  Parity intent: SURVEY.md §2.9
+# gang-scheduling row ("export MEGASCALE_*/TPU_*-style topology vars").
+ENV_VAR_MEGASCALE_COORDINATOR = 'MEGASCALE_COORDINATOR_ADDRESS'
+ENV_VAR_MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
+ENV_VAR_MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+ENV_VAR_MEGASCALE_PORT = 'MEGASCALE_PORT'
 
 JAX_COORDINATOR_PORT = 8476
+# DCN transport rendezvous port (distinct from the jax.distributed
+# coordinator: megascale runs its own server on slice 0's first host).
+MEGASCALE_PORT = 8477
 
 USER_HASH_LENGTH = 8
 CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
