@@ -821,6 +821,33 @@ def bench_delete(benchmark, yes):
     click.echo(f'Benchmark {benchmark!r} deleted.')
 
 
+
+
+_INFER_PROFILES = {
+    # Measured operating points for a 7B-class model on one v5e chip
+    # (docs/performance.md): the SAME decode window wins both axes on
+    # dispatch-latency-dominated hardware; the profiles trade slot count
+    # and prefill admission width (burst TTFT) for peak tok/s.
+    'latency': {'num_slots': 32, 'decode_steps': 8, 'prefills_per_gap': 2},
+    'throughput': {'num_slots': 48, 'decode_steps': 8,
+                   'prefills_per_gap': 4},
+}
+
+
+def _apply_infer_profile(ctx, profile, values):
+    """Profile presets fill any knob the user did NOT set explicitly."""
+    if not profile:
+        return values
+    import click.core as _cc
+    out = dict(values)
+    for key, preset in _INFER_PROFILES[profile].items():
+        if key not in out:
+            continue
+        src = ctx.get_parameter_source(key)
+        if src == _cc.ParameterSource.DEFAULT:
+            out[key] = preset
+    return out
+
 # -------------------------------------------------------------- infer group
 
 
@@ -851,18 +878,39 @@ def infer():
                    'minor quality loss possible.')
 @click.option('--tensor-parallel', default=0, type=int,
               help='Shard the model over N local chips (TP serving).')
-def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
-                eos_id, decode_steps, hf_model, cache_dtype,
-                tensor_parallel):
+@click.option('--weight-dtype', default='bf16',
+              type=click.Choice(['bf16', 'int8']),
+              help='Weight storage. int8 halves weight HBM (a 7B fits '
+                   'one 16 GB chip) and speeds weight-streaming-bound '
+                   'decode; per-channel scales keep logits close.')
+@click.option('--profile', default=None,
+              type=click.Choice(sorted(_INFER_PROFILES)),
+              help='Preset operating point (docs/performance.md); '
+                   'explicit flags win over the preset.')
+@click.option('--prefills-per-gap', type=int, default=4,
+              help='Max prefills between decode windows '
+                   '(latency/throughput knob).')
+@click.pass_context
+def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
+                tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
+                tensor_parallel, weight_dtype, profile,
+                prefills_per_gap):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
+    knobs = _apply_infer_profile(ctx, profile, {
+        'num_slots': num_slots, 'decode_steps': decode_steps,
+        'prefills_per_gap': prefills_per_gap})
+    num_slots, decode_steps = knobs['num_slots'], knobs['decode_steps']
+    prefills_per_gap = knobs['prefills_per_gap']
     click.echo(f'serving {hf_model or model} on {host}:{port}')
     infer_server.run(model=model, host=host, port=port,
                      num_slots=num_slots, max_cache_len=max_cache_len,
                      tokenizer_name=tokenizer, eos_id=eos_id,
                      decode_steps=decode_steps, hf_model=hf_model,
                      cache_dtype=cache_dtype,
-                     tensor_parallel=tensor_parallel)
+                     tensor_parallel=tensor_parallel,
+                     weight_dtype=weight_dtype,
+                     prefills_per_gap=prefills_per_gap)
 
 
 @infer.command('bench')
@@ -878,22 +926,62 @@ def infer_serve(model, port, host, num_slots, max_cache_len, tokenizer,
               help='KV-cache storage dtype. fp8 (e4m3) halves cache HBM '
                    'per slot (~+9% decode throughput at equal slots); '
                    'minor quality loss possible.')
-def infer_bench(model, num_requests, prompt_len, new_tokens, num_slots,
-                max_cache_len, decode_steps, cache_dtype):
+@click.option('--weight-dtype', default='bf16',
+              type=click.Choice(['bf16', 'int8']),
+              help='Weight storage (see infer serve --weight-dtype).')
+@click.option('--serving', is_flag=True, default=False,
+              help='Serving mode: requests arrive over time into the '
+                   'continuous-batching loop; TTFT/TPOT are real '
+                   'under-load latencies (vs offline batch).')
+@click.option('--qps', type=float, default=None,
+              help='Poisson arrival rate for --serving (default: all '
+                   'at once).')
+@click.option('--prefills-per-gap', type=int, default=4,
+              help='Serving: max prefills between decode windows '
+                   '(latency/throughput knob).')
+@click.option('--profile', default=None,
+              type=click.Choice(sorted(_INFER_PROFILES)),
+              help='Preset operating point (docs/performance.md); '
+                   'explicit flags win over the preset.')
+@click.pass_context
+def infer_bench(ctx, model, num_requests, prompt_len, new_tokens,
+                num_slots, max_cache_len, decode_steps, cache_dtype,
+                weight_dtype, serving, qps, prefills_per_gap, profile):
     """Benchmark the engine (req/s, tok/s, TTFT) with synthetic prompts."""
+    import dataclasses as _dc
     import json as json_lib
 
     from skypilot_tpu.infer import (InferConfig, InferenceEngine,
                                     resolve_cache_dtype)
     from skypilot_tpu.models import get_model_config
+    knobs = _apply_infer_profile(ctx, profile, {
+        'num_slots': num_slots, 'decode_steps': decode_steps,
+        'prefills_per_gap': prefills_per_gap})
+    num_slots = knobs['num_slots']
+    decode_steps = knobs['decode_steps']
+    prefills_per_gap = knobs['prefills_per_gap']
     cfg = InferConfig(model=model, num_slots=num_slots,
                       max_cache_len=max_cache_len,
                       decode_steps=decode_steps,
+                      prefills_per_gap=prefills_per_gap,
                       cache_dtype=resolve_cache_dtype(cache_dtype))
-    engine = InferenceEngine(get_model_config(model), cfg)
-    metrics = engine.benchmark(num_requests=num_requests,
-                               prompt_len=prompt_len,
-                               new_tokens=new_tokens)
+    model_config = get_model_config(model)
+    if weight_dtype != 'bf16':
+        from skypilot_tpu.models.llama import LlamaConfig
+        if not isinstance(model_config, LlamaConfig):
+            raise click.UsageError(
+                '--weight-dtype int8 currently supports the llama '
+                f'family; got {type(model_config).__name__}')
+        model_config = _dc.replace(model_config, weight_dtype=weight_dtype)
+    engine = InferenceEngine(model_config, cfg)
+    if serving:
+        metrics = engine.benchmark_serving(num_requests=num_requests,
+                                           prompt_len=prompt_len,
+                                           new_tokens=new_tokens, qps=qps)
+    else:
+        metrics = engine.benchmark(num_requests=num_requests,
+                                   prompt_len=prompt_len,
+                                   new_tokens=new_tokens)
     click.echo(json_lib.dumps(metrics))
 
 

