@@ -45,3 +45,15 @@ def enable_local_cloud(monkeypatch):
     from skypilot_tpu import state
     state.set_enabled_clouds(['local', 'gcp'])
     yield
+
+
+def pytest_addoption(parser):
+    """Real-cloud smoke gating (parity: reference tests/conftest.py:23-80
+    --aws/--gcp/--tpu flags): rows marked gcp only run with --gcp."""
+    parser.addoption('--gcp', action='store_true', default=False,
+                     help='run real-GCP smoke tests (needs credentials)')
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'gcp: real-cloud smoke test, gated by --gcp')
