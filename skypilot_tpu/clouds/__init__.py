@@ -1,6 +1,8 @@
 """Cloud abstraction layer (parity: sky/clouds/)."""
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability, Region
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
-__all__ = ['Cloud', 'CloudCapability', 'Region', 'GCP', 'Local']
+__all__ = ['Cloud', 'CloudCapability', 'Region', 'GCP', 'Kubernetes',
+           'Local']

@@ -47,6 +47,19 @@ def _make_runners(info: ClusterInfo):
             LocalProcessRunner(inst.local_dir, inst.instance_id)
             for inst in info.instances
         ]
+    if info.provider == 'kubernetes':
+        # The driver runs INSIDE the head pod; host 0 is plain local
+        # execution.  Worker pods carry no sshd and no kubectl, so
+        # multi-host podslices need a JobSet-style launcher (future
+        # work) — fail with intent rather than a cryptic ssh error.
+        if len(info.instances) > 1:
+            raise NotImplementedError(
+                'multi-host kubernetes clusters are not yet driven by '
+                'the podlet gang driver (pods have no sshd); use '
+                'cloud: gcp for multi-host slices')
+        from skypilot_tpu.utils.command_runner import LocalProcessRunner
+        return [LocalProcessRunner(os.path.expanduser('~'),
+                                   info.instances[0].instance_id)]
     from skypilot_tpu.utils.command_runner import SSHCommandRunner
     # On the head host we reach workers over INTERNAL IPs with the key the
     # provisioner placed at ~/.ssh/skytpu-key.

@@ -1,0 +1,272 @@
+"""Kubernetes provisioning: one pod per TPU host, pods-as-hosts.
+
+Parity: sky/provision/kubernetes/instance.py:921 (pods-as-nodes) —
+TPU-first: a GKE TPU podslice is claimed by pods requesting the
+`google.com/tpu` extended resource with the accelerator/topology
+nodeSelectors the cloud layer mapped (clouds/kubernetes.gke_selectors).
+GKE's TPU scheduler places the slice's pods onto the matching node
+pool's hosts atomically — the same slice-atomic gang semantics the
+TPU-VM path gets from tpu.googleapis.com.
+
+All cluster interaction goes through the `kubectl` binary (the
+reference delegates to binaries/SDKs the same way; the k8s python
+client is not vendored).  `_kubectl` is the single seam tests fake.
+
+Cluster layout on the k8s side (all labeled `skytpu/cluster=<name>`):
+  - Pods  <cluster>-host{i}: `sleep infinity` + the runtime synced in
+    by the provisioner (kubectl cp), podlet started by instance setup.
+  - Headless Service <cluster>-svc: stable DNS for pod-to-pod
+    rendezvous (`<pod>.<svc>.<ns>.svc.cluster.local`).
+
+Multi-host note: the podlet driver fans out from the head pod over the
+pod IPs recorded in ClusterInfo; images must carry python3 (default
+image python:3.11-slim) — sshd is NOT required because intra-cluster
+exec uses the pod network directly.
+"""
+import json
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions, logsys
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionRecord)
+from skypilot_tpu.utils import command_runner
+
+logger = logsys.init_logger(__name__)
+
+LABEL = 'skytpu/cluster'
+DEFAULT_IMAGE = 'python:3.11-slim'
+_WAIT_TIMEOUT = 1800
+
+
+def _kubectl(args: List[str], stdin: Optional[str] = None,
+             check: bool = True) -> subprocess.CompletedProcess:
+    """Single seam for every cluster interaction (tests fake this)."""
+    res = subprocess.run(['kubectl'] + args, input=stdin,
+                         capture_output=True, text=True)
+    if check and res.returncode != 0:
+        raise exceptions.ProvisionError(
+            f'kubectl {" ".join(args[:3])}... failed: '
+            f'{res.stderr[-500:]}')
+    return res
+
+
+def _pod_name(cluster_name: str, i: int) -> str:
+    return f'{cluster_name}-host{i}'
+
+
+def _pod_manifest(cluster_name: str, i: int, config: Dict) -> Dict:
+    selectors = dict(config.get('node_selectors') or {})
+    if config.get('use_spot') and selectors:
+        selectors['cloud.google.com/gke-spot'] = 'true'
+    chips = int(config.get('chips_per_host') or 0)
+    container: Dict = {
+        'name': 'skytpu',
+        'image': config.get('image') or DEFAULT_IMAGE,
+        'command': ['/bin/sh', '-c', 'sleep infinity'],
+    }
+    if chips:
+        container['resources'] = {
+            'requests': {'google.com/tpu': str(chips)},
+            'limits': {'google.com/tpu': str(chips)},
+        }
+    spec: Dict = {
+        'restartPolicy': 'Never',
+        'subdomain': f'{cluster_name}-svc',
+        'hostname': _pod_name(cluster_name, i),
+        'containers': [container],
+    }
+    if selectors:
+        spec['nodeSelector'] = selectors
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': _pod_name(cluster_name, i),
+            'labels': {LABEL: cluster_name,
+                       'skytpu/rank': str(i)},
+            # Slice metadata rides annotations so get_cluster_info can
+            # reconstruct it from the cluster alone (parity with the
+            # gcp provider persisting accelerator/chips in metadata).
+            'annotations': {
+                'skytpu/accelerator': str(config.get('accelerator')
+                                          or ''),
+                'skytpu/chips-per-host': str(chips),
+            },
+        },
+        'spec': spec,
+    }
+
+
+def _service_manifest(cluster_name: str) -> Dict:
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {
+            'name': f'{cluster_name}-svc',
+            'labels': {LABEL: cluster_name},
+        },
+        'spec': {
+            'clusterIP': 'None',           # headless: per-pod DNS
+            'selector': {LABEL: cluster_name},
+        },
+    }
+
+
+def run_instances(region: str, zone: Optional[str], cluster_name: str,
+                  config: Dict) -> ProvisionRecord:
+    num_hosts = int(config.get('num_hosts', 1)) * \
+        int(config.get('num_slices', 1))
+    existing = query_instances(cluster_name)
+    if existing and all(s == 'running' for s in existing.values()):
+        return ProvisionRecord('kubernetes', cluster_name, region, zone,
+                               resource_id=cluster_name, is_resume=True)
+    items = [_service_manifest(cluster_name)] + [
+        _pod_manifest(cluster_name, i, config) for i in range(num_hosts)
+    ]
+    manifest = json.dumps({'apiVersion': 'v1', 'kind': 'List',
+                           'items': items})
+    _kubectl(['apply', '-f', '-'], stdin=manifest)
+    logger.info('[k8s] applied %d pod(s) + service for %s', num_hosts,
+                cluster_name)
+    return ProvisionRecord('kubernetes', cluster_name, region, zone,
+                           resource_id=cluster_name,
+                           is_resume=bool(existing))
+
+
+def _get_pods(cluster_name: str) -> List[Dict]:
+    res = _kubectl(['get', 'pods', '-l', f'{LABEL}={cluster_name}',
+                    '-o', 'json'])
+    return json.loads(res.stdout).get('items', [])
+
+
+def wait_instances(region: str, zone: Optional[str], cluster_name: str,
+                   state: str = 'running') -> None:
+    del region, zone
+    if state != 'running':
+        return
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        pods = _get_pods(cluster_name)
+        phases = [p.get('status', {}).get('phase') for p in pods]
+        if pods and all(ph == 'Running' for ph in phases):
+            return
+        if any(ph == 'Failed' for ph in phases):
+            raise exceptions.ProvisionError(
+                f'pod(s) of {cluster_name} failed: {phases}')
+        # Unschedulable podslices surface as Pending with a
+        # FailedScheduling condition — that is the k8s stockout.
+        for p in pods:
+            for cond in p.get('status', {}).get('conditions', []):
+                if (cond.get('reason') == 'Unschedulable' and
+                        time.time() > deadline - _WAIT_TIMEOUT + 300):
+                    raise exceptions.TpuStockoutError(
+                        f'{cluster_name}: unschedulable after 300s: '
+                        f'{cond.get("message", "")[:200]}')
+        time.sleep(5)
+    raise exceptions.ProvisionError(
+        f'{cluster_name}: pods not Running within {_WAIT_TIMEOUT}s')
+
+
+def get_cluster_info(region: str, zone: Optional[str],
+                     cluster_name: str) -> ClusterInfo:
+    pods = _get_pods(cluster_name)
+    if not pods:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    pods.sort(key=lambda p: int(
+        p['metadata'].get('labels', {}).get('skytpu/rank', '0')))
+    instances = [
+        InstanceInfo(instance_id=p['metadata']['name'],
+                     internal_ip=p.get('status', {}).get('podIP', ''),
+                     external_ip=None)
+        for p in pods
+    ]
+    anno = pods[0]['metadata'].get('annotations', {})
+    return ClusterInfo(cluster_name=cluster_name,
+                       provider='kubernetes',
+                       region=region,
+                       zone=zone,
+                       instances=instances,
+                       accelerator=anno.get('skytpu/accelerator') or None,
+                       chips_per_host=int(
+                           anno.get('skytpu/chips-per-host') or 0),
+                       num_slices=1)
+
+
+_PHASE_MAP = {
+    'Pending': 'starting',
+    'Running': 'running',
+    'Succeeded': 'terminated',
+    'Failed': 'terminated',
+    'Unknown': 'stopped',
+}
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict] = None
+                    ) -> Dict[str, str]:
+    try:
+        pods = _get_pods(cluster_name)
+    except exceptions.ProvisionError:
+        return {}
+    return {
+        p['metadata']['name']: _PHASE_MAP.get(
+            p.get('status', {}).get('phase'), 'stopped')
+        for p in pods
+    }
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'kubernetes pods terminate, they do not stop; use down')
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict] = None) -> None:
+    _kubectl(['delete', 'pods,services', '-l',
+              f'{LABEL}={cluster_name}', '--ignore-not-found=true'],
+             check=False)
+
+
+def _expand_ports(ports: List[str]) -> List[int]:
+    """'8080' and '10000-10010' specs (both legal per Resources
+    validation) -> flat port list."""
+    out: List[int] = []
+    for p in ports:
+        if '-' in str(p):
+            lo, hi = str(p).split('-', 1)
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(p))
+    return out
+
+
+def open_ports(cluster_name: str, ports: List[str],
+               provider_config: Optional[Dict] = None) -> None:
+    """Expose ports via a NodePort service (LBs are cluster policy)."""
+    svc = {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {
+            'name': f'{cluster_name}-ports',
+            'labels': {LABEL: cluster_name},
+        },
+        'spec': {
+            'type': 'NodePort',
+            'selector': {LABEL: cluster_name, 'skytpu/rank': '0'},
+            'ports': [{'name': f'p{p}', 'port': p, 'targetPort': p}
+                      for p in _expand_ports(ports)],
+        },
+    }
+    _kubectl(['apply', '-f', '-'], stdin=json.dumps(svc))
+
+
+def get_command_runners(
+        cluster_info: ClusterInfo
+) -> List[command_runner.CommandRunner]:
+    return [
+        command_runner.KubernetesPodRunner(inst.instance_id)
+        for inst in cluster_info.instances
+    ]

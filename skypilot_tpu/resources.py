@@ -184,19 +184,24 @@ class Resources:
     # ------------------------------------------------------------ validation
 
     def _validate(self) -> None:
-        if self._cloud is not None and self._cloud not in ('gcp', 'local'):
+        if self._cloud == 'k8s':
+            self._cloud = 'kubernetes'    # accepted alias
+        if self._cloud is not None and self._cloud not in (
+                'gcp', 'local', 'kubernetes'):
             raise exceptions.InvalidResourcesError(
-                f'Unknown cloud {self._cloud!r}; supported: gcp, local.')
+                f'Unknown cloud {self._cloud!r}; supported: gcp, local, '
+                'kubernetes.')
         if self._accelerator is not None:
             if self._instance_type is not None:
                 raise exceptions.InvalidResourcesError(
                     'Cannot specify both accelerator and instance_type; the '
                     'TPU slice shape determines its host VMs.')
             catalog.get_slice_info(self._accelerator)  # raises if unknown
-            if self._cloud != 'local':
-                # The local cloud simulates slices in its own zones
-                # (local-a/b/c); only GCP placements validate against the
-                # catalog's zone offerings.
+            if self._cloud not in ('local', 'kubernetes'):
+                # local simulates slices in its own zones (local-a/b/c);
+                # kubernetes places onto whatever node pools the
+                # connected cluster has — only GCP placements validate
+                # against the catalog's zone offerings.
                 catalog.validate_region_zone(self._accelerator, self._region,
                                              self._zone)
             bad_keys = set(self._accelerator_args) - {
@@ -229,7 +234,7 @@ class Resources:
     def get_cost(self, seconds: float) -> float:
         """Estimated $ for running this many seconds."""
         hours = seconds / 3600.0
-        if self._cloud == 'local':
+        if self._cloud in ('local', 'kubernetes'):
             return 0.0
         if self._accelerator is not None:
             hourly = catalog.get_hourly_cost(self._accelerator,

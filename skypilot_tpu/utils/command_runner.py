@@ -252,3 +252,118 @@ def wait_for_connection(runners: List[CommandRunner],
         ids = [r.node_id for r in pending]
         raise exceptions.NetworkError(
             f'Hosts not reachable after {timeout}s: {ids}')
+
+
+class KubernetesPodRunner(CommandRunner):
+    """A pod as a host: commands via `kubectl exec`, file sync via
+    `kubectl cp` (tar must exist in the image — true of the default
+    python:*-slim images).
+
+    Parity: sky/utils/command_runner.py:656 KubernetesCommandRunner —
+    same role, subprocess kubectl instead of the python client.
+    """
+
+    def __init__(self, pod_name: str, namespace: Optional[str] = None,
+                 container: str = 'skytpu'):
+        super().__init__(pod_name)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.container = container
+
+    def _base(self) -> List[str]:
+        cmd = ['kubectl']
+        if self.namespace:
+            cmd += ['-n', self.namespace]
+        return cmd
+
+    def run(self, cmd, *, log_path='/dev/null', stream_logs=False,
+            require_outputs=False, cwd=None, env=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        exports = ''.join(
+            f'export {k}={shlex.quote(str(v))}; '
+            for k, v in (env or {}).items())
+        if cwd:
+            exports += f'cd {shlex.quote(cwd)}; '
+        full = self._base() + [
+            'exec', self.pod_name, '-c', self.container, '--',
+            'sh', '-c', exports + cmd
+        ]
+        if require_outputs:
+            proc = subprocess.run(full, capture_output=True, text=True,
+                                  errors='replace', check=False)
+            with open(os.path.expanduser(log_path), 'a',
+                      encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+            return proc.returncode, proc.stdout, proc.stderr
+        rc, _ = subprocess_utils.run_with_log(full, log_path,
+                                              stream_logs=stream_logs,
+                                              shell=False)
+        return rc
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        """Tar pipe through `kubectl exec` (mirrors SSHCommandRunner's
+        _tar_sync semantics: directory sources copy their CONTENTS into
+        the target, single files copy-and-rename), honoring
+        RSYNC_EXCLUDES.  `kubectl cp` is deliberately NOT used: it
+        nests an existing destination directory (breaking the
+        trailing-slash contract the call sites rely on) and cannot
+        exclude .git//__pycache__.
+        """
+
+        def pod_path(p: str) -> str:
+            # No '~' expansion inside the pod: resolve to /root (the
+            # default image user).
+            if p.startswith('~/'):
+                return '/root/' + p[2:]
+            return '/root' if p == '~' else p
+
+        excludes = ' '.join(
+            f"--exclude={shlex.quote(p.rstrip('/'))}"
+            for p in RSYNC_EXCLUDES)
+        kexec = ' '.join(shlex.quote(c) for c in self._base() + [
+            'exec', '-i', self.pod_name, '-c', self.container, '--'])
+        if up:
+            src = os.path.expanduser(source)
+            dst = pod_path(target)
+            if os.path.isdir(src):
+                dst_dir = shlex.quote(dst.rstrip('/'))
+                cmd = (f'tar -C {shlex.quote(src)} {excludes} -cf - . | '
+                       f'{kexec} sh -c '
+                       f"'mkdir -p {dst_dir} && tar -C {dst_dir} -xf -'")
+            else:
+                dst_dir, dst_base = os.path.split(dst.rstrip('/'))
+                dst_dir = dst_dir or '/root'
+                cmd = (f'cat {shlex.quote(src)} | {kexec} sh -c '
+                       f"'mkdir -p {shlex.quote(dst_dir)} && "
+                       f"cat > {shlex.quote(dst_dir)}/"
+                       f"{shlex.quote(dst_base or os.path.basename(src))}'")
+        else:
+            src = pod_path(source)
+            dst = os.path.expanduser(target)
+            if source.endswith('/'):
+                os.makedirs(dst, exist_ok=True)
+                cmd = (f'{kexec} sh -c '
+                       f"'tar -C {shlex.quote(src.rstrip('/'))} -cf - .'"
+                       f' | tar -C {shlex.quote(dst)} -xf -')
+            else:
+                os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+                # Two shapes: remote dir -> extract into dst dir;
+                # remote file -> plain byte copy.  Decide via a cheap
+                # remote test to keep the pipe itself simple.
+                rc = self.run(f'test -d {shlex.quote(src)}',
+                              log_path=log_path)
+                if rc == 0:
+                    os.makedirs(dst, exist_ok=True)
+                    cmd = (f'{kexec} sh -c '
+                           f"'tar -C {shlex.quote(src)} -cf - .' | "
+                           f'tar -C {shlex.quote(dst)} -xf -')
+                else:
+                    cmd = (f'{kexec} cat {shlex.quote(src)} > '
+                           f'{shlex.quote(dst)}')
+        rc, tail = subprocess_utils.run_with_log(cmd, log_path, shell=True)
+        if rc != 0:
+            raise exceptions.CommandError(
+                rc, cmd, f'pod sync failed: {tail[-500:]}')
