@@ -145,14 +145,32 @@ def optimize(dag,
     return dag
 
 
-def _egress_cost(src: Candidate, dst: Candidate, gb: float = 0.0) -> float:
-    """Cross-placement egress between consecutive tasks.  Tasks don't yet
-    declare output sizes, so this is 0 unless regions differ (small constant
-    penalty keeps pipelines co-located, matching the reference's intent)."""
-    if gb <= 0 and src.region == dst.region:
+# GCP inter-region egress: $/GB (catalog snapshot rate) and an
+# effective transfer bandwidth for the TIME objective (bucket-to-bucket
+# inter-region copies sustain roughly 1 GB/s in practice).
+_EGRESS_DOLLARS_PER_GB = 0.12
+_EGRESS_GB_PER_HOUR = 3600.0
+
+
+def _egress_cost(src: Candidate, dst: Candidate,
+                 gb: Optional[float] = None,
+                 minimize: 'OptimizeTarget' = None) -> float:
+    """Cross-placement egress between consecutive DAG tasks (parity:
+    sky/optimizer.py:239's cost/time model) in the OBJECTIVE's unit:
+    dollars for COST, transfer HOURS for TIME — adding $/GB to an
+    hours objective would let a declared 500 GB output read as a
+    500-hour penalty.
+
+    `gb` is the upstream task's declared `estimated_outputs_gb`:
+    None (undeclared) falls back to a 1 GB floor so cross-region hops
+    still carry a small co-location penalty; an EXPLICIT 0 declares
+    "no outputs" and disables the penalty entirely."""
+    if src.region == dst.region:
         return 0.0
-    per_gb = 0.12 if src.region != dst.region else 0.0
-    return per_gb * max(gb, 1.0) if src.region != dst.region else 0.0
+    gb = 1.0 if gb is None else max(float(gb), 0.0)
+    if minimize == OptimizeTarget.TIME:
+        return gb / _EGRESS_GB_PER_HOUR
+    return _EGRESS_DOLLARS_PER_GB * gb
 
 
 def _objective(cand: Candidate, minimize: OptimizeTarget) -> float:
@@ -174,9 +192,11 @@ def _optimize_chain_dp(dag, per_task, minimize) -> Dict[object, Candidate]:
         parents.append({})
         for j, cand in enumerate(layers[i]):
             best, arg = float('inf'), -1
+            up_gb = getattr(order[i - 1], 'estimated_outputs_gb', None)
             for pj, pval in costs[i - 1].items():
                 val = pval + _objective(cand, minimize) + _egress_cost(
-                    layers[i - 1][pj], cand)
+                    layers[i - 1][pj], cand, gb=up_gb,
+                    minimize=minimize)
                 if val < best:
                     best, arg = val, pj
             costs[i][j] = best
@@ -224,7 +244,9 @@ def _optimize_general_bb(dag, per_task, minimize) -> Dict[object, Candidate]:
         for j, cand in enumerate(layers[i]):
             extra = _objective(cand, minimize)
             for p in preds[i]:
-                extra += _egress_cost(layers[p][assign[p]], cand)
+                up_gb = getattr(order[p], 'estimated_outputs_gb', None)
+                extra += _egress_cost(layers[p][assign[p]], cand,
+                                      gb=up_gb, minimize=minimize)
             assign[i] = j
             _dfs(i + 1, acc + extra)
         assign[i] = -1

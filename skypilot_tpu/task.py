@@ -50,6 +50,11 @@ class Task:
         self.service: Optional[Any] = None  # serve.SkyTpuServiceSpec
         self.best_resources: Optional[Resources] = None
         self.estimated_duration_hours: Optional[float] = None
+        # Declared output size for cross-region egress costing in the
+        # optimizer (parity: sky Task.set_outputs(
+        # estimated_size_gigabytes=...), consumed at
+        # sky/optimizer.py:239's cost/time model).
+        self.estimated_outputs_gb: Optional[float] = None
         self._validate()
         # Auto-register into an active `with Dag():` context.
         from skypilot_tpu import dag as dag_lib
@@ -205,6 +210,12 @@ class Task:
             task.set_service(
                 service_spec.SkyTpuServiceSpec.from_yaml_config(
                     config['service']))
+        if config.get('estimated_duration_hours') is not None:
+            task.estimated_duration_hours = float(
+                config['estimated_duration_hours'])
+        if config.get('estimated_outputs_gb') is not None:
+            task.estimated_outputs_gb = float(
+                config['estimated_outputs_gb'])
         return task
 
     @classmethod
@@ -247,6 +258,8 @@ class Task:
             }
         if self.service is not None:
             cfg['service'] = self.service.to_yaml_config()
+        put('estimated_duration_hours', self.estimated_duration_hours)
+        put('estimated_outputs_gb', self.estimated_outputs_gb)
         return cfg
 
     def to_yaml(self, path: str) -> None:

@@ -118,6 +118,9 @@ TASK_SCHEMA = {
         'file_mounts': {'type': 'object'},
         'storage_mounts': {'type': 'object'},
         'service': _SERVICE_SCHEMA,
+        'estimated_duration_hours': {'type': 'number',
+                                     'exclusiveMinimum': 0},
+        'estimated_outputs_gb': {'type': 'number', 'minimum': 0},
     },
 }
 

@@ -67,10 +67,17 @@ def test_fsdp_params_are_sharded():
     mesh = make_mesh(MeshSpec(fsdp=8))
     tcfg = TrainConfig(model='llama-debug', batch_size=8, seq_len=32)
     state, _ = create_sharded_state(cfg, tcfg, mesh, jax.random.PRNGKey(0))
-    # The embedding's 'embed' axis (dim 64) should be sharded over fsdp=8.
+    # Embedding tables shard their VOCAB dim over fsdp (vocab_table
+    # rule): the hidden dim stays whole so the (data>1, fsdp>1)
+    # embedding backward never needs the inexpressible
+    # batch-shard->embed-shard reshard (see parallel/mesh.py rules).
     emb = state.params['embedding']
     shard_shape = emb.sharding.shard_shape(emb.shape)
-    assert shard_shape[1] == emb.shape[1] // 8
+    assert shard_shape[0] == emb.shape[0] // 8
+    assert shard_shape[1] == emb.shape[1]
+    # Ordinary weights (mlp kernels) still shard 'embed' over fsdp.
+    k = state.params['layer_0']['mlp']['gate_proj']['kernel']
+    assert k.sharding.shard_shape(k.shape)[0] == k.shape[0] // 8
 
 
 def test_mesh_spec_validation():
@@ -169,8 +176,11 @@ def test_grad_accum_matches_full_batch(accum):
         state2, _ = create_sharded_state(cfg, tcfg, mesh,
                                          jax.random.PRNGKey(0))
         s_micro, m_micro = micro(state2, batch)
+        # Accumulation sums CE in masked-sum form scaled by the global
+        # 1/token-count (exact masked semantics) — a different f32
+        # summation order than the single pass, so allow float noise.
         np.testing.assert_allclose(float(m_full['loss']),
-                                   float(m_micro['loss']), rtol=1e-5)
+                                   float(m_micro['loss']), rtol=5e-5)
         np.testing.assert_allclose(float(m_full['grad_norm']),
                                    float(m_micro['grad_norm']), rtol=1e-4)
         for a, b in zip(jax.tree.leaves(s_full.params),
@@ -302,8 +312,10 @@ def test_spmd_partitioner_no_full_remat_warnings():
     env = dict(os.environ,
                JAX_PLATFORMS='cpu',
                XLA_FLAGS='--xla_force_host_platform_device_count=8')
+    # Generous timeout: under a full-suite run this subprocess
+    # competes with the parent's compiles for CPU (observed >600s).
     res = subprocess.run([sys.executable, '-c', prog], env=env,
-                         capture_output=True, text=True, timeout=600)
+                         capture_output=True, text=True, timeout=1200)
     assert res.returncode == 0, res.stderr[-2000:]
     assert 'OK' in res.stdout
     assert 'Involuntary full rematerialization' not in res.stderr, (
