@@ -39,7 +39,7 @@ def main() -> None:
     # remat'd activations exceed HBM).
     batch_size = 24 * n_dev
     seq_len = 2048
-    steps = 20
+    steps = 10   # per measurement window; 3 windows, median reported
 
     cfg = get_model_config(model_name)
     tcfg = TrainConfig(model=model_name, batch_size=batch_size,
@@ -52,20 +52,26 @@ def main() -> None:
     step_fn = make_train_step(mesh, loss_chunk=128)
     data = synthetic_data(batch_size, seq_len, cfg.vocab_size)
 
+    # Median-of-3 measurement windows with spread: the shared tunneled
+    # bench chip is noisy run-to-run (~±1-2% train, far more for
+    # serving), so a single window misleads (VERDICT r1 weak #7).
+    window_tps = []
     with mesh:
         # Warmup / compile.  NOTE: sync via a host transfer of a value that
         # depends on the step (float(loss)) — on tunneled TPU platforms
         # block_until_ready can return before execution finishes.
         state, metrics = step_fn(state, next(data))
         _ = float(metrics['loss'])
-        t0 = time.time()
-        for _ in range(steps):
-            state, metrics = step_fn(state, next(data))
-        _ = float(metrics['loss'])  # waits for the full dispatched chain
-        elapsed = time.time() - t0
+        for _ in range(3):
+            t0 = time.time()
+            for _ in range(steps):
+                state, metrics = step_fn(state, next(data))
+            _ = float(metrics['loss'])  # waits for the dispatched chain
+            window_tps.append(batch_size * seq_len * steps /
+                              (time.time() - t0))
 
-    tokens_per_step = batch_size * seq_len
-    tps = tokens_per_step * steps / elapsed          # tokens/s (this model)
+    import statistics
+    tps = statistics.median(window_tps)    # robust to window count
     tps_chip = tps / n_dev
     flops_per_tok = cfg.flops_per_token(seq_len)
     achieved_tflops_chip = tps_chip * flops_per_tok / 1e12
@@ -91,6 +97,8 @@ def main() -> None:
             'batch': batch_size,
             'seq_len': seq_len,
             'raw_tokens_per_sec_per_chip': round(tps_chip, 1),
+            'window_spread_tok_s_per_chip': [
+                round(w / n_dev, 1) for w in window_tps],
             'achieved_tflops_per_chip': round(achieved_tflops_chip, 1),
             'mfu': round(achieved_tflops_chip / peak, 3) if peak else None,
             'final_loss': round(float(metrics['loss']), 3),
