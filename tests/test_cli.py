@@ -173,7 +173,7 @@ def test_infer_profile_presets(runner, monkeypatch):
     r = runner.invoke(cli.cli, ['infer', 'serve', '--model', 'llama-debug',
                                 '--profile', 'throughput'])
     assert r.exit_code == 0, r.output
-    assert captured['num_slots'] == 48 and captured['decode_steps'] == 8
+    assert captured['num_slots'] == 48 and captured['decode_steps'] == 32
     captured.clear()
     r = runner.invoke(cli.cli, ['infer', 'serve', '--model', 'llama-debug',
                                 '--profile', 'latency',
