@@ -239,3 +239,76 @@ def test_multihost_rejected_at_feasibility():
         cloud.get_feasible_resources(
             Resources(cloud='kubernetes', accelerator='tpu-v5e-16'))
     assert CloudCapability.AUTOSTOP not in cloud.capabilities()
+
+
+# ------------------------------------------------- subprocess-seam e2e
+
+
+@pytest.fixture
+def stateful_kubectl(tmp_path, monkeypatch):
+    """A REAL kubectl binary on PATH (python script) with pod state on
+    disk — drives provision.kubernetes through its actual subprocess
+    seam, not a monkeypatch."""
+    import os
+    import stat
+    state = tmp_path / 'k8s-state'
+    state.mkdir()
+    script = tmp_path / 'bin' / 'kubectl'
+    script.parent.mkdir()
+    script.write_text(f'''#!/usr/bin/env python3
+import json, os, sys, glob
+state = {str(state)!r}
+args = sys.argv[1:]
+if args[:2] == ['config', 'current-context']:
+    print('gke_test-ctx'); sys.exit(0)
+if args[0] == 'apply':
+    manifest = json.load(sys.stdin)
+    items = (manifest['items'] if manifest.get('kind') == 'List'
+             else [manifest])
+    for it in items:
+        if it['kind'] == 'Pod':
+            it['status'] = {{'phase': 'Running', 'podIP': '10.9.0.1'}}
+            json.dump(it, open(
+                os.path.join(state, it['metadata']['name'] + '.json'),
+                'w'))
+    print('applied'); sys.exit(0)
+if args[:2] == ['get', 'pods']:
+    label = args[args.index('-l') + 1].split('=', 1)[1]
+    pods = [json.load(open(p))
+            for p in sorted(glob.glob(os.path.join(state, '*.json')))]
+    pods = [p for p in pods
+            if p['metadata']['labels'].get('skytpu/cluster') == label]
+    print(json.dumps({{'items': pods}})); sys.exit(0)
+if args[0] == 'delete':
+    for p in glob.glob(os.path.join(state, '*.json')):
+        os.remove(p)
+    sys.exit(0)
+sys.exit(0)
+''')
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('PATH',
+                       f"{script.parent}{os.pathsep}{os.environ['PATH']}")
+    return state
+
+
+def test_provision_lifecycle_through_real_kubectl_seam(stateful_kubectl):
+    """Full lifecycle through the ACTUAL subprocess seam: credentials ->
+    run -> wait -> cluster info (annotations round-trip) -> query ->
+    terminate."""
+    cloud = Kubernetes()
+    ok, msg = cloud.check_credentials()
+    assert ok, msg
+    assert cloud.current_context() == 'gke_test-ctx'
+    cfg = {'num_hosts': 1, 'chips_per_host': 8,
+           'accelerator': 'tpu-v5e-8',
+           'node_selectors': gke_selectors('tpu-v5e-8')}
+    rec = k8s.run_instances('gke_test-ctx', None, 'ek8s', cfg)
+    assert rec.provider == 'kubernetes'
+    k8s.wait_instances('gke_test-ctx', None, 'ek8s')
+    info = k8s.get_cluster_info('gke_test-ctx', None, 'ek8s')
+    assert info.accelerator == 'tpu-v5e-8'
+    assert info.chips_per_host == 8
+    assert info.instances[0].internal_ip == '10.9.0.1'
+    assert k8s.query_instances('ek8s') == {'ek8s-host0': 'running'}
+    k8s.terminate_instances('ek8s')
+    assert k8s.query_instances('ek8s') == {}
