@@ -147,14 +147,17 @@ class InferenceEngine:
                  params: Optional[Any] = None,
                  rng: Optional[jax.Array] = None,
                  mesh: Optional[jax.sharding.Mesh] = None):
+        from skypilot_tpu.models.gpt2 import GPT2Config
         from skypilot_tpu.models.mixtral import MixtralConfig
         self._mesh = mesh
         self.model_config = model_config
         self.cfg = cfg or InferConfig()
-        if not isinstance(model_config, (LlamaConfig, MixtralConfig)):
+        if not isinstance(model_config,
+                          (LlamaConfig, MixtralConfig, GPT2Config)):
             raise TypeError(
-                'InferenceEngine supports the Llama and Mixtral families '
-                f'(KV-cache decode path); got {type(model_config).__name__}')
+                'InferenceEngine supports the Llama, Mixtral and GPT-2 '
+                'families (KV-cache decode path); got '
+                f'{type(model_config).__name__}')
         if mesh is not None:
             tp = dict(mesh.shape).get('tensor', 1)
             if model_config.num_kv_heads % max(tp, 1):

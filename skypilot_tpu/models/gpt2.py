@@ -33,6 +33,12 @@ class GPT2Config:
         return self.hidden_size // self.num_heads
 
     @property
+    def num_kv_heads(self) -> int:
+        """Full MHA: K/V head count equals the query head count (the
+        inference engine sizes its slotted cache off this)."""
+        return self.num_heads
+
+    @property
     def intermediate_size(self) -> int:
         return 4 * self.hidden_size
 
@@ -52,7 +58,7 @@ class GPT2Attention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, kv_cache=None):
         cfg = self.config
         d = cfg.head_dim_
         qkv = nn.DenseGeneral(
@@ -63,17 +69,29 @@ class GPT2Attention(nn.Module):
             name='c_attn')(x)
         q, k, v = (jnp.transpose(qkv[:, :, i], (0, 2, 1, 3))
                    for i in range(3))        # each [B, H, S, D]
-        q = nn.with_logical_constraint(
-            q, ('activation_batch', 'activation_heads', 'activation_seq',
-                None))
-        out = sequence_parallel_attention(q, k, v, causal=True)
+        if kv_cache is not None:
+            # Incremental decode: the SHARED cache contract (absolute
+            # positions index the cache rows; no rope — GPT-2 position
+            # information rides the wpe lookup upstream).
+            from skypilot_tpu.models.llama import write_kv_and_attend
+            out, new_cache = write_kv_and_attend(kv_cache, k, v, q,
+                                                 positions)
+        else:
+            q = nn.with_logical_constraint(
+                q, ('activation_batch', 'activation_heads',
+                    'activation_seq', None))
+            out = sequence_parallel_attention(q, k, v, causal=True)
+            new_cache = None
         out = jnp.transpose(out, (0, 2, 1, 3))
-        return nn.DenseGeneral(
+        out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), use_bias=True, dtype=cfg.dtype,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.normal(0.02 / (2 * cfg.num_layers) ** 0.5),
                 ('heads', 'qkv_embed', 'embed')),
             name='c_proj')(out)
+        if kv_cache is not None:
+            return out, new_cache
+        return out
 
 
 class GPT2MLP(nn.Module):
@@ -102,16 +120,25 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None, kv_cache=None):
         cfg = self.config
-        h = x + GPT2Attention(cfg, name='attn')(
-            nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
-                         name='ln_1')(x).astype(cfg.dtype))
+        attn = GPT2Attention(cfg, name='attn')
+        attn_in = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
+                               name='ln_1')(x).astype(cfg.dtype)
+        if kv_cache is not None:
+            attn_out, new_cache = attn(attn_in, positions, kv_cache)
+        else:
+            attn_out, new_cache = attn(attn_in), None
+        h = x + attn_out
         out = h + GPT2MLP(cfg, name='mlp')(
             nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          name='ln_2')(h).astype(cfg.dtype))
-        return nn.with_logical_constraint(
-            out, ('activation_batch', 'activation_seq', 'activation_embed'))
+        out = nn.with_logical_constraint(
+            out, ('activation_batch', 'activation_seq',
+                  'activation_embed'))
+        if kv_cache is not None:
+            return out, new_cache
+        return out
 
 
 class GPT2(nn.Module):
@@ -121,7 +148,13 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions: Optional[jax.Array] = None,
-                 hidden_only: bool = False):
+                 cache=None, hidden_only: bool = False):
+        """Training/scoring: __call__(tokens) -> logits.  Incremental
+        inference: __call__(tokens, positions, cache) ->
+        (logits, new_cache) — the same per-layer [(k, v)] contract as
+        the Llama family (llama.init_cache works: num_kv_heads ==
+        num_heads for full MHA), so the shared inference engine serves
+        GPT-2 too."""
         cfg = self.config
         if tokens.shape[1] > cfg.max_seq_len:
             # Learned-position table: out-of-range indexing would clamp
@@ -144,12 +177,20 @@ class GPT2(nn.Module):
         x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[positions]
         x = nn.with_logical_constraint(
             x, ('activation_batch', 'activation_seq', 'activation_embed'))
+        new_cache = []
         for i in range(cfg.num_layers):
             block = GPT2Block(cfg, name=f'h_{i}')
-            x = nn.remat(lambda mdl, h: mdl(h),
-                         prevent_cse=True)(block, x)
+            if cache is not None:
+                x, layer_cache = block(x, positions, cache[i])
+                new_cache.append(layer_cache)
+            else:
+                x = nn.remat(lambda mdl, h: mdl(h),
+                             prevent_cse=True)(block, x)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=jnp.float32,
                          name='ln_f')(x)
         if hidden_only:
             return x
-        return x.astype(jnp.float32) @ wte.astype(jnp.float32).T
+        logits = x.astype(jnp.float32) @ wte.astype(jnp.float32).T
+        if cache is not None:
+            return logits, new_cache
+        return logits

@@ -156,6 +156,24 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
     return rotated.astype(x.dtype)
 
 
+def write_kv_and_attend(kv_cache, k, v, q, positions):
+    """Shared incremental-decode cache step: write the new K/V rows at
+    their absolute positions, attend over the whole cache.  Used by the
+    Llama and GPT-2 attention modules so the cache-write contract has
+    exactly one implementation."""
+    k_cache, v_cache = kv_cache
+    start = positions[:, 0]   # positions within one call are contiguous
+
+    def upd(cache_row, new_row, s0):
+        return jax.lax.dynamic_update_slice(
+            cache_row, new_row.astype(cache_row.dtype), (0, s0, 0))
+
+    k_cache = jax.vmap(upd)(k_cache, k, start)
+    v_cache = jax.vmap(upd)(v_cache, v, start)
+    out = decode_attention(q, k_cache, v_cache, positions)
+    return out, (k_cache, v_cache)
+
+
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      q_positions: jax.Array) -> jax.Array:
     """Attention of T new queries over a [B, Hkv, M, D] KV cache.
@@ -303,20 +321,9 @@ class Attention(nn.Module):
         new_cache = None
         if kv_cache is not None:
             # Incremental decode/prefill: write the (roped) new K/V rows
-            # into the cache at their absolute positions, then attend
-            # over the whole cache.  start = positions[:, 0] (positions
-            # within one call are contiguous).
-            k_cache, v_cache = kv_cache
-            start = positions[:, 0]
-
-            def upd(cache_row, new_row, s):
-                return jax.lax.dynamic_update_slice(
-                    cache_row, new_row.astype(cache_row.dtype), (0, s, 0))
-
-            k_cache = jax.vmap(upd)(k_cache, k, start)
-            v_cache = jax.vmap(upd)(v_cache, v, start)
-            out = decode_attention(q, k_cache, v_cache, positions)
-            new_cache = (k_cache, v_cache)
+            # into the cache, then attend over the whole cache.
+            out, new_cache = write_kv_and_attend(kv_cache, k, v, q,
+                                                 positions)
         else:
             q = nn.with_logical_constraint(
                 q, ('activation_batch', 'activation_heads', 'activation_seq',

@@ -631,3 +631,43 @@ def test_benchmark_serving_metrics(tiny_config):
     assert m['ttft_median_s'] >= 0
     assert m['tpot_median_s'] >= 0
     assert m['ttft_p99_s'] >= m['ttft_median_s']
+
+
+# ---------------------------------------------------------------- gpt2
+
+
+def test_gpt2_engine_matches_full_forward_argmax():
+    """GPT-2 rides the same engine: cached incremental decode (learned
+    positions via the wpe lookup, MHA cache) reproduces the
+    full-forward greedy continuation."""
+    from skypilot_tpu.models.gpt2 import GPT2, GPT2Config
+    cfg_m = GPT2Config(name='gpt2-infer-test', vocab_size=101,
+                       hidden_size=32, num_layers=2, num_heads=4,
+                       max_seq_len=64, dtype=jnp.float32)
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=6, cache_dtype=jnp.float32)
+    eng = InferenceEngine(cfg_m, cfg, rng=jax.random.PRNGKey(17))
+    prompt = [5, 6, 7]
+    res = eng.generate([Request(tokens=prompt, max_new_tokens=6)])[0]
+    assert res.finish_reason == 'length'
+
+    model = GPT2(cfg_m)
+    seq = list(prompt)
+    for _ in range(6):
+        logits = model.apply(eng.params, jnp.asarray([seq]))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert res.output_tokens == seq[len(prompt):]
+
+
+def test_gpt2_engine_continuous_batching():
+    from skypilot_tpu.models.gpt2 import GPT2Config
+    cfg_m = GPT2Config(name='gpt2-cb', vocab_size=101, hidden_size=32,
+                       num_layers=2, num_heads=4, max_seq_len=64,
+                       dtype=jnp.float32)
+    cfg = InferConfig(num_slots=2, max_cache_len=32, prefill_buckets=(8,),
+                      max_new_tokens=4, cache_dtype=jnp.float32)
+    eng = InferenceEngine(cfg_m, cfg, rng=jax.random.PRNGKey(18))
+    results = eng.generate([Request(tokens=[i + 1, i + 2],
+                                    max_new_tokens=4) for i in range(5)])
+    assert len(results) == 5
+    assert all(len(r.output_tokens) == 4 for r in results)
