@@ -289,7 +289,12 @@ def test_spmd_partitioner_no_full_remat_warnings():
     import subprocess
     import sys
     prog = (
-        "import jax, jax.numpy as jnp\n"
+        # The site hook re-pins JAX_PLATFORMS onto the tunneled TPU at
+        # jax import whenever the chip is free; the config update AFTER
+        # import is the only reliable CPU force (see conftest.py).
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
         "from skypilot_tpu.models.llama import LlamaConfig\n"
         "from skypilot_tpu.parallel import MeshSpec, make_mesh\n"
         "from skypilot_tpu.train import TrainConfig, create_sharded_state\n"
