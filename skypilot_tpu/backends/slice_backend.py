@@ -201,6 +201,28 @@ class SliceBackend(Backend[SliceResourceHandle]):
                 d.add(task)
             optimizer_lib.optimize(d, quiet=True)
             candidates = task.candidates
+        if (task.num_nodes or 1) > 1:
+            # Gang width (num_nodes = SLICES) is a task property the
+            # per-resource feasibility check cannot see: filter clouds
+            # that cannot provision multi-slice gangs HERE, before any
+            # provisioning is paid for (a kubernetes podslice wait is
+            # ~30 min; failing at job-run time after it is not ok).
+            from skypilot_tpu.clouds import Cloud
+            from skypilot_tpu.clouds.cloud import CloudCapability
+            dropped = {
+                c.resources.cloud for c in candidates
+                if not Cloud.from_name(c.resources.cloud).supports(
+                    CloudCapability.MULTI_SLICE)
+            }
+            candidates = [
+                c for c in candidates if c.resources.cloud not in dropped
+            ]
+            if not candidates:
+                raise exceptions.InvalidResourcesError(
+                    f'num_nodes={task.num_nodes} needs a multi-slice '
+                    f'capable cloud; {sorted(dropped)} cannot gang-'
+                    'provision multiple slices (on kubernetes use one '
+                    'slice per task, or cloud: gcp for multislice)')
         if dryrun:
             cand = candidates[0]
             logger.info('Dryrun: would provision %s in %s.',

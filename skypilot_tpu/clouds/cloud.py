@@ -15,6 +15,7 @@ class CloudCapability(enum.Enum):
     SPOT = 'spot'
     OPEN_PORTS = 'open_ports'
     MULTI_HOST = 'multi_host'
+    MULTI_SLICE = 'multi_slice'   # gang width > 1 (task.num_nodes)
     STORAGE_MOUNT = 'storage_mount'
     HOST_CONTROLLERS = 'host_controllers'
 

@@ -24,6 +24,7 @@ class GCP(Cloud):
             CloudCapability.SPOT,
             CloudCapability.OPEN_PORTS,
             CloudCapability.MULTI_HOST,
+            CloudCapability.MULTI_SLICE,
             CloudCapability.STORAGE_MOUNT,
             CloudCapability.HOST_CONTROLLERS,
             # STOP/AUTOSTOP supported for CPU VMs only; TPU slices must be

@@ -23,19 +23,46 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 from skypilot_tpu import exceptions
 from skypilot_tpu.clouds.cloud import Cloud, CloudCapability
 
-# Framework TPU generation -> GKE accelerator label value.  v4/v5p are
-# deliberately absent: their GKE topology labels are 3D (e.g. 2x2x4)
-# while the catalog records the 2D host grid — mapping them needs a
-# separate table, and v5e/v6e are the mainstream GKE TPU targets.
+# Framework TPU generation -> GKE accelerator label value.
+# v5e/v6e node pools carry the catalog's 2D chip grid as their topology
+# label; v4/v5p are 3D tori whose label is derived (below).
 _GKE_ACCELERATOR = {
+    'v4': 'tpu-v4-podslice',
     'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
     'v6e': 'tpu-v6e-slice',
 }
+# Generations whose GKE topology label is the 3D chip torus, not the 2D
+# host grid the catalog records.
+_3D_TOPOLOGY_GENERATIONS = ('v4', 'v5p')
+
+
+def _topology_3d(chips: int) -> str:
+    """Chip count -> GKE 3D topology label for v4/v5p tori.
+
+    GCP's published shapes (ct4p/ct5p node pools: 2x2x1, 2x2x2, 2x2x4,
+    2x4x4, 4x4x4, 4x4x8, ...) are the balanced power-of-two
+    factorization: grow the smallest dimension by 2 until the product
+    reaches the chip count, then print ascending."""
+    if chips < 1 or chips & (chips - 1):
+        raise exceptions.InvalidResourcesError(
+            f'cannot derive a 3D torus topology for {chips} chips '
+            '(not a power of two)')
+    dims = [1, 1, 1]
+    while dims[0] * dims[1] * dims[2] < chips:
+        dims.sort()
+        dims[0] *= 2
+    # GCP prints ascending with any 1s trailing: 2x2x1, 2x2x4, 2x4x4.
+    dims.sort()
+    dims = [d for d in dims if d > 1] + [d for d in dims if d == 1]
+    return 'x'.join(str(d) for d in dims)
+
 
 def gke_selectors(accelerator: Optional[str]) -> Dict[str, str]:
     """accelerator string -> GKE nodeSelector labels (empty for CPU).
-    The slice topology comes from the catalog (the same physical shape
-    the TPU-VM path uses); only the accelerator label needs mapping."""
+    The slice shape comes from the catalog (the same physical shape the
+    TPU-VM path uses); the accelerator label is mapped per generation
+    and v4/v5p topologies are lifted to their 3D chip-torus form."""
     if not accelerator:
         return {}
     from skypilot_tpu import catalog
@@ -46,9 +73,12 @@ def gke_selectors(accelerator: Optional[str]) -> Dict[str, str]:
             f'no GKE podslice mapping for {accelerator!r} (generation '
             f'{info.generation}); kubernetes currently supports '
             f'{sorted(_GKE_ACCELERATOR)} — use cloud: gcp for the rest')
+    topology = (_topology_3d(info.chips)
+                if info.generation in _3D_TOPOLOGY_GENERATIONS
+                else info.topology)
     return {
         'cloud.google.com/gke-tpu-accelerator': gke_acc,
-        'cloud.google.com/gke-tpu-topology': info.topology,
+        'cloud.google.com/gke-tpu-topology': topology,
     }
 
 
@@ -73,16 +103,10 @@ class Kubernetes(Cloud):
             return []   # opt-in
         if resources.accelerator:
             gke_selectors(resources.accelerator)   # validate mapping
-            if resources.num_hosts > 1:
-                # Fail BEFORE provisioning: the gang driver cannot yet
-                # fan out across pods (no sshd in images; JobSet-style
-                # launch is future work) — rejecting here beats paying
-                # 30 min of podslice scheduling first.
-                raise exceptions.InvalidResourcesError(
-                    f'{resources.accelerator} spans '
-                    f'{resources.num_hosts} hosts; multi-host podslices '
-                    'are not yet supported on kubernetes — use '
-                    'cloud: gcp for multi-host slices')
+        # Multi-host podslices (num_hosts > 1) are supported: one pod
+        # per TPU host, gang-driven over the podlet agent on pod IPs
+        # (podlet/agent.py); GKE schedules the podslice's pods onto the
+        # matching node pool atomically.
         return [resources]
 
     def region_zones_for(self, resources) -> Iterator[Tuple[str,

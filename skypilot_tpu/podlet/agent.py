@@ -1,0 +1,123 @@
+"""Worker-pod exec agent: the gang driver's transport on Kubernetes.
+
+TPU-VM hosts run sshd, so the head-host driver fans jobs out over SSH
+(podlet/driver.py).  Kubernetes pods carry neither sshd nor kubectl, so
+multi-host podslices need their own intra-cluster transport (the
+reference reaches pods from the *client* via the kubernetes API,
+sky/provision/kubernetes/instance.py:921 — but the gang driver runs ON
+the head pod, inside the cluster).  This agent is that transport: a
+small JSON-lines-over-TCP server the provisioner starts on every worker
+pod, listening on the pod network (headless-service DNS / pod IP).
+
+Protocol (one JSON object per line, newline-terminated):
+  -> {"token": t, "op": "ping"}
+  <- {"ok": true}
+  -> {"token": t, "op": "put", "path": p, "data": b64, "mode": 0o644}
+  <- {"ok": true}
+  -> {"token": t, "op": "run", "cmd": c, "env": {...}}
+  <- {"line": "..."} ... streamed as the command prints ...
+  <- {"rc": 0}
+
+Auth: a per-cluster random token the provisioner writes to
+~/.skytpu/agent_token on every pod before the agent starts (the pod
+network is cluster-internal, but a flat network is no reason to run an
+unauthenticated exec service).  One request per connection.
+"""
+import argparse
+import base64
+import json
+import os
+import socketserver
+import subprocess
+import sys
+
+TOKEN_PATH = '~/.skytpu/agent_token'
+# Worker rank i listens on AGENT_PORT_BASE + i: per-rank ports keep the
+# scheme collision-free even when several pods share one IP (the
+# hermetic test seam runs every "pod" on localhost).  8490+ avoids the
+# jax coordinator (8476) and MEGASCALE (8477) ports.
+AGENT_PORT_BASE = 8490
+
+
+def _load_token() -> str:
+    with open(os.path.expanduser(TOKEN_PATH), 'r', encoding='utf-8') as f:
+        return f.read().strip()
+
+
+class _Handler(socketserver.StreamRequestHandler):
+
+    def _send(self, obj) -> None:
+        self.wfile.write((json.dumps(obj) + '\n').encode())
+        self.wfile.flush()
+
+    def handle(self) -> None:
+        try:
+            line = self.rfile.readline(10 * 1024 * 1024)
+            req = json.loads(line)
+        except (ValueError, OSError):
+            return
+        # Token is re-read per request: a client that regenerates the
+        # cluster token (state wipe, second client machine) rewrites
+        # ~/.skytpu/agent_token and must NOT be locked out by a value
+        # the agent cached at startup.
+        try:
+            expected = _load_token()
+        except OSError:
+            expected = None
+        if expected is None or req.get('token') != expected:
+            self._send({'error': 'bad token'})
+            return
+        op = req.get('op')
+        try:
+            if op == 'ping':
+                self._send({'ok': True})
+            elif op == 'put':
+                path = os.path.expanduser(req['path'])
+                os.makedirs(os.path.dirname(path) or '/', exist_ok=True)
+                with open(path, 'wb') as f:
+                    f.write(base64.b64decode(req['data']))
+                os.chmod(path, int(req.get('mode', 0o644)))
+                self._send({'ok': True})
+            elif op == 'run':
+                env = dict(os.environ)
+                env.update({str(k): str(v)
+                            for k, v in (req.get('env') or {}).items()})
+                # start_new_session: the job must lead its own process
+                # group — the recorded-pgid cancel fallback (`kill -TERM
+                # -$(cat pgid_file)`) is a no-op on a non-leader, which
+                # would leave cancelled gang jobs burning the podslice.
+                proc = subprocess.Popen(
+                    ['sh', '-c', req['cmd']], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, errors='replace', start_new_session=True)
+                assert proc.stdout is not None
+                for out_line in proc.stdout:
+                    self._send({'line': out_line.rstrip('\n')})
+                self._send({'rc': proc.wait()})
+            else:
+                self._send({'error': f'unknown op {op!r}'})
+        except Exception as e:  # pylint: disable=broad-except
+            try:
+                self._send({'error': str(e), 'rc': 113})
+            except OSError:
+                pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, required=True)
+    parser.add_argument('--host', default='0.0.0.0')
+    args = parser.parse_args()
+    server = _Server((args.host, args.port), _Handler)
+    _load_token()                   # fail fast if the token is missing
+    print(f'[agent] listening on {args.host}:{args.port}', flush=True)
+    server.serve_forever()
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -49,17 +49,21 @@ def _make_runners(info: ClusterInfo):
         ]
     if info.provider == 'kubernetes':
         # The driver runs INSIDE the head pod; host 0 is plain local
-        # execution.  Worker pods carry no sshd and no kubectl, so
-        # multi-host podslices need a JobSet-style launcher (future
-        # work) — fail with intent rather than a cryptic ssh error.
-        if len(info.instances) > 1:
-            raise NotImplementedError(
-                'multi-host kubernetes clusters are not yet driven by '
-                'the podlet gang driver (pods have no sshd); use '
-                'cloud: gcp for multi-host slices')
-        from skypilot_tpu.utils.command_runner import LocalProcessRunner
-        return [LocalProcessRunner(os.path.expanduser('~'),
-                                   info.instances[0].instance_id)]
+        # execution.  Worker pods carry no sshd and no kubectl, so the
+        # fan-out rides the podlet agent the provisioner started on
+        # every worker (podlet/agent.py), over pod IPs.
+        from skypilot_tpu.podlet.agent import AGENT_PORT_BASE
+        from skypilot_tpu.utils.command_runner import (LocalProcessRunner,
+                                                       PodAgentRunner)
+        runners = [LocalProcessRunner(os.path.expanduser('~'),
+                                      info.instances[0].instance_id)]
+        token = info.custom.get('agent_token', '')
+        base = int(info.custom.get('agent_port_base', AGENT_PORT_BASE))
+        for rank, inst in enumerate(info.instances[1:], start=1):
+            runners.append(
+                PodAgentRunner(inst.internal_ip, base + rank, token,
+                               node_id=inst.instance_id))
+        return runners
     from skypilot_tpu.utils.command_runner import SSHCommandRunner
     # On the head host we reach workers over INTERNAL IPs with the key the
     # provisioner placed at ~/.ssh/skytpu-key.
@@ -158,7 +162,19 @@ def _run_on_host(runner, rank: int, job_id: int, run_script_remote: str,
 
     from skypilot_tpu import native
     from skypilot_tpu.utils import subprocess_utils
-    from skypilot_tpu.utils.command_runner import LocalProcessRunner
+    from skypilot_tpu.utils.command_runner import (LocalProcessRunner,
+                                                   PodAgentRunner)
+    if isinstance(runner, PodAgentRunner):
+        # Worker pod: the agent execs + streams; env travels in the
+        # protocol (no shell-quoting round trip).  The supervisor was
+        # built during runtime sync when the image has a compiler; slim
+        # images take the recorded-pgid shell fallback.
+        wrapped = _wrap_with_supervisor(job_id, rank, run_script_remote,
+                                        '$HOME/.skytpu/native/bin/'
+                                        f'{native.SUPERVISOR_NAME}')
+        env_full = {k: str(v) for k, v in env.items()}
+        return runner.stream_run(wrapped, env_full, host_log,
+                                 _hook_factory())
     if isinstance(runner, LocalProcessRunner):
         # Same machine: use the client-built binary by absolute path (the
         # per-host fake $HOME has no native/bin of its own).

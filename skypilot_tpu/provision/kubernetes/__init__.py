@@ -38,6 +38,10 @@ logger = logsys.init_logger(__name__)
 LABEL = 'skytpu/cluster'
 DEFAULT_IMAGE = 'python:3.11-slim'
 _WAIT_TIMEOUT = 1800
+# Pending + Unschedulable for this long = the k8s stockout (no node
+# pool has capacity for the podslice) -> TpuStockoutError feeds the
+# backend's zone blocklist failover.  Module-level so tests can shrink.
+UNSCHEDULABLE_GRACE = 300
 
 
 def _kubectl(args: List[str], stdin: Optional[str] = None,
@@ -116,8 +120,15 @@ def _service_manifest(cluster_name: str) -> Dict:
 
 def run_instances(region: str, zone: Optional[str], cluster_name: str,
                   config: Dict) -> ProvisionRecord:
-    num_hosts = int(config.get('num_hosts', 1)) * \
-        int(config.get('num_slices', 1))
+    if int(config.get('num_slices', 1)) > 1:
+        # Belt-and-braces behind the backend's MULTI_SLICE feasibility
+        # gate: multislice (MEGASCALE over DCN) on GKE needs JobSet-style
+        # slice grouping the pod-per-host layout cannot express.
+        raise exceptions.ProvisionError(
+            'kubernetes cannot gang-provision multiple podslices '
+            f'(num_slices={config["num_slices"]}); use cloud: gcp',
+            retryable=False)
+    num_hosts = int(config.get('num_hosts', 1))
     existing = query_instances(cluster_name)
     if existing and all(s == 'running' for s in existing.values()):
         return ProvisionRecord('kubernetes', cluster_name, region, zone,
@@ -146,7 +157,8 @@ def wait_instances(region: str, zone: Optional[str], cluster_name: str,
     del region, zone
     if state != 'running':
         return
-    deadline = time.time() + _WAIT_TIMEOUT
+    start = time.time()
+    deadline = start + _WAIT_TIMEOUT
     while time.time() < deadline:
         pods = _get_pods(cluster_name)
         phases = [p.get('status', {}).get('phase') for p in pods]
@@ -157,13 +169,14 @@ def wait_instances(region: str, zone: Optional[str], cluster_name: str,
                 f'pod(s) of {cluster_name} failed: {phases}')
         # Unschedulable podslices surface as Pending with a
         # FailedScheduling condition — that is the k8s stockout.
-        for p in pods:
-            for cond in p.get('status', {}).get('conditions', []):
-                if (cond.get('reason') == 'Unschedulable' and
-                        time.time() > deadline - _WAIT_TIMEOUT + 300):
-                    raise exceptions.TpuStockoutError(
-                        f'{cluster_name}: unschedulable after 300s: '
-                        f'{cond.get("message", "")[:200]}')
+        if time.time() - start >= UNSCHEDULABLE_GRACE:
+            for p in pods:
+                for cond in p.get('status', {}).get('conditions', []):
+                    if cond.get('reason') == 'Unschedulable':
+                        raise exceptions.TpuStockoutError(
+                            f'{cluster_name}: unschedulable after '
+                            f'{UNSCHEDULABLE_GRACE}s: '
+                            f'{cond.get("message", "")[:200]}')
         time.sleep(5)
     raise exceptions.ProvisionError(
         f'{cluster_name}: pods not Running within {_WAIT_TIMEOUT}s')

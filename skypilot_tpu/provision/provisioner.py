@@ -124,6 +124,13 @@ def post_provision_runtime_setup(cluster_name: str, cluster_info: ClusterInfo,
 
     subprocess_utils.run_in_parallel(_sync_runtime, list(range(len(runners))))
 
+    if cluster_info.provider == 'kubernetes' and len(runners) > 1:
+        # Multi-host podslice: pods carry no sshd, so the head-pod gang
+        # driver reaches workers over the podlet agent (podlet/agent.py)
+        # — start one per worker pod, authed by a per-cluster token.
+        _setup_pod_agents(cluster_name, cluster_info, runners, token,
+                          log_path)
+
     # Head host extras: cluster info (for the gang driver + autostop) and
     # the private key so the head can reach workers over internal IPs.
     head = runners[0]
@@ -155,6 +162,88 @@ def post_provision_runtime_setup(cluster_name: str, cluster_info: ClusterInfo,
                        up=True, log_path=log_path)
 
     _start_podlet(cluster_name, head, token, log_path)
+
+
+def _agent_token(cluster_name: str) -> str:
+    """Per-cluster agent auth token, persisted so resumes reuse it."""
+    path = os.path.join(metadata_dir(cluster_name), 'agent_token')
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        import secrets
+        tok = secrets.token_hex(16)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(tok)
+        return tok
+
+
+def _setup_pod_agents(cluster_name: str, cluster_info: ClusterInfo,
+                      runners: List[runner_lib.CommandRunner],
+                      version_token: str, log_path: str) -> None:
+    """Write the auth token to every pod and (re)start the exec agent on
+    the worker pods (rank >= 1); the head pod needs no agent — the
+    driver runs there.  Idempotent + version-gated like the podlet."""
+    from skypilot_tpu.podlet.agent import AGENT_PORT_BASE
+    agent_token = _agent_token(cluster_name)
+
+    def _one(rank: int) -> None:
+        runner = runners[rank]
+        import shlex
+        runner.run_or_raise(
+            'mkdir -p ~/.skytpu && umask 077 && '
+            f'printf %s {shlex.quote(agent_token)} > ~/.skytpu/agent_token',
+            log_path=log_path)
+        if rank == 0:
+            return
+        port = AGENT_PORT_BASE + rank
+        # The version token is recorded ONLY after a successful connect
+        # check (below): a fire-and-forget nohup always exits 0, and a
+        # bind/startup failure stamped as "current" would never be
+        # retried — it would surface days later as an opaque job error.
+        check_and_start = (
+            f'export PYTHONPATH={_RUNTIME_DIR}:$PYTHONPATH; '
+            f'mkdir -p ~/.skytpu/agent; '
+            f'CUR=$(cat ~/.skytpu/agent/version.token 2>/dev/null '
+            f'|| echo none); '
+            f'PID=$(cat ~/.skytpu/agent/pid 2>/dev/null || true); '
+            f'ALIVE=no; '
+            f'if [ -n "$PID" ] && kill -0 "$PID" 2>/dev/null; '
+            f'then ALIVE=yes; fi; '
+            f'if [ "$CUR" != "{version_token}" ] || [ "$ALIVE" != yes ]; '
+            f'then '
+            f'  if [ -n "$PID" ]; then kill "$PID" 2>/dev/null || true; fi; '
+            f'  rm -f ~/.skytpu/agent/version.token; '
+            f'  nohup python3 -m skypilot_tpu.podlet.agent --port {port} '
+            f'    >> ~/.skytpu/agent/agent.log 2>&1 & '
+            f'  echo $! > ~/.skytpu/agent/pid; '
+            f'fi')
+        runner.run_or_raise(check_and_start, log_path=log_path)
+        # Connect check runs ON the pod (pod IPs are cluster-internal —
+        # the client cannot reach them directly).
+        import time
+        ping = ('python3 -c \'import socket; '
+                f'socket.create_connection(("127.0.0.1", {port}), '
+                '2).close()\'')
+        deadline = time.time() + 60
+        while True:
+            if runner.run(ping, log_path=log_path) == 0:
+                break
+            if time.time() > deadline:
+                raise exceptions.ProvisionError(
+                    f'podlet agent on {runner.node_id} did not come up '
+                    f'on port {port} within 60s — see '
+                    '~/.skytpu/agent/agent.log on the pod',
+                    retryable=False)
+            time.sleep(2)
+        runner.run_or_raise(
+            f'echo {version_token} > ~/.skytpu/agent/version.token',
+            log_path=log_path)
+
+    subprocess_utils.run_in_parallel(_one, list(range(len(runners))))
+    cluster_info.custom['agent_token'] = agent_token
+    cluster_info.custom['agent_port_base'] = AGENT_PORT_BASE
 
 
 def _start_podlet(cluster_name: str, head: runner_lib.CommandRunner,

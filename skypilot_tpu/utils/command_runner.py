@@ -28,7 +28,12 @@ SSH_COMMON_OPTIONS = [
 ]
 _SSH_CONTROL_DIR = '/tmp/skytpu_ssh_control'
 
-RSYNC_EXCLUDES = ['.git/', '__pycache__/', '.venv/', '*.pyc', '.DS_Store']
+RSYNC_EXCLUDES = ['.git/', '__pycache__/', '.venv/', '*.pyc', '.DS_Store',
+                  # Framework state must never ship inside a workdir
+                  # sync: a workdir that resolves to (or contains) a
+                  # host's HOME would otherwise recursively copy cluster
+                  # state into every replica/job it launches.
+                  '.skytpu/', '.skytpu_runtime/', 'sky_logs/']
 
 
 class CommandRunner:
@@ -254,6 +259,120 @@ def wait_for_connection(runners: List[CommandRunner],
             f'Hosts not reachable after {timeout}s: {ids}')
 
 
+class PodAgentRunner(CommandRunner):
+    """A worker pod as a host, reached over the podlet agent's TCP
+    protocol (podlet/agent.py) on the pod network.
+
+    This is the HEAD-POD side of multi-host kubernetes gangs: pods have
+    no sshd and no kubectl, so the gang driver cannot use
+    SSHCommandRunner/KubernetesPodRunner from inside the cluster — it
+    speaks the agent's JSON-lines protocol instead.
+    """
+
+    def __init__(self, ip: str, port: int, token: str,
+                 node_id: Optional[str] = None,
+                 connect_timeout: float = 30.0):
+        super().__init__(node_id or f'{ip}:{port}')
+        self.ip = ip
+        self.port = port
+        self.token = token
+        self.connect_timeout = connect_timeout
+
+    def _request(self, payload: Dict, line_hook=None,
+                 log_file=None) -> Dict:
+        """One request -> final response dict; 'line' messages stream
+        into log_file/line_hook as they arrive."""
+        import json
+        import socket
+        with socket.create_connection((self.ip, self.port),
+                                      timeout=self.connect_timeout) as s:
+            payload = dict(payload, token=self.token)
+            s.sendall((json.dumps(payload) + '\n').encode())
+            # Command output is unbounded in time: no read timeout.
+            s.settimeout(None)
+            buf = s.makefile('r', encoding='utf-8', errors='replace')
+            for line in buf:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                if 'line' in msg:
+                    text = msg['line'] + '\n'
+                    if log_file is not None:
+                        log_file.write(text)
+                        log_file.flush()
+                    if line_hook is not None:
+                        line_hook(text)
+                else:
+                    return msg
+        return {'error': 'agent closed the connection', 'rc': 255}
+
+    def run(self, cmd, *, log_path='/dev/null', stream_logs=False,
+            require_outputs=False, cwd=None, env=None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        if cwd:
+            cmd = f'cd {shlex.quote(cwd)} && {cmd}'
+        lines: List[str] = []
+        hook = lines.append if require_outputs else None
+        try:
+            with open(os.path.expanduser(log_path), 'a',
+                      encoding='utf-8') as f:
+                msg = self._request({'op': 'run', 'cmd': cmd,
+                                     'env': env or {}},
+                                    line_hook=hook, log_file=f)
+        except OSError as e:
+            if require_outputs:
+                return 255, '', f'agent {self.node_id}: {e}'
+            return 255
+        rc = int(msg.get('rc', 255))
+        if require_outputs:
+            return rc, ''.join(lines), msg.get('error', '')
+        return rc
+
+    def stream_run(self, cmd: str, env: Optional[Dict[str, str]],
+                   log_path: str, line_hook) -> int:
+        """Run streaming output into log_path AND line_hook (the gang
+        driver's per-host log fan-in)."""
+        with open(os.path.expanduser(log_path), 'a',
+                  encoding='utf-8') as f:
+            try:
+                msg = self._request({'op': 'run', 'cmd': cmd,
+                                     'env': env or {}},
+                                    line_hook=line_hook, log_file=f)
+            except OSError as e:
+                f.write(f'[agent] connection failed: {e}\n')
+                return 255
+        return int(msg.get('rc', 255))
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        import base64
+        if not up or os.path.isdir(source):
+            raise exceptions.NotSupportedError(
+                'PodAgentRunner syncs single files up only (the gang '
+                'driver ships run scripts; the provisioner syncs trees '
+                'via kubectl from the client)')
+        with open(os.path.expanduser(source), 'rb') as f:
+            data = base64.b64encode(f.read()).decode()
+        try:
+            msg = self._request({'op': 'put', 'path': target,
+                                 'data': data, 'mode': 0o755})
+        except OSError as e:
+            raise exceptions.CommandError(
+                255, f'put {target}', f'agent {self.node_id}: {e}') from e
+        if not msg.get('ok'):
+            raise exceptions.CommandError(
+                int(msg.get('rc', 1)), f'put {target}',
+                str(msg.get('error', 'agent put failed')))
+
+    def check_connection(self) -> bool:
+        try:
+            return bool(self._request({'op': 'ping'}).get('ok'))
+        except OSError:
+            return False
+
+
 class KubernetesPodRunner(CommandRunner):
     """A pod as a host: commands via `kubectl exec`, file sync via
     `kubectl cp` (tar must exist in the image — true of the default
@@ -313,55 +432,65 @@ class KubernetesPodRunner(CommandRunner):
         exclude .git//__pycache__.
         """
 
-        def pod_path(p: str) -> str:
-            # No '~' expansion inside the pod: resolve to /root (the
-            # default image user).
+        def qpod(p: str) -> str:
+            """Pod path -> shell word for the POD's sh.  '~' cannot
+            expand inside the quoted sh -c operand, so emit an unquoted
+            "$HOME" prefix the pod's sh expands itself (correct for any
+            image user, unlike a hardcoded /root)."""
+            if p == '~':
+                return '"$HOME"'
             if p.startswith('~/'):
-                return '/root/' + p[2:]
-            return '/root' if p == '~' else p
+                return '"$HOME"/' + shlex.quote(p[2:])
+            return shlex.quote(p)
 
         excludes = ' '.join(
             f"--exclude={shlex.quote(p.rstrip('/'))}"
             for p in RSYNC_EXCLUDES)
         kexec = ' '.join(shlex.quote(c) for c in self._base() + [
             'exec', '-i', self.pod_name, '-c', self.container, '--'])
+        # Inner pod-side scripts are built fully quoted FIRST, then quoted
+        # once as a single sh -c operand: nesting shlex.quote()'d paths
+        # inside an outer '...' literal breaks (the inner quotes terminate
+        # the outer ones) on any path that actually needs quoting.
         if up:
             src = os.path.expanduser(source)
-            dst = pod_path(target)
+            dst = target.rstrip('/')
             if os.path.isdir(src):
-                dst_dir = shlex.quote(dst.rstrip('/'))
+                dst_dir = qpod(dst)
+                inner = f'mkdir -p {dst_dir} && tar -C {dst_dir} -xf -'
                 cmd = (f'tar -C {shlex.quote(src)} {excludes} -cf - . | '
-                       f'{kexec} sh -c '
-                       f"'mkdir -p {dst_dir} && tar -C {dst_dir} -xf -'")
+                       f'{kexec} sh -c {shlex.quote(inner)}')
             else:
-                dst_dir, dst_base = os.path.split(dst.rstrip('/'))
-                dst_dir = dst_dir or '/root'
+                dst_dir, dst_base = os.path.split(dst)
+                dst_dir = dst_dir or '~'
+                dst_file = (f'{dst_dir}/'
+                            f'{dst_base or os.path.basename(src)}')
+                inner = (f'mkdir -p {qpod(dst_dir)} && '
+                         f'cat > {qpod(dst_file)}')
                 cmd = (f'cat {shlex.quote(src)} | {kexec} sh -c '
-                       f"'mkdir -p {shlex.quote(dst_dir)} && "
-                       f"cat > {shlex.quote(dst_dir)}/"
-                       f"{shlex.quote(dst_base or os.path.basename(src))}'")
+                       f'{shlex.quote(inner)}')
         else:
-            src = pod_path(source)
+            src = source
             dst = os.path.expanduser(target)
             if source.endswith('/'):
                 os.makedirs(dst, exist_ok=True)
-                cmd = (f'{kexec} sh -c '
-                       f"'tar -C {shlex.quote(src.rstrip('/'))} -cf - .'"
+                inner = f"tar -C {qpod(src.rstrip('/'))} -cf - ."
+                cmd = (f'{kexec} sh -c {shlex.quote(inner)}'
                        f' | tar -C {shlex.quote(dst)} -xf -')
             else:
                 os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
                 # Two shapes: remote dir -> extract into dst dir;
                 # remote file -> plain byte copy.  Decide via a cheap
                 # remote test to keep the pipe itself simple.
-                rc = self.run(f'test -d {shlex.quote(src)}',
-                              log_path=log_path)
+                rc = self.run(f'test -d {qpod(src)}', log_path=log_path)
                 if rc == 0:
                     os.makedirs(dst, exist_ok=True)
-                    cmd = (f'{kexec} sh -c '
-                           f"'tar -C {shlex.quote(src)} -cf - .' | "
+                    inner = f'tar -C {qpod(src)} -cf - .'
+                    cmd = (f'{kexec} sh -c {shlex.quote(inner)} | '
                            f'tar -C {shlex.quote(dst)} -xf -')
                 else:
-                    cmd = (f'{kexec} cat {shlex.quote(src)} > '
+                    inner = f'cat {qpod(src)}'
+                    cmd = (f'{kexec} sh -c {shlex.quote(inner)} > '
                            f'{shlex.quote(dst)}')
         rc, tail = subprocess_utils.run_with_log(cmd, log_path, shell=True)
         if rc != 0:
