@@ -1,0 +1,161 @@
+"""BERT sequence-classification finetune (IMDB-style) on TPU.
+
+Analog of the reference's examples/huggingface_glue_imdb_app.yaml
+(HF run_glue.py on a provisioned GPU VM), rebuilt JAX-native on the
+framework's BERT family: data-parallel over the device mesh, bf16
+encoder on the MXU, one jit'd train step.
+
+Data: `--dataset imdb` tokenizes the real IMDB set via `datasets` +
+`transformers` when those are installed; `--dataset synthetic` (the
+hermetic default for CI) generates a *learnable* stand-in — each
+sequence is drawn from a class-conditioned token distribution, so
+accuracy above chance proves the end-to-end learning path, not just
+that the step runs.
+
+Examples:
+  # v5e-8, real IMDB:
+  python examples/finetune_bert.py --model bert-base --dataset imdb
+
+  # hermetic CPU smoke:
+  python examples/finetune_bert.py --model bert-debug \
+      --dataset synthetic --steps 30 --batch-size 8 --seq-len 64
+"""
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def synthetic_batches(rng: np.random.Generator, vocab_size: int,
+                      batch_size: int, seq_len: int, num_classes: int):
+    """Class-conditioned token streams: class c favors the c-th slice of
+    the vocabulary 3:1, so a linear probe over a [CLS] encoding can
+    separate the classes — loss must fall and accuracy must rise."""
+    bucket = max(vocab_size // num_classes, 1)
+    while True:
+        labels = rng.integers(0, num_classes, size=batch_size)
+        favored = rng.integers(0, bucket, size=(batch_size, seq_len)) + \
+            (labels[:, None] * bucket)
+        uniform = rng.integers(0, vocab_size, size=(batch_size, seq_len))
+        pick = rng.random((batch_size, seq_len)) < 0.75
+        tokens = np.where(pick, favored, uniform)
+        yield {'tokens': tokens.astype(np.int32),
+               'labels': labels.astype(np.int32)}
+
+
+def imdb_batches(batch_size: int, seq_len: int):
+    """Real IMDB via `datasets` (needs network/installed data)."""
+    try:
+        import datasets  # type: ignore
+        import transformers
+    except ImportError as e:
+        raise SystemExit(
+            f'--dataset imdb needs the `datasets` package ({e}); '
+            'use --dataset synthetic for a hermetic run') from e
+    ds = datasets.load_dataset('imdb', split='train').shuffle(seed=0)
+    tok = transformers.AutoTokenizer.from_pretrained('bert-base-uncased')
+    while True:
+        for i in range(0, len(ds) - batch_size, batch_size):
+            rows = ds[i:i + batch_size]
+            enc = tok(rows['text'], truncation=True, padding='max_length',
+                      max_length=seq_len, return_tensors='np')
+            yield {'tokens': enc['input_ids'].astype(np.int32),
+                   'labels': np.asarray(rows['label'], np.int32)}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='bert-base')
+    parser.add_argument('--dataset', default='synthetic',
+                        choices=['synthetic', 'imdb'])
+    parser.add_argument('--steps', type=int, default=200)
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--seq-len', type=int, default=128)
+    parser.add_argument('--lr', type=float, default=2e-5)
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--platform', default=None,
+                        choices=['cpu', 'tpu'],
+                        help='pin jax onto this platform (hosts whose '
+                             'site hooks rewrite JAX_PLATFORMS need the '
+                             'post-import pin; hermetic CI uses cpu)')
+    args = parser.parse_args()
+    if args.platform:
+        jax.config.update('jax_platforms', args.platform)
+
+    from skypilot_tpu.models import get_model_config
+    from skypilot_tpu.models.bert import BertForSequenceClassification
+    from skypilot_tpu.parallel import MeshSpec, make_mesh, mesh as mesh_lib
+
+    mesh_lib.initialize_distributed_from_env()
+    mesh = make_mesh(MeshSpec(data=len(jax.devices())))
+    P = jax.sharding.PartitionSpec
+
+    def put(tree, pspec):
+        """Host values -> global arrays on the mesh.  Multi-process:
+        each process contributes its LOCAL rows (host_local -> global);
+        single-process: plain device_put."""
+        if jax.process_count() == 1:
+            return jax.device_put(
+                tree, jax.sharding.NamedSharding(mesh, pspec))
+        from jax.experimental import multihost_utils
+        return multihost_utils.host_local_array_to_global_array(
+            tree, mesh, pspec)
+
+    cfg = get_model_config(args.model)
+    model = BertForSequenceClassification(cfg)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, args.seq_len), jnp.int32))
+    opt = optax.adamw(args.lr, weight_decay=0.01)
+    opt_state = put(opt.init(params), P())
+    params = put(params, P())      # same seed everywhere -> replicated
+
+    def loss_fn(p, tokens, labels):
+        logits = model.apply(p, tokens)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, acc
+
+    @jax.jit
+    def step(p, s, batch):
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, batch['tokens'], batch['labels'])
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss, acc
+
+    nproc = jax.process_count()
+    if args.batch_size % nproc:
+        raise SystemExit(f'--batch-size {args.batch_size} must divide '
+                         f'across {nproc} processes')
+    local_bs = args.batch_size // nproc
+    if local_bs % jax.local_device_count():
+        raise SystemExit(
+            f'per-process batch {local_bs} must divide by the '
+            f'{jax.local_device_count()} local devices')
+    rng = np.random.default_rng(args.seed * 1000 + jax.process_index())
+    batches = (synthetic_batches(rng, cfg.vocab_size, local_bs,
+                                 args.seq_len, cfg.num_classes)
+               if args.dataset == 'synthetic' else
+               imdb_batches(local_bs, args.seq_len))
+    t0 = time.time()
+    first_loss = last_acc = None
+    for i in range(args.steps):
+        batch = put(next(batches), P('data'))
+        params, opt_state, loss, acc = step(params, opt_state, batch)
+        if i % 10 == 0 or i == args.steps - 1:
+            loss, acc = float(loss), float(acc)
+            if first_loss is None:
+                first_loss = loss
+            last_acc = acc
+            print(f'step {i}: loss {loss:.4f} acc {acc:.3f}', flush=True)
+    elapsed = time.time() - t0
+    seqs = args.steps * args.batch_size
+    print(f'done: {seqs / elapsed:.1f} sequences/s, final acc '
+          f'{last_acc:.3f} (first loss {first_loss:.4f})')
+
+
+if __name__ == '__main__':
+    main()

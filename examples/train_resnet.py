@@ -1,0 +1,179 @@
+"""Distributed ResNet training on TPU (data-parallel over the mesh).
+
+Analog of the reference's examples/resnet_distributed_torch.yaml
+(torch DDP over N GPU nodes via torch.distributed.launch), rebuilt
+JAX-native: one jit'd SGD step with the batch sharded over the mesh's
+data axis — XLA inserts the gradient all-reduce over ICI, no
+torchrun/master_addr plumbing (multi-host rendezvous comes from the
+framework env via initialize_distributed_from_env).
+
+Data: CIFAR-shaped synthetic images by default (hermetic, no egress):
+each class gets a fixed random mean image + noise, so the model must
+actually learn class structure — accuracy above chance proves the
+training path end to end.  `--data-dir` points at a CIFAR-10 python
+pickle tree for the real thing.
+
+Examples:
+  # v5e-8 single host:
+  python examples/train_resnet.py --model resnet50 --batch-size 256
+
+  # hermetic CPU smoke:
+  python examples/train_resnet.py --model resnet18-debug \
+      --steps 30 --batch-size 16 --image-size 32 --num-classes 4
+"""
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def synthetic_batches(seed: int, proc_seed: int, batch_size: int,
+                      image_size: int, num_classes: int):
+    """Class-conditioned images: fixed per-class mean + gaussian noise.
+    The class means come from `seed` alone so every process of a
+    distributed run learns the SAME task; the sample stream is offset
+    by `proc_seed` so shards differ."""
+    means = np.random.default_rng(seed).normal(
+        0.0, 1.0, size=(num_classes, image_size, image_size, 3))
+    rng = np.random.default_rng(seed * 1000 + proc_seed + 1)
+    while True:
+        labels = rng.integers(0, num_classes, size=batch_size)
+        images = means[labels] + rng.normal(
+            0.0, 0.8, size=(batch_size, image_size, image_size, 3))
+        yield {'images': images.astype(np.float32),
+               'labels': labels.astype(np.int32)}
+
+
+def cifar_batches(data_dir: str, batch_size: int, proc_seed: int = 0):
+    """CIFAR-10 python-pickle batches (the reference recipe's dataset).
+    `proc_seed` de-correlates the shards of a distributed run."""
+    import glob
+    import pickle
+    files = sorted(glob.glob(f'{data_dir}/data_batch_*'))
+    if not files:
+        raise SystemExit(f'no CIFAR data_batch_* under {data_dir}')
+    xs, ys = [], []
+    for f in files:
+        with open(f, 'rb') as fh:
+            d = pickle.load(fh, encoding='bytes')
+        xs.append(np.asarray(d[b'data'], np.float32).reshape(
+            -1, 3, 32, 32).transpose(0, 2, 3, 1) / 127.5 - 1.0)
+        ys.append(np.asarray(d[b'labels'], np.int32))
+    x, y = np.concatenate(xs), np.concatenate(ys)
+    rng = np.random.default_rng(proc_seed)
+    while True:
+        order = rng.permutation(len(x))
+        for i in range(0, len(order) - batch_size, batch_size):
+            idx = order[i:i + batch_size]
+            yield {'images': x[idx], 'labels': y[idx]}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='resnet50')
+    parser.add_argument('--steps', type=int, default=200)
+    parser.add_argument('--batch-size', type=int, default=256,
+                        help='global batch (sharded over the data axis)')
+    parser.add_argument('--image-size', type=int, default=32)
+    parser.add_argument('--num-classes', type=int, default=10)
+    parser.add_argument('--lr', type=float, default=0.1)
+    parser.add_argument('--momentum', type=float, default=0.9)
+    parser.add_argument('--data-dir', default=None,
+                        help='CIFAR-10 pickle dir; default synthetic')
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--platform', default=None,
+                        choices=['cpu', 'tpu'],
+                        help='pin jax onto this platform (hosts whose '
+                             'site hooks rewrite JAX_PLATFORMS need the '
+                             'post-import pin; hermetic CI uses cpu)')
+    args = parser.parse_args()
+    if args.platform:
+        jax.config.update('jax_platforms', args.platform)
+
+    import dataclasses
+
+    from skypilot_tpu.models import get_model_config
+    from skypilot_tpu.models.resnet import ResNet
+    from skypilot_tpu.parallel import MeshSpec, make_mesh, mesh as mesh_lib
+
+    mesh_lib.initialize_distributed_from_env()
+    mesh = make_mesh(MeshSpec(data=len(jax.devices())))
+    P = jax.sharding.PartitionSpec
+
+    def put(tree, pspec):
+        """Host values -> global arrays on the mesh.  Multi-process:
+        each process contributes its LOCAL rows (host_local -> global);
+        single-process: plain device_put."""
+        if jax.process_count() == 1:
+            return jax.device_put(
+                tree, jax.sharding.NamedSharding(mesh, pspec))
+        from jax.experimental import multihost_utils
+        return multihost_utils.host_local_array_to_global_array(
+            tree, mesh, pspec)
+
+    cfg = dataclasses.replace(get_model_config(args.model),
+                              num_classes=args.num_classes)
+    model = ResNet(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(args.seed),
+        jnp.zeros((1, args.image_size, args.image_size, 3)), train=True)
+    opt = optax.sgd(optax.cosine_decay_schedule(args.lr, args.steps),
+                    momentum=args.momentum, nesterov=True)
+    opt_state = put(opt.init(variables['params']), P())
+    state = put({'params': variables['params'],
+                 'batch_stats': variables['batch_stats']},
+                P())               # same seed everywhere -> replicated
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {'params': params, 'batch_stats': batch_stats}, images,
+            train=True, mutable=['batch_stats'])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, (acc, mutated['batch_stats'])
+
+    @jax.jit
+    def step(state, opt_state, batch):
+        (loss, (acc, stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state['params'], state['batch_stats'],
+                                   batch['images'], batch['labels'])
+        updates, opt_state = opt.update(grads, opt_state, state['params'])
+        params = optax.apply_updates(state['params'], updates)
+        return ({'params': params, 'batch_stats': stats}, opt_state,
+                loss, acc)
+
+    nproc = jax.process_count()
+    if args.batch_size % nproc:
+        raise SystemExit(f'--batch-size {args.batch_size} must divide '
+                         f'across {nproc} processes')
+    local_bs = args.batch_size // nproc
+    if local_bs % jax.local_device_count():
+        raise SystemExit(
+            f'per-process batch {local_bs} must divide by the '
+            f'{jax.local_device_count()} local devices')
+    batches = (cifar_batches(args.data_dir, local_bs,
+                             jax.process_index())
+               if args.data_dir else
+               synthetic_batches(args.seed, jax.process_index(),
+                                 local_bs, args.image_size,
+                                 args.num_classes))
+    t0 = time.time()
+    last_acc = None
+    for i in range(args.steps):
+        batch = put(next(batches), P('data'))
+        state, opt_state, loss, acc = step(state, opt_state, batch)
+        if i % 10 == 0 or i == args.steps - 1:
+            last_acc = float(acc)
+            print(f'step {i}: loss {float(loss):.4f} acc {last_acc:.3f}',
+                  flush=True)
+    elapsed = time.time() - t0
+    print(f'done: {args.steps * args.batch_size / elapsed:.1f} images/s, '
+          f'final acc {last_acc:.3f}')
+
+
+if __name__ == '__main__':
+    main()

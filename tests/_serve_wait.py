@@ -1,0 +1,71 @@
+"""Smoke helper: wait for a service to go READY, then drive one
+/generate request through the load balancer.
+
+Usage: python tests/_serve_wait.py <service> [--replicas N]
+       [--timeout S] [--generate]
+Exit 0 = service READY (and, with --generate, the LB returned tokens).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+# Runnable straight from a checkout (the smoke harness invokes it as a
+# script, so only tests/ would be on sys.path).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('service')
+    parser.add_argument('--replicas', type=int, default=1)
+    parser.add_argument('--timeout', type=float, default=600)
+    parser.add_argument('--generate', action='store_true')
+    args = parser.parse_args()
+
+    from skypilot_tpu.serve import core as serve_core
+    deadline = time.time() + args.timeout
+    svc = None
+    while time.time() < deadline:
+        svcs = serve_core.status([args.service])
+        if svcs:
+            svc = svcs[0]
+            ready = [r for r in svc['replicas']
+                     if r['status'] == 'READY']
+            if svc['status'] == 'READY' and len(ready) >= args.replicas:
+                break
+        time.sleep(3)
+    else:
+        print(f'timeout; last status: {svc}', file=sys.stderr)
+        return 1
+    print(f"READY with {args.replicas}+ replicas at {svc['endpoint']}")
+    if not args.generate:
+        return 0
+    body = json.dumps({'tokens': [1, 2, 3, 4], 'max_new_tokens': 8})
+    req = urllib.request.Request(
+        svc['endpoint'] + '/generate', data=body.encode(),
+        headers={'Content-Type': 'application/json'})
+    deadline = time.time() + 120
+    while True:
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+            break
+        except Exception as e:  # pylint: disable=broad-except
+            if time.time() > deadline:
+                print(f'generate failed: {e}', file=sys.stderr)
+                return 1
+            time.sleep(3)
+    toks = out.get('output_tokens')
+    if not toks:
+        print(f'no output tokens: {out}', file=sys.stderr)
+        return 1
+    print(f'generated {len(toks)} tokens through the LB: {toks}')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

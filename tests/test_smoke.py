@@ -120,6 +120,55 @@ _LOCAL_TESTS = [
         ],
         teardown=f'{SKYTPU} down -y smka'),
     SmokeTest(
+        # BASELINE.json flagship recipe 4/5 (ref
+        # examples/huggingface_glue_imdb_app.yaml): the real YAML,
+        # shrunk via its CI envs (bert-debug, synthetic IMDB stand-in).
+        name='recipe-bert-imdb',
+        commands=[
+            _ENABLE_LOCAL,
+            f'{SKYTPU} launch -y -c smkb --cloud local '
+            # BATCH=8: the harness forces 8 virtual CPU devices and the
+            # data axis spans them, so the batch must divide by 8.
+            '--env MODEL=bert-debug --env DATASET=synthetic '
+            '--env STEPS=15 --env BATCH=8 --env SEQLEN=32 '
+            '--env PLATFORM=cpu examples/bert_imdb.yaml',
+            f'{SKYTPU} logs smkb 1 | grep -q "final acc"',
+        ],
+        teardown=f'{SKYTPU} down -y smkb',
+        timeout=20 * 60),
+    SmokeTest(
+        # BASELINE.json flagship recipe 5/5 (ref
+        # examples/resnet_distributed_torch.yaml): 2-node gang via the
+        # real YAML (num_nodes: 2), shrunk via its CI envs.
+        name='recipe-resnet',
+        commands=[
+            _ENABLE_LOCAL,
+            f'{SKYTPU} launch -y -c smkr --cloud local '
+            # BATCH=16: 2 processes x 8 forced CPU devices — the LOCAL
+            # batch (global/2) must divide by the 8 local devices.
+            '--env MODEL=resnet18-debug --env STEPS=15 --env BATCH=16 '
+            '--env PLATFORM=cpu examples/resnet.yaml',
+            f'{SKYTPU} logs smkr 1 | grep -q "final acc"',
+        ],
+        teardown=f'{SKYTPU} down -y smkr',
+        timeout=20 * 60),
+    SmokeTest(
+        # BASELINE.json flagship recipe 3/5 (ref llm/mixtral/serve.yaml):
+        # serve up through the REAL serve plane on the local cloud —
+        # controller, prober, LB — then one /generate through the LB.
+        name='recipe-serve-mixtral',
+        commands=[
+            _ENABLE_LOCAL,
+            f'{SKYTPU} serve up -y examples/serve_mixtral.yaml '
+            '-n smkmx --cloud local '
+            '--env MODEL=mixtral-debug --env TP=1 --env SLOTS=4 '
+            '--env MAXCACHE=128 --env PLATFORM=cpu',
+            f'{sys.executable} tests/_serve_wait.py smkmx '
+            '--replicas 2 --timeout 900 --generate',
+        ],
+        teardown=f'{SKYTPU} serve down -y smkmx || true',
+        timeout=20 * 60),
+    SmokeTest(
         name='cli-surfaces',
         commands=[
             _ENABLE_LOCAL,
