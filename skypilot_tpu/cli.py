@@ -891,11 +891,19 @@ def infer():
 @click.option('--prefills-per-gap', type=int, default=4,
               help='Max prefills between decode windows '
                    '(latency/throughput knob).')
+@click.option('--platform', default=None,
+              type=click.Choice(['cpu', 'tpu']),
+              help='Pin jax onto this platform (CPU replicas for dev '
+                   'serving / hermetic CI; default = jax\'s pick).')
+@click.option('--max-ttft', type=float, default=None,
+              help='Admission bound (s): shed requests (HTTP 429 + '
+                   'Retry-After) whose projected TTFT exceeds this '
+                   'instead of queueing unboundedly. Default: off.')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
                 tensor_parallel, weight_dtype, profile,
-                prefills_per_gap):
+                prefills_per_gap, platform, max_ttft):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
@@ -911,7 +919,8 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      cache_dtype=cache_dtype,
                      tensor_parallel=tensor_parallel,
                      weight_dtype=weight_dtype,
-                     prefills_per_gap=prefills_per_gap)
+                     prefills_per_gap=prefills_per_gap,
+                     platform=platform, max_ttft=max_ttft)
 
 
 @infer.command('bench')

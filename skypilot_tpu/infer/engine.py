@@ -743,7 +743,10 @@ class InferenceEngine:
             'ttft_p99_s': pct(ttfts, 0.99),
             'tpot_median_s': pct(tpots, 0.5),
             'tpot_p99_s': pct(tpots, 0.99),
-            'offered_qps': qps or float('inf'),
+            # None (JSON null) when no arrival rate was set: float('inf')
+            # serializes as the non-standard token 'Infinity' that strict
+            # parsers (jq) reject.
+            'offered_qps': qps if qps else None,
             'completed': len(results),
             'elapsed_s': elapsed,
         }

@@ -31,12 +31,31 @@ from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
                                        resolve_cache_dtype)
 
 
+class AdmissionError(Exception):
+    """Request shed at admission: projected TTFT exceeds the bound."""
+
+    def __init__(self, projected_s: float, bound_s: float):
+        self.projected_s = projected_s
+        self.bound_s = bound_s
+        super().__init__(
+            f'overloaded: projected TTFT {projected_s:.1f}s exceeds the '
+            f'{bound_s:.1f}s admission bound')
+
+
 class InferenceServer:
 
     def __init__(self, engine: InferenceEngine,
-                 tokenizer: Optional[object] = None):
+                 tokenizer: Optional[object] = None,
+                 max_projected_ttft_s: Optional[float] = None):
+        """max_projected_ttft_s: admission bound (VERDICT r2 weak #5) —
+        shed (AdmissionError -> HTTP 429 + Retry-After) instead of
+        queueing a request whose projected TTFT exceeds this.  The
+        projection is (backlog ahead + 1) / recent first-token service
+        rate, measured over the last first-token completions.  None =
+        admit everything (unbounded queue wait)."""
         self.engine = engine
         self.tokenizer = tokenizer
+        self.max_projected_ttft_s = max_projected_ttft_s
         self.ready = threading.Event()
         self._queue: 'queue.Queue[Request]' = queue.Queue()
         self._results: Dict[str, RequestResult] = {}
@@ -44,6 +63,14 @@ class InferenceServer:
         self._stream_queues: Dict[str, 'queue.Queue'] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
+        # Admission bookkeeping: requests admitted but first-token-less,
+        # and recent first-token completion times (service-rate window).
+        self._adm_lock = threading.Lock()
+        self._awaiting_first: set = set()
+        import collections
+        self._first_token_times: 'collections.deque' = collections.deque(
+            maxlen=32)
+        self.shed_count = 0
 
     def start(self) -> None:
         self._thread.start()
@@ -79,12 +106,54 @@ class InferenceServer:
             return
         ev.set()
 
+    # ---------------------------------------------------------- admission
+
+    def _admit(self, rid: str) -> None:
+        """Raise AdmissionError if the projected queue wait exceeds the
+        bound; otherwise record the request as awaiting first token.
+
+        Sheds only when a real queue exists: the completion-time window
+        measures ARRIVAL cadence whenever traffic is lighter than
+        capacity (1 req/min served in 1 s looks like rate 1/60), so a
+        projection from it is only meaningful once the backlog exceeds
+        the concurrent-service width — below that there is no queue
+        wait to bound, and an idle server must never shed."""
+        bound = self.max_projected_ttft_s
+        with self._adm_lock:
+            backlog = len(self._awaiting_first)
+            floor = getattr(getattr(self.engine, 'cfg', None),
+                            'num_slots', 4)
+            if (bound is not None and backlog >= floor and
+                    len(self._first_token_times) >= 4):
+                times = self._first_token_times
+                span = times[-1] - times[0]
+                rate = (len(times) - 1) / span if span > 0 else None
+                if rate:
+                    projected = (backlog + 1) / rate
+                    if projected > bound:
+                        self.shed_count += 1
+                        raise AdmissionError(projected, bound)
+            self._awaiting_first.add(rid)
+
+    def _note_first_token(self, rid: str) -> None:
+        with self._adm_lock:
+            if rid in self._awaiting_first:
+                self._awaiting_first.discard(rid)
+                self._first_token_times.append(time.time())
+
+    def _drop_admitted(self, rid: str) -> None:
+        """Request left the system without a first token (error/timeout):
+        remove from the backlog WITHOUT counting a service completion."""
+        with self._adm_lock:
+            self._awaiting_first.discard(rid)
+
     def submit(self, req: Request,
                timeout: float = 300.0) -> Optional[RequestResult]:
         rid = req.request_id or uuid.uuid4().hex
         req.request_id = rid
         if req.arrival_time is None:   # TTFT counts slot-queue wait
             req.arrival_time = time.time()
+        self._admit(rid)
         ev = threading.Event()
         self._events[rid] = ev
         self._queue.put(req)
@@ -93,9 +162,18 @@ class InferenceServer:
         # result before this pop (we return it) or sees no event and
         # drops it (no leak).
         self._events.pop(rid, None)
-        return self._results.pop(rid, None)
+        res = self._results.pop(rid, None)
+        # Non-streaming: the result IS the first-token observation (its
+        # ttft is in the past, but the service-rate window only needs
+        # completion cadence, not exact first-token instants).
+        if res is not None and res.finish_reason != 'error':
+            self._note_first_token(rid)
+        else:
+            self._drop_admitted(rid)
+        return res
 
-    def submit_stream(self, req: Request, timeout: float = 300.0):
+    def submit_stream(self, req: Request, timeout: float = 300.0,
+                      pre_admitted: bool = False):
         """Submit and yield ('tokens', [ids]) chunks as they decode,
         terminated by ('done', RequestResult) — or ('timeout', None) if
         `timeout` passes with no new chunk.
@@ -115,6 +193,11 @@ class InferenceServer:
         req.request_id = rid
         if req.arrival_time is None:   # TTFT counts slot-queue wait
             req.arrival_time = time.time()
+        if not pre_admitted:
+            # NB: generator body — deferred to first next().  The HTTP
+            # handler pre-admits instead, so the 429 can go out before
+            # the SSE response line.
+            self._admit(rid)
         chunks: 'queue.Queue' = queue.Queue()
         req.stream_cb = lambda toks: chunks.put(('tokens', toks))
         self._stream_queues[rid] = chunks
@@ -124,13 +207,24 @@ class InferenceServer:
                 try:
                     item = chunks.get(timeout=timeout)
                 except queue.Empty:
+                    self._drop_admitted(rid)
                     yield ('timeout', None)
                     return
+                if item[0] == 'tokens':
+                    self._note_first_token(rid)
+                elif item[0] == 'done':
+                    # Prefill-only/error finishes never streamed a chunk.
+                    self._drop_admitted(rid)
                 yield item
                 if item[0] == 'done':
                     return
         finally:
             self._stream_queues.pop(rid, None)
+            # Generator closed without a first token (client disconnect
+            # before any chunk, GeneratorExit): the request leaves the
+            # admission backlog — no-op when a first token already
+            # removed it.
+            self._drop_admitted(rid)
 
 
 def _make_handler(server: InferenceServer):
@@ -140,13 +234,26 @@ def _make_handler(server: InferenceServer):
         def log_message(self, fmt, *args):  # quiet
             pass
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict,
+                  extra_headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header('Content-Type', 'application/json')
             self.send_header('Content-Length', str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _shed(self, e: 'AdmissionError') -> None:
+            """429 + Retry-After: wait long enough that the projected
+            queue drains back under the bound."""
+            import math
+            retry_after = max(1, math.ceil(e.projected_s - e.bound_s))
+            self._json(429, {'error': str(e), 'shed': True,
+                             'projected_ttft_s': round(e.projected_s, 2),
+                             'bound_s': e.bound_s},
+                       extra_headers={'Retry-After': str(retry_after)})
 
         def _stream(self, req: Request) -> None:
             """Server-sent events: one `data:` line per token chunk, a
@@ -165,7 +272,8 @@ def _make_handler(server: InferenceServer):
             streamed: list = []
             prev_text = ''
             try:
-                for kind, value in server.submit_stream(req):
+                for kind, value in server.submit_stream(
+                        req, pre_admitted=True):
                     if kind == 'tokens':
                         streamed.extend(value)
                         out = {'tokens': value}
@@ -243,11 +351,29 @@ def _make_handler(server: InferenceServer):
                 self._json(400, {'error': f'bad field: {e}'})
                 return
             req = Request(tokens=tokens, max_new_tokens=max_new,
-                          temperature=temperature)
+                          temperature=temperature,
+                          request_id=uuid.uuid4().hex)
             if payload.get('stream'):
-                self._stream(req)
+                # Admit BEFORE the SSE 200 goes out: a shed must be a
+                # clean 429 the client (and LB) can act on.
+                try:
+                    server._admit(req.request_id)
+                except AdmissionError as e:
+                    self._shed(e)
+                    return
+                try:
+                    self._stream(req)
+                finally:
+                    # Pre-admitted rid must not leak if _stream died
+                    # before the generator ran (e.g. BrokenPipeError on
+                    # the SSE headers) — idempotent on success paths.
+                    server._drop_admitted(req.request_id)
                 return
-            res = server.submit(req)
+            try:
+                res = server.submit(req)
+            except AdmissionError as e:
+                self._shed(e)
+                return
             if res is None:
                 self._json(504, {'error': 'timed out'})
                 return
@@ -268,11 +394,20 @@ def _make_handler(server: InferenceServer):
     return Handler
 
 
+class _BurstTolerantHTTPServer(ThreadingHTTPServer):
+    # Default listen backlog (5) RSTs connections during an arrival
+    # burst BEFORE admission control can answer 429 — the shed path
+    # must see the request to shed it.
+    request_queue_size = 128
+
+
 def serve(engine: InferenceEngine, host: str = '0.0.0.0', port: int = 8100,
-          tokenizer: Optional[object] = None) -> None:
-    srv = InferenceServer(engine, tokenizer)
+          tokenizer: Optional[object] = None,
+          max_projected_ttft_s: Optional[float] = None) -> None:
+    srv = InferenceServer(engine, tokenizer,
+                          max_projected_ttft_s=max_projected_ttft_s)
     srv.start()
-    httpd = ThreadingHTTPServer((host, port), _make_handler(srv))
+    httpd = _BurstTolerantHTTPServer((host, port), _make_handler(srv))
     try:
         httpd.serve_forever()
     finally:
@@ -288,9 +423,16 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         cache_dtype: str = 'bfloat16',
         tensor_parallel: int = 0,
         weight_dtype: str = 'bf16',
-        prefills_per_gap: int = 4) -> None:
+        prefills_per_gap: int = 4,
+        platform: Optional[str] = None,
+        max_ttft: Optional[float] = None) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
+
+    platform: pin jax onto 'cpu'/'tpu' (None = whatever jax picks).
+    The config update AFTER importing jax is the only reliable pin on
+    hosts whose site hooks rewrite JAX_PLATFORMS at import time; CPU
+    replicas (dev serving, hermetic CI) need it.
 
     hf_model: HuggingFace Llama checkpoint (local path or warm cache) —
     real pretrained weights instead of the registry's random init.  The
@@ -305,6 +447,10 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
     fits one 16 GB v5e chip.  Llama-family only.
     """
     import dataclasses
+
+    if platform:
+        import jax
+        jax.config.update('jax_platforms', platform)
 
     import jax.numpy as jnp
 
@@ -330,10 +476,10 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         # before the (potentially tens-of-GB) weight load.
         mt = getattr(transformers.AutoConfig.from_pretrained(hf_model),
                      'model_type', None)
-        if mt not in ('llama', 'qwen2', 'mixtral'):
+        if mt not in ('llama', 'qwen2', 'mixtral', 'gpt2'):
             raise ValueError(
-                f'--hf-model must be a llama- or mixtral-family '
-                f"checkpoint (model_type 'llama', 'qwen2' or 'mixtral'); "
+                f'--hf-model must be a supported causal-LM checkpoint '
+                f"(model_type 'llama', 'qwen2', 'mixtral' or 'gpt2'); "
                 f'got model_type={mt!r}')
         # Serving: bf16 weights end to end (half the host RAM and HBM,
         # MXU-native).
@@ -399,7 +545,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         mesh = make_mesh(MeshSpec(tensor=tensor_parallel),
                          devices=jax.devices()[:tensor_parallel])
     engine = InferenceEngine(model_config, cfg, params=params, mesh=mesh)
-    serve(engine, host=host, port=port, tokenizer=tokenizer)
+    serve(engine, host=host, port=port, tokenizer=tokenizer,
+          max_projected_ttft_s=max_ttft)
 
 
 def main() -> None:

@@ -71,10 +71,14 @@ class SkyTpuLoadBalancer:
             self._request_timestamps.append(time.time())
 
     def _proxy_once(self, handler: BaseHTTPRequestHandler, replica: str,
-                    body: Optional[bytes]) -> bool:
-        """Stream one request to one replica. Returns False if the replica
-        could not be reached (retryable); True once any response line has
-        been forwarded (after which errors are no longer retryable)."""
+                    body: Optional[bytes],
+                    forward_shed: bool = True) -> str:
+        """Stream one request to one replica.  Returns 'unreachable'
+        (retryable: nothing forwarded), 'shed' (replica answered 429 at
+        admission and forward_shed is False — nothing forwarded, safe to
+        retry elsewhere since the replica did no work), or 'ok' (a
+        response line has been forwarded; errors past that point are no
+        longer retryable)."""
         parsed = urllib.parse.urlsplit(replica)
         conn = HTTPConnection(parsed.hostname, parsed.port, timeout=120)
         headers = {
@@ -89,7 +93,10 @@ class SkyTpuLoadBalancer:
             resp = conn.getresponse()
         except (OSError, socket.timeout):
             conn.close()
-            return False
+            return 'unreachable'
+        if resp.status == 429 and not forward_shed:
+            conn.close()
+            return 'shed'
         try:
             handler.send_response(resp.status, resp.reason)
             has_length = False
@@ -119,25 +126,43 @@ class SkyTpuLoadBalancer:
                            '%s', e)
         finally:
             conn.close()
-        return True
+        return 'ok'
 
     def handle_request(self, handler: BaseHTTPRequestHandler) -> None:
         self._record_request()
         length = int(handler.headers.get('Content-Length', 0) or 0)
         body = handler.rfile.read(length) if length else None
         tried = set()
+        shed_replica = None
         for _ in range(_MAX_ATTEMPTS):
             replica = self.policy.select_replica()
             if replica is None or replica in tried:
                 break
             tried.add(replica)
             try:
-                if self._proxy_once(handler, replica, body):
+                outcome = self._proxy_once(handler, replica, body,
+                                           forward_shed=False)
+                if outcome == 'ok':
                     return
+                if outcome == 'shed':
+                    # Admission-shed: the replica did no work — another
+                    # replica may have headroom.
+                    shed_replica = replica
+                    continue
                 logger.warning('LB: replica %s unreachable, retrying',
                                replica)
             finally:
                 self.policy.request_done(replica)
+        if shed_replica is not None:
+            # Every candidate shed: surface the 429 (+ Retry-After) to
+            # the client.  Re-requesting is safe — a shed does no work.
+            # No request_done here: the loop already paired this
+            # replica's select_replica with its request_done, and an
+            # unmatched decrement would corrupt LeastLoadPolicy's
+            # outstanding counts exactly when the fleet is overloaded.
+            if self._proxy_once(handler, shed_replica, body,
+                                forward_shed=True) == 'ok':
+                return
         handler.send_response(503)
         msg = b'{"error": "no ready replicas"}'
         handler.send_header('Content-Type', 'application/json')
@@ -168,7 +193,12 @@ class SkyTpuLoadBalancer:
         sync_thread = threading.Thread(target=self._sync_loop, daemon=True,
                                        name='lb-sync')
         sync_thread.start()
-        self._httpd = ThreadingHTTPServer(('0.0.0.0', self.port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # Default listen backlog (5) RSTs connections during
+            # arrival bursts; user traffic funnels through this port.
+            request_queue_size = 128
+
+        self._httpd = _Server(('0.0.0.0', self.port), Handler)
         self._httpd.daemon_threads = True
         logger.info('Load balancer listening on :%d -> controller %s',
                     self.port, self.controller_url)
