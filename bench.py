@@ -4,11 +4,14 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
-Metric: Llama-3-8B-equivalent training tokens/sec/chip.  The largest model
-that fits ONE v5e chip (16 GB HBM) with f32 params + adam state is ~800M
-params, so we measure achieved model-FLOPs/sec/chip on `llama-800m` and
+Metric: Llama-3-8B-equivalent training tokens/sec/chip, MEASURED AT THE
+ANCHOR'S SEQUENCE LENGTH (8192).  The largest model that fits ONE v5e
+chip (16 GB HBM) with f32 params + adam state is ~800M params, so we
+measure achieved model-FLOPs/sec/chip on `llama-800m` at seq 8192 and
 express it as tokens/sec/chip of Llama-3-8B at seq 8192 (same FLOPs
-accounting) for comparison against the reference baseline.
+accounting) for comparison against the reference baseline.  A second
+window at seq 2048 (the historical r1/r2 operating point) is reported in
+`detail` for round-over-round comparability.
 
 Baseline (BASELINE.md): reference `sky launch` Llama-3-8B torch-XLA FSDP on
 TPU v6e-8 = 0.476 samples/s @ seq 8192 over 8 chips
@@ -18,29 +21,23 @@ absolute number on weaker silicon means the software stack is >4.7x more
 efficient.
 """
 import json
+import statistics
 import time
 
 
-def main() -> None:
+def measure(model_name: str, seq_len: int, batch_per_chip: int,
+            steps: int = 10, windows: int = 3):
+    """Median-of-N window throughput for one (seq_len, batch) point.
+    Returns (tokens/s/chip, window spread, final loss, achieved
+    TFLOP/s/chip)."""
     import jax
-    jax.config.update('jax_default_matmul_precision', 'bfloat16')
-
-    import jax.numpy as jnp
     from skypilot_tpu.models import get_model_config
     from skypilot_tpu.parallel import MeshSpec, make_mesh
     from skypilot_tpu.train import TrainConfig, create_sharded_state
     from skypilot_tpu.train.trainer import make_train_step, synthetic_data
 
     n_dev = len(jax.devices())
-    platform = jax.devices()[0].platform
-    model_name = 'llama-800m'
-    # 24 seq/chip is the measured HBM sweet spot on v5e (16 GB): +6%
-    # MFU over 16/chip; 28+ no longer compiles (params + adam state +
-    # remat'd activations exceed HBM).
-    batch_size = 24 * n_dev
-    seq_len = 2048
-    steps = 10   # per measurement window; 3 windows, median reported
-
+    batch_size = batch_per_chip * n_dev
     cfg = get_model_config(model_name)
     tcfg = TrainConfig(model=model_name, batch_size=batch_size,
                        seq_len=seq_len, warmup_steps=10, total_steps=1000)
@@ -48,41 +45,61 @@ def main() -> None:
     state, _ = create_sharded_state(cfg, tcfg, mesh, jax.random.PRNGKey(0))
     # Fused/chunked loss: never materializes [B,T,V] f32 logits (see
     # trainer.chunked_cross_entropy) — worth ~6% step time and the HBM
-    # that the full-logits buffer (4+ GB at this config) would pin.
+    # that the full-logits buffer would pin.
     step_fn = make_train_step(mesh, loss_chunk=128)
     data = synthetic_data(batch_size, seq_len, cfg.vocab_size)
 
-    # Median-of-3 measurement windows with spread: the shared tunneled
+    # Median-of-N measurement windows with spread: the shared tunneled
     # bench chip is noisy run-to-run (~±1-2% train, far more for
     # serving), so a single window misleads (VERDICT r1 weak #7).
     window_tps = []
     with mesh:
-        # Warmup / compile.  NOTE: sync via a host transfer of a value that
-        # depends on the step (float(loss)) — on tunneled TPU platforms
-        # block_until_ready can return before execution finishes.
+        # Warmup / compile.  NOTE: sync via a host transfer of a value
+        # that depends on the step (float(loss)) — on tunneled TPU
+        # platforms block_until_ready can return before execution ends.
         state, metrics = step_fn(state, next(data))
         _ = float(metrics['loss'])
-        for _ in range(3):
+        for _ in range(windows):
             t0 = time.time()
             for _ in range(steps):
                 state, metrics = step_fn(state, next(data))
             _ = float(metrics['loss'])  # waits for the dispatched chain
             window_tps.append(batch_size * seq_len * steps /
                               (time.time() - t0))
+    tps_chip = statistics.median(window_tps) / n_dev
+    loss = float(metrics['loss'])
+    tflops_chip = tps_chip * cfg.flops_per_token(seq_len) / 1e12
+    spread = [round(w / n_dev, 1) for w in window_tps]
+    return tps_chip, spread, loss, tflops_chip
 
-    import statistics
-    tps = statistics.median(window_tps)    # robust to window count
-    tps_chip = tps / n_dev
-    flops_per_tok = cfg.flops_per_token(seq_len)
-    achieved_tflops_chip = tps_chip * flops_per_tok / 1e12
 
-    # Express as Llama-3-8B @ seq 8192 tokens/sec/chip (FLOPs-equivalent).
-    cfg8b = get_model_config('llama3-8b')
-    tps_chip_8b_equiv = (achieved_tflops_chip * 1e12 /
-                         cfg8b.flops_per_token(8192))
+def main() -> None:
+    import jax
+    jax.config.update('jax_default_matmul_precision', 'bfloat16')
 
+    from skypilot_tpu.models import get_model_config
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    model_name = 'llama-800m'
     peak = {'tpu': 196.8}.get(platform, None)  # v5e bf16 peak
     baseline_8b_tok_s_chip = 0.476 * 8192 / 8   # reference, v6e-8
+    cfg8b = get_model_config('llama3-8b')
+
+    # Headline window AT THE ANCHOR SEQ (8192).  6 seq/chip keeps the
+    # same ~49k tokens/chip working set as the seq-2048 sweet spot
+    # (24*2048); flash attention keeps activation memory O(S*d).
+    tps_8192, spread_8192, loss_8192, tflops_8192 = measure(
+        model_name, seq_len=8192, batch_per_chip=6)
+    # Comparability window at the r1/r2 operating point (seq 2048).
+    tps_2048, spread_2048, loss_2048, tflops_2048 = measure(
+        model_name, seq_len=2048, batch_per_chip=24)
+
+    # Express as Llama-3-8B @ seq 8192 tokens/sec/chip — now from a
+    # MEASURED seq-8192 window (VERDICT r2 weak #2: no 2048->8192
+    # extrapolation in the headline).
+    tps_chip_8b_equiv = (tflops_8192 * 1e12 /
+                         cfg8b.flops_per_token(8192))
 
     result = {
         'metric': 'llama3_8b_equiv_train_tokens_per_sec_per_chip',
@@ -91,19 +108,27 @@ def main() -> None:
         'vs_baseline': round(tps_chip_8b_equiv / baseline_8b_tok_s_chip, 3),
         'detail': {
             'bench_model': model_name,
-            'model_params_m': round(cfg.num_params / 1e6),
             'devices': n_dev,
             'platform': platform,
-            'batch': batch_size,
-            'seq_len': seq_len,
-            'raw_tokens_per_sec_per_chip': round(tps_chip, 1),
-            'window_spread_tok_s_per_chip': [
-                round(w / n_dev, 1) for w in window_tps],
-            'achieved_tflops_per_chip': round(achieved_tflops_chip, 1),
-            'mfu': round(achieved_tflops_chip / peak, 3) if peak else None,
-            'final_loss': round(float(metrics['loss']), 3),
+            'headline_seq_len': 8192,
+            'seq8192': {
+                'batch_per_chip': 6,
+                'raw_tokens_per_sec_per_chip': round(tps_8192, 1),
+                'window_spread_tok_s_per_chip': spread_8192,
+                'achieved_tflops_per_chip': round(tflops_8192, 1),
+                'mfu': round(tflops_8192 / peak, 3) if peak else None,
+                'final_loss': round(loss_8192, 3),
+            },
+            'seq2048': {
+                'batch_per_chip': 24,
+                'raw_tokens_per_sec_per_chip': round(tps_2048, 1),
+                'window_spread_tok_s_per_chip': spread_2048,
+                'achieved_tflops_per_chip': round(tflops_2048, 1),
+                'mfu': round(tflops_2048 / peak, 3) if peak else None,
+                'final_loss': round(loss_2048, 3),
+            },
             'baseline': 'ref torch-XLA FSDP llama3-8b on v6e-8: '
-                        '487.4 tok/s/chip (BASELINE.md)',
+                        '487.4 tok/s/chip @ seq 8192 (BASELINE.md)',
         },
     }
     print(json.dumps(result))

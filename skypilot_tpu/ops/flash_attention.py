@@ -198,44 +198,51 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                    dv_ref, *, scale, causal, block_q, seq_len):
-    kv_idx = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    block_kv = k.shape[0]
-    kv_offset = kv_idx * block_kv
+                    dv_ref, *, scale, causal, block_q):
+    """One (batch*head, kv_block, q_block) program.
 
-    def body(q_idx, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(q_idx * block_q, block_q)].astype(
-            jnp.float32)
-        do = do_ref[0, pl.ds(q_idx * block_q, block_q)].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(q_idx * block_q, block_q)][:, 0]
-        delta = delta_ref[0, pl.ds(q_idx * block_q, block_q)][:, 0]
+    The q axis is a GRID dimension, not a fori_loop over a full-sequence
+    VMEM ref: at seq 8192 the full q/do/lse/delta refs are ~12 MB which
+    double-buffers past the 16 MB VMEM limit (the r3 seq-8192 bench OOM).
+    dk/dv are f32 outputs revisited across the q axis (the block stays
+    VMEM-resident while its index is unchanged) and cast outside.
+    """
+    kv_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+    block_kv = k_ref.shape[1]
+    kv_offset = kv_idx * block_kv
+    q_offset = q_idx * block_q
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    def _accumulate():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
         s = (q * scale) @ k.T                            # [Bq, Bkv]
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+            q_pos = q_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = kv_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + p.T @ do
+        dv_ref[0] += p.T @ do
         dp = do @ v.T
         ds = p * (dp - delta[:, None]) * scale
-        dk = dk + ds.T @ q
-        return dk, dv
+        dk_ref[0] += ds.T @ q
 
-    num_q_blocks = seq_len // block_q
     if causal:
-        first_q = jax.lax.div(kv_offset, block_q)
+        # q blocks strictly before this kv block contribute nothing.
+        pl.when(q_offset + block_q - 1 >= kv_offset)(_accumulate)
     else:
-        first_q = 0
-    dk0 = jnp.zeros_like(k)
-    dv0 = jnp.zeros_like(v)
-    dk, dv = jax.lax.fori_loop(first_q, num_q_blocks, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        _accumulate()
 
 
 def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv):
@@ -276,31 +283,34 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv):
     )(qf, kf, vf, dof, lsef, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_len=s),
-        grid=(b * hq, s // block_kv),
+                          block_q=block_q),
+        # q blocks are the INNER grid axis: dk/dv blocks stay resident
+        # and accumulate across it (no full-seq VMEM refs — see kernel).
+        grid=(b * hq, s // block_kv, s // block_q),
         in_specs=[
-            pl.BlockSpec((1, s, d), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, j, 0)),
             pl.BlockSpec((1, block_kv, d),
-                         lambda h, i, f=kv_index: (f(h), i, 0)),
+                         lambda h, i, j, f=kv_index: (f(h), i, 0)),
             pl.BlockSpec((1, block_kv, d),
-                         lambda h, i, f=kv_index: (f(h), i, 0)),
-            pl.BlockSpec((1, s, d), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((1, s, _LANES), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((1, s, _LANES), lambda h, i: (h, 0, 0)),
+                         lambda h, i, j, f=kv_index: (f(h), i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda h, i, j: (h, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_kv, d), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda h, i, j: (h, i, 0)),
         ],
         out_shape=[
-            _out_struct((b * hq, s, d), q.dtype, qf, kf, vf, dof, lsef,
-                        delta),
-            _out_struct((b * hq, s, d), q.dtype, qf, kf, vf, dof, lsef,
-                        delta),
+            _out_struct((b * hq, s, d), jnp.float32, qf, kf, vf, dof,
+                        lsef, delta),
+            _out_struct((b * hq, s, d), jnp.float32, qf, kf, vf, dof,
+                        lsef, delta),
         ],
         interpret=_interpret(),
     )(qf, kf, vf, dof, lsef, delta)
-    # Fold GQA groups back: sum dk/dv over the query heads of each kv head.
+    # Fold GQA groups back: sum dk/dv over the query heads of each kv head
+    # (f32 accumulators from the kernel; cast once here).
     dk = dk.reshape(b, hkv, group, s, d).sum(axis=2).astype(k.dtype)
     dv = dv.reshape(b, hkv, group, s, d).sum(axis=2).astype(v.dtype)
     return dq.reshape(b, hq, s, d), dk, dv
