@@ -72,11 +72,18 @@ def run_sweep_row(endpoint: str, qps: float, num_requests: int,
                for _ in range(num_requests)]
     results = [None] * num_requests
     errors = []
+    sheds = []
     threads = []
 
     def one(i):
+        import urllib.error
         try:
             results[i] = _post_stream(endpoint, prompts[i], NEW_TOKENS)
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                sheds.append((i, e.headers.get('Retry-After')))
+            else:
+                errors.append((i, f'HTTP {e.code}'))
         except Exception as e:  # pylint: disable=broad-except
             errors.append((i, str(e)[:200]))
 
@@ -103,6 +110,8 @@ def run_sweep_row(endpoint: str, qps: float, num_requests: int,
     return {
         'offered_qps': qps,
         'completed': len(done),
+        'shed_429': len(sheds),
+        'shed_rate': len(sheds) / num_requests,
         'errors': len(errors),
         'requests_per_second': len(done) / elapsed,
         'output_tokens_per_second': outs / elapsed,
@@ -123,6 +132,9 @@ def main() -> None:
                         help='num_requests = qps * this')
     parser.add_argument('--num-slots', type=int, default=48)
     parser.add_argument('--decode-steps', type=int, default=8)
+    parser.add_argument('--max-ttft', type=float, default=None,
+                        help='replica admission bound (s); sheds count '
+                             'in the sweep rows')
     parser.add_argument('--service-name', default='lbbench')
     parser.add_argument('--out', default=None)
     parser.add_argument('--keep-up', action='store_true',
@@ -144,7 +156,9 @@ def main() -> None:
             '--model llama2-7b --weight-dtype int8 --cache-dtype fp8 '
             f'--num-slots {args.num_slots} '
             f'--decode-steps {args.decode_steps} --max-cache-len 512 '
-            '--port $SKYTPU_SERVE_REPLICA_PORT')
+            + (f'--max-ttft {args.max_ttft} '
+               if args.max_ttft is not None else '')
+            + '--port $SKYTPU_SERVE_REPLICA_PORT')
         from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
         task = Task('llama-serve-bench', run=run_cmd)
         task.set_resources(Resources(cloud='local'))
@@ -167,8 +181,20 @@ def main() -> None:
             raise TimeoutError('replica never became READY')
     print(f'driving load at {endpoint}', flush=True)
     # Warm the serving path (compile happened at replica start; this
-    # warms the LB connection + prefill bucket).
-    _post_stream(endpoint, list(range(4, 4 + PROMPT_LEN)), 4)
+    # warms the LB connection + prefill bucket).  The LB's replica list
+    # syncs on an interval, so READY status can precede LB routability —
+    # retry the warm request until the path is live.
+    deadline = time.time() + 300
+    while True:
+        try:
+            _post_stream(endpoint, list(range(4, 4 + PROMPT_LEN)), 4)
+            break
+        except Exception as e:  # pylint: disable=broad-except
+            if time.time() > deadline:
+                raise
+            print(f'warm request not routable yet ({e}); retrying',
+                  flush=True)
+            time.sleep(5)
 
     rows = []
     for qps in qps_list:

@@ -386,6 +386,12 @@ class InferenceEngine:
                 return i
         return None
 
+    def has_free_slot(self) -> bool:
+        """Lock-free saturation peek for admission control: a free slot
+        means arrivals are NOT queueing (benign race — a stale answer
+        only shifts one admission decision by one loop gap)."""
+        return any(s is None for s in self._slots)
+
     def _max_new(self, req: Request) -> int:
         return self.cfg.max_new_tokens if req.max_new_tokens is None \
             else req.max_new_tokens
