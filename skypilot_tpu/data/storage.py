@@ -1,8 +1,13 @@
 """Bucket-backed storage: lifecycle + MOUNT/COPY modes.
 
-Parity: sky/data/storage.py (Storage :384, GcsStore :1527, StorageMode
-:192) — GCS-only, TPU-first: checkpoints ride gcsfuse MOUNT on TPU VMs
-(the checkpoint/resume contract for managed jobs), datasets ride COPY.
+Parity: sky/data/storage.py (Storage :384, stores :1080-3138,
+StorageMode :192) — TPU-first: GCS is the default and the only
+MOUNTable store (gcsfuse on TPU VMs — the checkpoint/resume contract
+for managed jobs); **S3 and R2 are supported as destination stores**
+(`store: s3|r2`, data/stores.py) for task outputs and cross-cloud
+datasets, reached via gsutil/aws/rclone subprocesses.  External-cloud
+*sources* (s3:// / r2:// / cos://) ingest into a GCS bucket at upload
+time (data_transfer) when the destination store is GCS.
 """
 import enum
 import os
@@ -10,6 +15,7 @@ import subprocess
 from typing import Any, Dict, List, Optional, Union
 
 from skypilot_tpu import exceptions, logsys, state
+from skypilot_tpu.data.stores import Store
 from skypilot_tpu.status_lib import StorageStatus
 from skypilot_tpu.utils import common
 
@@ -25,11 +31,13 @@ class StorageHandle:
     """Pickled record in the local state DB."""
 
     def __init__(self, name: str, source: Optional[Union[str, List[str]]],
-                 mode: StorageMode, persistent: bool):
+                 mode: StorageMode, persistent: bool,
+                 store: str = 'gcs'):
         self.name = name
         self.source = source
         self.mode = mode
         self.persistent = persistent
+        self.store = store
 
 
 def _run_gsutil(args: List[str], check: bool = True
@@ -45,13 +53,15 @@ def _run_gsutil(args: List[str], check: bool = True
 
 
 class Storage:
-    """A named bucket, optionally synced from local source(s)."""
+    """A named bucket on one destination store, optionally synced from
+    local source(s)."""
 
     def __init__(self,
                  name: Optional[str] = None,
                  source: Optional[Union[str, List[str]]] = None,
                  mode: StorageMode = StorageMode.MOUNT,
-                 persistent: bool = True):
+                 persistent: bool = True,
+                 store: Optional[str] = None):
         if name is None and source is None:
             raise exceptions.StorageSourceError(
                 'Storage needs a name and/or a source.')
@@ -62,21 +72,46 @@ class Storage:
         self.source = source
         self.mode = mode
         self.persistent = persistent
+        # Destination store: explicit `store:` wins; a gs:// source
+        # implies gcs; everything else defaults to gcs.  Deliberately
+        # NOT inferred from s3://-r2://-cos:// sources: without an
+        # explicit `store:`, those keep the TPU-first ingestion
+        # semantics (copied INTO a GCS bucket at upload; the slice only
+        # talks to GCS).  `store: s3` + `source: s3://b` means "use
+        # that S3 bucket directly" instead.
+        self.store_name = (store or 'gcs').lower()
+        self.store = Store.make(self.store_name)
         self._validate_source()
 
     def _validate_source(self) -> None:
         from skypilot_tpu.data import data_transfer
+        if self._is_external_bucket:
+            return   # single-string source naming the bucket itself
         sources = (self.source if isinstance(self.source, list) else
                    [self.source] if self.source else [])
         for src in sources:
-            if src.startswith('gs://'):
-                continue
             if data_transfer.is_external_cloud_uri(src):
-                # s3:// / r2:// / cos://: ingested into the GCS bucket at
-                # upload time (data_transfer.transfer_to_gcs) — the TPU
-                # slice itself only ever talks to GCS.  Parity:
+                if self.store_name != 'gcs':
+                    raise exceptions.StorageSourceError(
+                        f'External source {src} can only be ingested '
+                        f'into a GCS-store bucket (store: gcs), not '
+                        f'{self.store_name!r}. To use a pre-existing '
+                        f'bucket directly, make it the single string '
+                        f'source with a matching store.')
+                # s3:// / r2:// / cos://: ingested into the GCS bucket
+                # at upload time (data_transfer.transfer_to_gcs) — the
+                # TPU slice itself only ever talks to GCS.  Parity:
                 # sky/data/data_transfer.py:39-193.
                 continue
+            if '://' in str(src):
+                # gs:// here, or a bucket URI inside a LIST: neither is
+                # a syncable source — a pre-existing bucket must be the
+                # SINGLE string source matching the store's scheme.
+                raise exceptions.StorageSourceError(
+                    f'{src!r} is not usable as a source for a '
+                    f'{self.store_name} store: a bucket URI must be '
+                    f'the single string source whose scheme matches '
+                    f'the store ({self.store.SCHEME}).')
             if not os.path.exists(os.path.expanduser(src)):
                 raise exceptions.StorageSourceError(
                     f'Local source not found: {src}')
@@ -84,32 +119,40 @@ class Storage:
     # ------------------------------------------------------------- lifecycle
 
     @property
+    def _is_external_bucket(self) -> bool:
+        return (isinstance(self.source, str) and
+                self.source.startswith(self.store.SCHEME))
+
+    @property
     def bucket_uri(self) -> str:
-        if isinstance(self.source, str) and self.source.startswith('gs://'):
+        if self._is_external_bucket:
             return self.source.rstrip('/')
-        return f'gs://{self.name}'
+        return self.store.uri(self.name)
 
     def ensure_bucket(self) -> None:
-        if isinstance(self.source, str) and self.source.startswith('gs://'):
+        if self._is_external_bucket:
             return  # pre-existing bucket
-        res = _run_gsutil(['ls', self.bucket_uri], check=False)
-        if res.returncode != 0:
+        if not self.store.exists(self.bucket_uri):
             logger.info('Creating bucket %s.', self.bucket_uri)
-            res = _run_gsutil(['mb', self.bucket_uri], check=False)
+            res = self.store.create(self.bucket_uri)
             if res.returncode != 0:
                 raise exceptions.StorageBucketCreateError(
                     f'mb failed: {res.stderr[-500:]}')
 
     def upload(self) -> None:
         """Sync local source(s) into the bucket; external-cloud sources
-        (s3:// / r2:// / cos://) are ingested via data_transfer."""
+        (s3:// / r2:// / cos://) are ingested via data_transfer when the
+        destination store is GCS."""
         from skypilot_tpu.data import data_transfer
         self.ensure_bucket()
+        if self._is_external_bucket:
+            # Pre-existing bucket IS the storage; nothing to upload.
+            state.add_or_update_storage(self.name, self.to_handle(),
+                                        StorageStatus.READY)
+            return
         sources = (self.source if isinstance(self.source, list) else
                    [self.source] if self.source else [])
         for src in sources:
-            if src.startswith('gs://'):
-                continue
             if data_transfer.is_external_cloud_uri(src):
                 try:
                     data_transfer.transfer_to_gcs(src, self.bucket_uri)
@@ -120,11 +163,8 @@ class Storage:
                     raise exceptions.StorageUploadError(str(e)) from e
                 continue
             src = os.path.expanduser(src)
-            dst = self.bucket_uri
-            if os.path.isdir(src):
-                res = _run_gsutil(['rsync', '-r', src, dst], check=False)
-            else:
-                res = _run_gsutil(['cp', src, dst], check=False)
+            res = self.store.sync_up(src, self.bucket_uri,
+                                     is_dir=os.path.isdir(src))
             if res.returncode != 0:
                 state.add_or_update_storage(self.name, self.to_handle(),
                                             StorageStatus.UPLOAD_FAILED)
@@ -134,13 +174,13 @@ class Storage:
                                     StorageStatus.READY)
 
     def delete(self) -> None:
-        if (isinstance(self.source, str) and
-                self.source.startswith('gs://')):
+        if self._is_external_bucket:
             logger.info('Not deleting externally-managed bucket %s.',
                         self.bucket_uri)
         else:
-            res = _run_gsutil(['rm', '-r', self.bucket_uri], check=False)
-            if res.returncode != 0 and 'BucketNotFound' not in res.stderr:
+            res = self.store.delete(self.bucket_uri)
+            if res.returncode != 0 and not any(
+                    m in res.stderr for m in self.store.MISSING_MARKERS):
                 raise exceptions.StorageBucketDeleteError(
                     f'Deletion of {self.bucket_uri} failed: '
                     f'{res.stderr[-500:]}')
@@ -154,7 +194,8 @@ class Storage:
         return cls(name=config.get('name'),
                    source=config.get('source'),
                    mode=StorageMode(mode_str),
-                   persistent=config.get('persistent', True))
+                   persistent=config.get('persistent', True),
+                   store=config.get('store'))
 
     def to_yaml_config(self) -> Dict[str, Any]:
         cfg: Dict[str, Any] = {'name': self.name, 'mode': self.mode.value}
@@ -162,13 +203,16 @@ class Storage:
             cfg['source'] = self.source
         if not self.persistent:
             cfg['persistent'] = False
+        if self.store_name != 'gcs':
+            cfg['store'] = self.store_name
         return cfg
 
     def to_handle(self) -> StorageHandle:
         return StorageHandle(self.name, self.source, self.mode,
-                             self.persistent)
+                             self.persistent, self.store_name)
 
     @classmethod
     def from_handle(cls, handle: StorageHandle) -> 'Storage':
         return cls(name=handle.name, source=handle.source, mode=handle.mode,
-                   persistent=handle.persistent)
+                   persistent=handle.persistent,
+                   store=getattr(handle, 'store', 'gcs'))

@@ -86,16 +86,6 @@ def host_supports_fuse(runner: CommandRunner) -> bool:
         f'a capability answer): {last_err[-300:]}')
 
 
-def copy_command(bucket_uri: str, dst: str) -> str:
-    """Directory sync: bucket -> dst dir."""
-    import shlex
-    d = shlex.quote(dst)
-    return (f'mkdir -p {d} && '
-            f'(command -v gsutil >/dev/null && '
-            f'gsutil -m rsync -r {bucket_uri} {d} || '
-            f'gcloud storage rsync --recursive {bucket_uri} {d})')
-
-
 def copy_object_command(src_uri: str, dst: str) -> str:
     """Single object/prefix copy: gs://... -> dst path (file mounts)."""
     import shlex
@@ -109,9 +99,26 @@ def copy_object_command(src_uri: str, dst: str) -> str:
 def mount_storage(runners: List[CommandRunner], mount_path: str,
                   storage: Storage, log_path: str) -> None:
     if storage.source is not None and not str(
-            storage.source).startswith('gs://'):
+            storage.source).startswith(storage.store.SCHEME):
         storage.upload()
     bucket = storage.bucket_uri.removeprefix('gs://')
+
+    # Store mountability is host-independent: decide (and warn) ONCE,
+    # not once per host of a 64-host slice.
+    if storage.mode == StorageMode.MOUNT and not storage.store.MOUNTABLE:
+        # S3/R2 destination stores: no FUSE adapter assumed on TPU
+        # images (the reference uses goofys for S3) — degrade to a COPY
+        # of the bucket, same contract as the FUSE-less-host downgrade.
+        logger.warning(
+            'MOUNT of %s degrades to COPY: the %s store is not '
+            'mountable on TPU hosts (only gcs mounts, via gcsfuse).',
+            storage.bucket_uri, storage.store_name)
+        copy_cmd = storage.store.host_copy_command(storage.bucket_uri,
+                                                   mount_path)
+        subprocess_utils.run_in_parallel(
+            lambda r: r.run_or_raise(copy_cmd, log_path=log_path),
+            runners)
+        return
 
     def _one(runner: CommandRunner) -> None:
         if storage.mode == StorageMode.MOUNT:
@@ -129,9 +136,11 @@ def mount_storage(runners: List[CommandRunner], mount_path: str,
                     'or the GKE gcsfuse sidecar for a live mount — '
                     'docs/storage.md).',
                     storage.bucket_uri, runner.node_id)
-                cmd = copy_command(storage.bucket_uri, mount_path)
+                cmd = storage.store.host_copy_command(
+                    storage.bucket_uri, mount_path)
         else:
-            cmd = copy_command(storage.bucket_uri, mount_path)
+            cmd = storage.store.host_copy_command(storage.bucket_uri,
+                                                  mount_path)
         runner.run_or_raise(cmd, log_path=log_path)
 
     subprocess_utils.run_in_parallel(_one, runners)

@@ -1,0 +1,204 @@
+"""Destination stores: where a Storage object's bucket actually lives.
+
+Parity: sky/data/storage.py's store classes (S3Store :1080, GcsStore
+:1527, R2Store :2561) — reduced to the TPU-relevant contract.  The
+TPU-first stance is unchanged: GCS is the serving-side store (gcsfuse
+MOUNT on TPU VMs); S3 and R2 are DESTINATION stores for task outputs
+and cross-cloud datasets, reached through external tools exactly like
+the reference (gsutil speaks s3:// natively; R2 needs rclone's
+endpoint config) — no cloud SDK imports.
+
+MOUNT semantics: only GCS mounts on a TPU VM (gcsfuse).  A MOUNT
+request against an S3/R2 store degrades to COPY with a warning, the
+same contract as the FUSE-less-host downgrade (storage_mounting).
+"""
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Type
+
+from skypilot_tpu import exceptions, logsys
+
+logger = logsys.init_logger(__name__)
+
+
+def _run(cmd: List[str]) -> subprocess.CompletedProcess:
+    """Single seam for tests to intercept tool invocations."""
+    return subprocess.run(cmd, capture_output=True, text=True, check=False)
+
+
+class Store:
+    """Bucket operations for one destination cloud."""
+
+    NAME = 'abstract'
+    SCHEME = ''
+    MOUNTABLE = False
+    # stderr substrings meaning "bucket already gone" (delete stays
+    # idempotent per tool: gsutil/aws/rclone each phrase it their way).
+    MISSING_MARKERS: tuple = ()
+    _REGISTRY: Dict[str, Type['Store']] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.NAME != 'abstract':
+            Store._REGISTRY[cls.NAME] = cls
+
+    @classmethod
+    def make(cls, name: Optional[str]) -> 'Store':
+        store_cls = cls._REGISTRY.get((name or 'gcs').lower())
+        if store_cls is None:
+            raise exceptions.StorageError(
+                f'Unknown store {name!r}; one of '
+                f'{sorted(cls._REGISTRY)}')
+        return store_cls()
+
+    def uri(self, bucket_name: str) -> str:
+        return f'{self.SCHEME}{bucket_name}'
+
+    # Each op returns a CompletedProcess (rc + stderr for callers).
+    def exists(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    def create(self, uri: str) -> subprocess.CompletedProcess:
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> subprocess.CompletedProcess:
+        raise NotImplementedError
+
+    def sync_up(self, local_src: str, uri: str,
+                is_dir: bool) -> subprocess.CompletedProcess:
+        raise NotImplementedError
+
+    def host_copy_command(self, uri: str, dst: str) -> str:
+        """Shell command a cluster HOST runs to COPY the bucket down."""
+        raise NotImplementedError
+
+
+class GcsStore(Store):
+    """gsutil (gcloud storage fallback) — the default, mountable store."""
+
+    NAME = 'gcs'
+    SCHEME = 'gs://'
+    MOUNTABLE = True
+    MISSING_MARKERS = ('BucketNotFound', 'NotFoundException')
+
+    def _tool(self, args: List[str]) -> subprocess.CompletedProcess:
+        # Routed through storage._run_gsutil — the long-standing seam
+        # tests (and callers) already intercept.
+        from skypilot_tpu.data import storage as storage_mod
+        return storage_mod._run_gsutil(args, check=False)
+
+    def exists(self, uri: str) -> bool:
+        return self._tool(['ls', uri]).returncode == 0
+
+    def create(self, uri: str) -> subprocess.CompletedProcess:
+        return self._tool(['mb', uri])
+
+    def delete(self, uri: str) -> subprocess.CompletedProcess:
+        return self._tool(['rm', '-r', uri])
+
+    def sync_up(self, local_src: str, uri: str, is_dir: bool):
+        return self._tool(['rsync', '-r', local_src, uri] if is_dir
+                          else ['cp', local_src, uri])
+
+    def host_copy_command(self, uri: str, dst: str) -> str:
+        import shlex
+        d = shlex.quote(dst)
+        return (f'mkdir -p {d} && '
+                f'(command -v gsutil >/dev/null && '
+                f'gsutil -m rsync -r {uri} {d} || '
+                f'gcloud storage rsync --recursive {uri} {d})')
+
+
+class S3Store(Store):
+    """AWS S3 destination: gsutil (speaks s3:// with boto/AWS-env
+    credentials — one tool shared with GCS), aws CLI fallback."""
+
+    NAME = 's3'
+    SCHEME = 's3://'
+    MOUNTABLE = False   # goofys not assumed on TPU images -> COPY
+    MISSING_MARKERS = ('NoSuchBucket', 'BucketNotFound')
+
+    def _tool(self, gsutil_args: List[str], aws_args: List[str]
+              ) -> subprocess.CompletedProcess:
+        if shutil.which('gsutil'):
+            return _run(['gsutil', '-m'] + gsutil_args)
+        if shutil.which('aws'):
+            return _run(['aws', 's3'] + aws_args)
+        raise exceptions.StorageError(
+            'Neither gsutil (with S3 credentials in ~/.boto or AWS env '
+            'vars) nor the aws CLI found; cannot manage s3:// buckets.')
+
+    def exists(self, uri: str) -> bool:
+        return self._tool(['ls', uri], ['ls', uri]).returncode == 0
+
+    def create(self, uri: str) -> subprocess.CompletedProcess:
+        return self._tool(['mb', uri], ['mb', uri])
+
+    def delete(self, uri: str) -> subprocess.CompletedProcess:
+        return self._tool(['rm', '-r', uri], ['rb', '--force', uri])
+
+    def sync_up(self, local_src: str, uri: str, is_dir: bool):
+        return self._tool(
+            ['rsync', '-r', local_src, uri] if is_dir
+            else ['cp', local_src, uri],
+            ['sync', local_src, uri] if is_dir
+            else ['cp', local_src, uri])
+
+    def host_copy_command(self, uri: str, dst: str) -> str:
+        import shlex
+        d = shlex.quote(dst)
+        return (f'mkdir -p {d} && '
+                f'(command -v gsutil >/dev/null && '
+                f'gsutil -m rsync -r {uri} {d} || '
+                f'aws s3 sync {uri} {d})')
+
+
+class R2Store(Store):
+    """Cloudflare R2 destination via rclone (S3-compatible, but the
+    account endpoint only rclone config carries — same contract as the
+    reference's R2 path and data_transfer's ingestion: a configured
+    'r2' remote)."""
+
+    NAME = 'r2'
+    SCHEME = 'r2://'
+    MOUNTABLE = False
+    MISSING_MARKERS = ('directory not found', "doesn't exist")
+
+    @staticmethod
+    def _remote_path(uri: str) -> str:
+        return 'r2:' + uri[len('r2://'):].rstrip('/')
+
+    def _tool(self, args: List[str]) -> subprocess.CompletedProcess:
+        if not shutil.which('rclone'):
+            raise exceptions.StorageError(
+                "rclone not found; r2:// buckets need rclone with an "
+                "'r2' remote configured (rclone config).")
+        return _run(['rclone'] + args)
+
+    def exists(self, uri: str) -> bool:
+        return self._tool(['lsd', self._remote_path(uri)]).returncode == 0
+
+    def create(self, uri: str) -> subprocess.CompletedProcess:
+        return self._tool(['mkdir', self._remote_path(uri)])
+
+    def delete(self, uri: str) -> subprocess.CompletedProcess:
+        return self._tool(['purge', self._remote_path(uri)])
+
+    def sync_up(self, local_src: str, uri: str, is_dir: bool):
+        # 'copy', never 'sync': sync would DELETE destination objects
+        # absent from the source — gsutil rsync (no -d) and aws s3 sync
+        # are non-deleting, and a persistent bucket's prior outputs
+        # must survive a re-upload.
+        dst = self._remote_path(uri)
+        if not is_dir:
+            import os
+            return self._tool(
+                ['copyto', local_src,
+                 f'{dst}/{os.path.basename(local_src)}'])
+        return self._tool(['copy', local_src, dst])
+
+    def host_copy_command(self, uri: str, dst: str) -> str:
+        import shlex
+        return (f'mkdir -p {shlex.quote(dst)} && '
+                f'rclone copy --fast-list {self._remote_path(uri)} '
+                f'{shlex.quote(dst)}')

@@ -49,7 +49,7 @@ _STORAGE_SCHEMA = {
             'anyOf': [{'type': 'string'},
                       {'type': 'array', 'items': {'type': 'string'}}]
         },
-        'store': {'type': 'string', 'enum': ['gcs']},
+        'store': {'type': 'string', 'enum': ['gcs', 's3', 'r2']},
         'persistent': {'type': 'boolean'},
         'mode': {'type': 'string', 'enum': ['MOUNT', 'COPY', 'mount', 'copy']},
     },
