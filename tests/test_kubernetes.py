@@ -584,6 +584,44 @@ def test_multihost_gang_through_kubectl_seam(exec_kubectl, skytpu_home):
     assert not list((exec_kubectl.parent / 'k8s-state').glob('kg1-*'))
 
 
+@pytest.mark.e2e
+def test_multihost_gang_failure_cancels_over_agent(exec_kubectl,
+                                                   skytpu_home):
+    """Gang semantics across pods: a failing rank cancels the other
+    rank THROUGH the agent (recorded-pgid kill; the agent runs jobs in
+    their own session so the kill reaches them) — the job fails fast
+    instead of riding out the healthy rank's sleep."""
+    import os
+    import time as _time
+
+    from skypilot_tpu import Resources, Task, core, execution, state
+    state.set_enabled_clouds(['kubernetes'])
+    task = Task(
+        'kgangfail',
+        run='if [ "$SKYTPU_NODE_RANK" = "1" ]; then exit 3; fi; sleep 60')
+    task.set_resources(
+        Resources(cloud='kubernetes', accelerator='tpu-v5p-16'))
+    job_id = execution.launch(task, cluster_name='kgf1', detach_run=True,
+                              stream_logs=False)
+    try:
+        t0 = _time.time()
+        st = 'PENDING'
+        deadline = t0 + 180
+        while _time.time() < deadline:
+            st = core.job_status('kgf1', job_id)['status']
+            if st in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED'):
+                break
+            _time.sleep(1)
+        assert st == 'FAILED', st
+        # Gang cancel: nowhere near the healthy rank's 60s sleep.
+        assert _time.time() - t0 < 45
+        log_dir = core.download_logs('kgf1', job_id)
+        content = open(os.path.join(log_dir, 'run.log')).read()
+        assert 'job failed on host(s)' in content
+    finally:
+        core.down('kgf1')
+
+
 def test_fuse_probe_parsing():
     """host_supports_fuse maps probe output -> capability; the local
     cloud and the SKYTPU_DISABLE_FUSE escape hatch always say no."""
