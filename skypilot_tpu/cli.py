@@ -446,12 +446,28 @@ def storage():
 @storage.command('ls')
 def storage_ls():
     from skypilot_tpu import core
-    rows = [[s['name'], s.get('source') or '-', s['mode'],
-             _fmt_ts(s.get('launched_at'))] for s in core.storage_ls()]
+    rows = []
+    for s in core.storage_ls():
+        # Source/mode/store live inside the pickled handle, not as
+        # flat row columns.
+        h = s['handle']
+        mode = getattr(h, 'mode', None)
+        source = getattr(h, 'source', None)
+        if isinstance(source, list):
+            source = ','.join(source)
+        rows.append([
+            s['name'],
+            source or '-',
+            getattr(h, 'store', 'gcs'),
+            getattr(mode, 'value', str(mode)),
+            s['status'].value,
+            _fmt_ts(s.get('launched_at')),
+        ])
     if not rows:
         click.echo('No storage.')
         return
-    click.echo(_table(['NAME', 'SOURCE', 'MODE', 'CREATED'], rows))
+    click.echo(_table(['NAME', 'SOURCE', 'STORE', 'MODE', 'STATUS',
+                       'CREATED'], rows))
 
 
 @storage.command('delete')

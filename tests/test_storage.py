@@ -209,3 +209,21 @@ def test_mount_on_unmountable_store_degrades_to_copy(monkeypatch):
     storage_mounting.mount_storage([r], '/out', st, '/dev/null')
     assert any('not mountable' in w for w in warnings)
     assert 's3://out' in r.cmds[0] and 'rsync' in r.cmds[0]
+
+
+def test_cli_storage_ls_renders_rows():
+    """`skytpu storage ls` with rows present: source/mode/store come
+    out of the pickled handle (regression: the table indexed flat keys
+    the state rows never had and crashed on ANY storage)."""
+    from click.testing import CliRunner
+
+    from skypilot_tpu import cli as cli_mod
+    from skypilot_tpu import state
+    from skypilot_tpu.status_lib import StorageStatus
+    h = storage.StorageHandle('b1', './data', storage.StorageMode.COPY,
+                              True, store='s3')
+    state.add_or_update_storage('b1', h, StorageStatus.READY)
+    res = CliRunner().invoke(cli_mod.cli, ['storage', 'ls'])
+    assert res.exit_code == 0, res.output
+    assert 'b1' in res.output and 's3' in res.output
+    assert 'COPY' in res.output and 'READY' in res.output
