@@ -118,17 +118,23 @@ class _SD:
 
 def _convert_llama(cfg: LlamaConfig, sd: _SD) -> Dict[str, Any]:
     d = cfg.head_dim_
+    if cfg.hf_norm_zero_centered:
+        # Gemma checkpoints already store the zero-centered reparam this
+        # framework's RMSNorm uses — no -1 shift.
+        norm = lambda t: np.asarray(_np(t), np.float32)  # noqa: E731
+    else:
+        norm = _norm_scale
     params: Dict[str, Any] = {
         'embedding': _np(sd('embed_tokens.weight')),
-        'final_norm': {'scale': _norm_scale(sd('norm.weight'))},
+        'final_norm': {'scale': norm(sd('norm.weight'))},
     }
     for i in range(cfg.num_layers):
         p = f'layers.{i}.'
         params[f'layer_{i}'] = {
             'input_norm': {
-                'scale': _norm_scale(sd(p + 'input_layernorm.weight'))},
+                'scale': norm(sd(p + 'input_layernorm.weight'))},
             'post_attn_norm': {
-                'scale': _norm_scale(
+                'scale': norm(
                     sd(p + 'post_attention_layernorm.weight'))},
             'attn': {
                 'q_proj': {'kernel': _qkv_kernel(
@@ -427,6 +433,25 @@ def config_from_hf(hf_config, name: Optional[str] = None):
                             getattr(hf_config, 'attention_bias', False)),
             tie_embeddings=getattr(hf_config, 'tie_word_embeddings', False),
             **scaling_kw)
+    if mt == 'gemma':
+        # Gemma = llama topology + GeGLU (tanh GELU), sqrt(H)-scaled
+        # embeddings, explicit head_dim (256), tied embeddings, and
+        # zero-centered norm weights (handled in _convert_llama).
+        return LlamaConfig(
+            name=name, vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            head_dim=getattr(hf_config, 'head_dim', 256),
+            max_seq_len=hf_config.max_position_embeddings,
+            rope_theta=getattr(hf_config, 'rope_theta', 10000.0),
+            norm_eps=hf_config.rms_norm_eps,
+            tie_embeddings=True,
+            hidden_act='gelu_tanh',
+            scale_embeddings=True,
+            hf_norm_zero_centered=True)
     if mt == 'gpt2':
         return GPT2Config(
             name=name, vocab_size=hf_config.vocab_size,

@@ -47,6 +47,18 @@ class LlamaConfig:
     # Biases on the q/k/v projections (Qwen2-style; LLaMA proper has
     # none anywhere).
     attention_bias: bool = False
+    # MLP gate activation: 'silu' (LLaMA/Qwen2) or 'gelu_tanh'
+    # (Gemma's GeGLU — tanh-approximated GELU).
+    hidden_act: str = 'silu'
+    # Gemma scales the embedding output by sqrt(hidden_size) (the
+    # normalizer is cast to the compute dtype first, matching HF's
+    # GemmaModel exactly so imported checkpoints keep logit parity).
+    scale_embeddings: bool = False
+    # HF-checkpoint convention for RMSNorm weights: LLaMA stores w
+    # (applied as x*w), Gemma stores a zero-centered w (applied as
+    # x*(1+w) — the same reparam this framework's RMSNorm uses).
+    # Consumed by models/hf_import.py only.
+    hf_norm_zero_centered: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     # LoRA adapters (train/lora.py): rank 0 disables.  Targets name the
     # projections that get a sibling '<name>_lora' adapter; the base
@@ -359,7 +371,14 @@ class MLP(nn.Module):
                      ('embed', 'mlp'))(x)
         up = _proj(cfg, 'up_proj', cfg.intermediate_size,
                    ('embed', 'mlp'))(x)
-        h = nn.silu(gate) * up
+        if cfg.hidden_act == 'gelu_tanh':       # Gemma GeGLU
+            h = nn.gelu(gate, approximate=True) * up
+        elif cfg.hidden_act == 'silu':
+            h = nn.silu(gate) * up
+        else:
+            raise ValueError(
+                f'Unknown hidden_act {cfg.hidden_act!r}; '
+                "expected 'silu' or 'gelu_tanh'.")
         h = nn.with_logical_constraint(
             h, ('activation_batch', 'activation_seq', 'activation_mlp'))
         return _proj(cfg, 'down_proj', cfg.hidden_size,
@@ -426,6 +445,10 @@ class Llama(nn.Module):
                                          ('vocab_table', 'embed_table')),
             (cfg.vocab_size, cfg.hidden_size))
         x = embed.astype(cfg.dtype)[tokens]
+        if cfg.scale_embeddings:
+            # Normalizer cast to dtype BEFORE the multiply (bf16 rounds
+            # sqrt(H)) — bit-matching HF's GemmaModel for logit parity.
+            x = x * jnp.asarray(cfg.hidden_size**0.5, cfg.dtype)
         x = nn.with_logical_constraint(
             x, ('activation_batch', 'activation_seq', 'activation_embed'))
         new_cache = []

@@ -71,6 +71,32 @@ _register(
                 intermediate_size=128, num_layers=2, num_heads=4,
                 num_kv_heads=2, max_seq_len=256, tie_embeddings=True))
 
+# ----------------------------------------------------------------- gemma
+# Gemma rides the llama decoder with GeGLU (tanh GELU), sqrt(H)-scaled
+# embeddings, explicit head_dim 256 and tied embeddings (llm/gemma
+# recipe parity; the reference serves it via vLLM).
+_register(
+    LlamaConfig(name='gemma-7b', vocab_size=256000, hidden_size=3072,
+                intermediate_size=24576, num_layers=28, num_heads=16,
+                num_kv_heads=16, head_dim=256, max_seq_len=8192,
+                tie_embeddings=True, hidden_act='gelu_tanh',
+                scale_embeddings=True, hf_norm_zero_centered=True))
+# gemma-2b is MQA (1 kv head): it trains/serves on data/fsdp meshes but
+# cannot shard the kv head over a tensor axis (use tensor=1, or gemma-7b
+# which is MHA).
+_register(
+    LlamaConfig(name='gemma-2b', vocab_size=256000, hidden_size=2048,
+                intermediate_size=16384, num_layers=18, num_heads=8,
+                num_kv_heads=1, head_dim=256, max_seq_len=8192,
+                tie_embeddings=True, hidden_act='gelu_tanh',
+                scale_embeddings=True, hf_norm_zero_centered=True))
+_register(
+    LlamaConfig(name='gemma-debug', vocab_size=256, hidden_size=64,
+                intermediate_size=128, num_layers=2, num_heads=4,
+                num_kv_heads=2, head_dim=16, max_seq_len=256,
+                tie_embeddings=True, hidden_act='gelu_tanh',
+                scale_embeddings=True, hf_norm_zero_centered=True))
+
 # ------------------------------------------------------------------ gpt2
 # GPT-2 sizes from the original family (llm/gpt-2 recipe parity).
 _register(GPT2Config(name='gpt2', vocab_size=50257, hidden_size=768,

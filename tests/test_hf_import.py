@@ -320,3 +320,34 @@ def test_qwen2_sliding_window_rejected():
         use_sliding_window=True, sliding_window=16, max_window_layers=0)
     with pytest.raises(ValueError, match='sliding_window'):
         hf_import.config_from_hf(hf_cfg)
+
+
+def test_gemma_logit_parity():
+    """Gemma = llama topology + GeGLU (tanh), sqrt(H)-scaled embeddings,
+    zero-centered norm weights, explicit head_dim, tied embeddings —
+    converted weights must match transformers logits exactly."""
+    torch.manual_seed(9)
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+    # Random (non-zero) norm weights: a zero-init checkpoint would hide
+    # a wrong +-1 shift in the conversion.
+    with torch.no_grad():
+        for n, p in hf.named_parameters():
+            if 'norm' in n:
+                p.copy_(torch.randn_like(p) * 0.1)
+
+    cfg = hf_import.config_from_hf(hf_cfg, name='tiny-gemma')
+    assert cfg.hidden_act == 'gelu_tanh' and cfg.scale_embeddings
+    assert cfg.tie_embeddings and cfg.head_dim == 16
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = hf_import.convert_state_dict(cfg, hf.state_dict())
+
+    from skypilot_tpu.models.llama import Llama
+    tokens = _tokens(128)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(tokens)).logits.numpy()
+    got = Llama(cfg).apply({'params': params}, jnp.asarray(tokens))
+    _assert_close(got, want)

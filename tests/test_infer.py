@@ -760,3 +760,28 @@ def test_gpt2_engine_continuous_batching():
                                     max_new_tokens=4) for i in range(5)])
     assert len(results) == 5
     assert all(len(r.output_tokens) == 4 for r in results)
+
+
+def test_gemma_engine_matches_full_forward_argmax():
+    """Gemma (GeGLU + scaled embeddings + MQA + head_dim != H/heads)
+    rides the same engine: cached incremental decode reproduces the
+    full-forward greedy continuation."""
+    import dataclasses as _dc
+
+    from skypilot_tpu.models import get_model_config
+    from skypilot_tpu.models.llama import Llama
+    cfg_m = _dc.replace(get_model_config('gemma-debug'),
+                        dtype=jnp.float32)
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=6, cache_dtype=jnp.float32)
+    eng = InferenceEngine(cfg_m, cfg, rng=jax.random.PRNGKey(23))
+    prompt = [5, 6, 7]
+    res = eng.generate([Request(tokens=prompt, max_new_tokens=6)])[0]
+    assert res.finish_reason == 'length'
+
+    model = Llama(cfg_m)
+    seq = list(prompt)
+    for _ in range(6):
+        logits = model.apply(eng.params, jnp.asarray([seq]))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert res.output_tokens == seq[len(prompt):]

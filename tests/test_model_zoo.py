@@ -13,7 +13,8 @@ from skypilot_tpu.train import TrainConfig, create_sharded_state
 from skypilot_tpu.train.trainer import make_train_step, synthetic_data
 
 
-@pytest.mark.parametrize('name', ['gpt2-debug', 'mixtral-debug'])
+@pytest.mark.parametrize('name', ['gpt2-debug', 'mixtral-debug',
+                                  'gemma-debug'])
 def test_lm_forward_shapes(name):
     cfg = get_model_config(name)
     model = build_model(cfg)
@@ -24,7 +25,8 @@ def test_lm_forward_shapes(name):
     assert jnp.all(jnp.isfinite(logits))
 
 
-@pytest.mark.parametrize('name', ['gpt2-debug', 'mixtral-debug'])
+@pytest.mark.parametrize('name', ['gpt2-debug', 'mixtral-debug',
+                                  'gemma-debug'])
 def test_lm_families_train_on_mesh(name):
     cfg = get_model_config(name)
     tcfg = TrainConfig(model=name, batch_size=8, seq_len=32,
