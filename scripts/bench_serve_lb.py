@@ -135,6 +135,8 @@ def main() -> None:
     parser.add_argument('--max-ttft', type=float, default=None,
                         help='replica admission bound (s); sheds count '
                              'in the sweep rows')
+    parser.add_argument('--max-queue', type=int, default=None,
+                        help='replica hard backlog cap (requests)')
     parser.add_argument('--service-name', default='lbbench')
     parser.add_argument('--out', default=None)
     parser.add_argument('--keep-up', action='store_true',
@@ -158,6 +160,8 @@ def main() -> None:
             f'--decode-steps {args.decode_steps} --max-cache-len 512 '
             + (f'--max-ttft {args.max_ttft} '
                if args.max_ttft is not None else '')
+            + (f'--max-queue {args.max_queue} '
+               if args.max_queue is not None else '')
             + '--port $SKYTPU_SERVE_REPLICA_PORT')
         from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
         task = Task('llama-serve-bench', run=run_cmd)

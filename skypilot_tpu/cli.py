@@ -913,13 +913,17 @@ def infer():
                    'serving / hermetic CI; default = jax\'s pick).')
 @click.option('--max-ttft', type=float, default=None,
               help='Admission bound (s): shed requests (HTTP 429 + '
-                   'Retry-After) whose projected TTFT exceeds this '
-                   'instead of queueing unboundedly. Default: off.')
+                   'Retry-After) while recent observed TTFT exceeds '
+                   'this instead of queueing unboundedly. Default: off.')
+@click.option('--max-queue', type=int, default=None,
+              help='Hard first-token backlog cap: shed (429) the moment '
+                   'this many requests are queued ahead (bounds the '
+                   'TTFT tail feedforward). Default: off.')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
                 tensor_parallel, weight_dtype, profile,
-                prefills_per_gap, platform, max_ttft):
+                prefills_per_gap, platform, max_ttft, max_queue):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
@@ -936,7 +940,8 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      tensor_parallel=tensor_parallel,
                      weight_dtype=weight_dtype,
                      prefills_per_gap=prefills_per_gap,
-                     platform=platform, max_ttft=max_ttft)
+                     platform=platform, max_ttft=max_ttft,
+                     max_queue=max_queue)
 
 
 @infer.command('bench')

@@ -785,3 +785,18 @@ def test_gemma_engine_matches_full_forward_argmax():
         logits = model.apply(eng.params, jnp.asarray([seq]))
         seq.append(int(jnp.argmax(logits[0, -1])))
     assert res.output_tokens == seq[len(prompt):]
+
+
+def test_admission_hard_queue_cap():
+    """--max-queue: feedforward shed at a fixed backlog depth while
+    saturated, independent of any TTFT estimate (bounds the tail with
+    zero feedback lag)."""
+    from skypilot_tpu.infer.server import AdmissionError, InferenceServer
+    srv = InferenceServer(engine=None, max_queue=8)
+    for i in range(8):
+        srv._admit(f'q{i}')
+    with pytest.raises(AdmissionError):
+        srv._admit('q8')
+    assert srv.shed_count == 1
+    srv._note_first_token('q0', 0.5)
+    srv._admit('q8')   # backlog back under the cap
