@@ -32,12 +32,18 @@ from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
 
 
 class AdmissionError(Exception):
-    """Request shed at admission: the server is past its TTFT bound."""
+    """Request shed at admission (TTFT bound exceeded or queue full)."""
 
-    def __init__(self, projected_s: float, bound_s: float):
+    def __init__(self, projected_s: float, bound_s: float,
+                 retry_after_s: Optional[int] = None,
+                 message: Optional[str] = None):
         self.projected_s = projected_s
         self.bound_s = bound_s
+        # Explicit Retry-After override (the queue-cap shed computes its
+        # own drain estimate; the TTFT shed derives one from the bound).
+        self.retry_after_s = retry_after_s
         super().__init__(
+            message or
             f'overloaded: recent TTFT {projected_s:.1f}s exceeds the '
             f'{bound_s:.1f}s admission bound')
 
@@ -143,12 +149,23 @@ class InferenceServer:
                          not self.engine.has_free_slot())
             if (self.max_queue is not None and saturated and
                     backlog >= self.max_queue):
+                import math
                 import statistics
                 self.shed_count += 1
+                # Drain estimate: the queue moves at roughly one
+                # first-token per recent-TTFT/backlog... the honest
+                # cheap signal is the recent TTFT itself (how long the
+                # queue has been making requests wait).
                 est = (statistics.median(self._recent_ttfts)
-                       if self._recent_ttfts else float(backlog))
-                raise AdmissionError(est, bound if bound is not None
-                                     else est)
+                       if self._recent_ttfts else None)
+                retry = max(1, math.ceil(est)) if est is not None else 5
+                raise AdmissionError(
+                    est if est is not None else 0.0,
+                    bound if bound is not None else 0.0,
+                    retry_after_s=retry,
+                    message=f'overloaded: admission queue full '
+                            f'({backlog} requests waiting, cap '
+                            f'{self.max_queue})')
             if (bound is not None and saturated and
                     backlog >= self._ADMIT_BACKLOG_FLOOR and
                     len(self._recent_ttfts) >= 4):
@@ -270,10 +287,12 @@ def _make_handler(server: InferenceServer):
             self.wfile.write(body)
 
         def _shed(self, e: 'AdmissionError') -> None:
-            """429 + Retry-After: wait long enough that the projected
-            queue drains back under the bound."""
+            """429 + Retry-After: wait long enough that the queue
+            plausibly drains back under the bound."""
             import math
-            retry_after = max(1, math.ceil(e.projected_s - e.bound_s))
+            retry_after = (e.retry_after_s if e.retry_after_s is not None
+                           else max(1, math.ceil(e.projected_s -
+                                                 e.bound_s)))
             self._json(429, {'error': str(e), 'shed': True,
                              'projected_ttft_s': round(e.projected_s, 2),
                              'bound_s': e.bound_s},

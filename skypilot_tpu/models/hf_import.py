@@ -434,9 +434,22 @@ def config_from_hf(hf_config, name: Optional[str] = None):
             tie_embeddings=getattr(hf_config, 'tie_word_embeddings', False),
             **scaling_kw)
     if mt == 'gemma':
-        # Gemma = llama topology + GeGLU (tanh GELU), sqrt(H)-scaled
-        # embeddings, explicit head_dim (256), tied embeddings, and
-        # zero-centered norm weights (handled in _convert_llama).
+        # Gemma = llama topology + GeGLU, sqrt(H)-scaled embeddings,
+        # explicit head_dim (256), tied embeddings, and zero-centered
+        # norm weights (handled in _convert_llama).  The activation
+        # comes from the CHECKPOINT: modern configs say
+        # gelu_pytorch_tanh (via hidden_activation); early-era Gemma
+        # configs predate that fix and run exact GELU — hardcoding
+        # tanh-approx would silently break logit parity for those.
+        hf_act = (getattr(hf_config, 'hidden_activation', None) or
+                  getattr(hf_config, 'hidden_act', 'gelu_pytorch_tanh'))
+        act = {'gelu_pytorch_tanh': 'gelu_tanh', 'gelu_tanh': 'gelu_tanh',
+               'gelu': 'gelu', 'gelu_new': 'gelu_tanh',
+               'silu': 'silu'}.get(hf_act)
+        if act is None:
+            raise ValueError(
+                f'unsupported gemma hidden activation {hf_act!r}; '
+                'refusing to load with a wrong MLP')
         return LlamaConfig(
             name=name, vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
@@ -449,7 +462,7 @@ def config_from_hf(hf_config, name: Optional[str] = None):
             rope_theta=getattr(hf_config, 'rope_theta', 10000.0),
             norm_eps=hf_config.rms_norm_eps,
             tie_embeddings=True,
-            hidden_act='gelu_tanh',
+            hidden_act=act,
             scale_embeddings=True,
             hf_norm_zero_centered=True)
     if mt == 'gpt2':

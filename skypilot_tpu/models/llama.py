@@ -373,12 +373,14 @@ class MLP(nn.Module):
                    ('embed', 'mlp'))(x)
         if cfg.hidden_act == 'gelu_tanh':       # Gemma GeGLU
             h = nn.gelu(gate, approximate=True) * up
+        elif cfg.hidden_act == 'gelu':          # exact (erf) GELU
+            h = nn.gelu(gate, approximate=False) * up
         elif cfg.hidden_act == 'silu':
             h = nn.silu(gate) * up
         else:
             raise ValueError(
                 f'Unknown hidden_act {cfg.hidden_act!r}; '
-                "expected 'silu' or 'gelu_tanh'.")
+                "expected 'silu', 'gelu' or 'gelu_tanh'.")
         h = nn.with_logical_constraint(
             h, ('activation_batch', 'activation_seq', 'activation_mlp'))
         return _proj(cfg, 'down_proj', cfg.hidden_size,

@@ -90,10 +90,20 @@ _register(
                 num_kv_heads=1, head_dim=256, max_seq_len=8192,
                 tie_embeddings=True, hidden_act='gelu_tanh',
                 scale_embeddings=True, hf_norm_zero_centered=True))
+# head_dim 32 != hidden/num_heads (16): the decoupled-head_dim o_proj
+# shape gemma-7b has (16 x 256 = 4096 != 3072) is exercised in CI.
 _register(
     LlamaConfig(name='gemma-debug', vocab_size=256, hidden_size=64,
                 intermediate_size=128, num_layers=2, num_heads=4,
-                num_kv_heads=2, head_dim=16, max_seq_len=256,
+                num_kv_heads=2, head_dim=32, max_seq_len=256,
+                tie_embeddings=True, hidden_act='gelu_tanh',
+                scale_embeddings=True, hf_norm_zero_centered=True))
+# True MQA (1 kv head) like gemma-2b — engine/cache-path CI only (a
+# single kv head cannot shard over a tensor mesh axis).
+_register(
+    LlamaConfig(name='gemma-mqa-debug', vocab_size=256, hidden_size=64,
+                intermediate_size=128, num_layers=2, num_heads=4,
+                num_kv_heads=1, head_dim=32, max_seq_len=256,
                 tie_embeddings=True, hidden_act='gelu_tanh',
                 scale_embeddings=True, hf_norm_zero_centered=True))
 

@@ -762,16 +762,18 @@ def test_gpt2_engine_continuous_batching():
     assert all(len(r.output_tokens) == 4 for r in results)
 
 
-def test_gemma_engine_matches_full_forward_argmax():
-    """Gemma (GeGLU + scaled embeddings + MQA + head_dim != H/heads)
-    rides the same engine: cached incremental decode reproduces the
-    full-forward greedy continuation."""
+@pytest.mark.parametrize('name', ['gemma-debug', 'gemma-mqa-debug'])
+def test_gemma_engine_matches_full_forward_argmax(name):
+    """Gemma rides the same engine: cached incremental decode
+    reproduces the full-forward greedy continuation — for both the GQA
+    shape with decoupled head_dim (heads*head_dim != hidden, like
+    gemma-7b) and TRUE MQA (1 kv head, like gemma-2b)."""
     import dataclasses as _dc
 
     from skypilot_tpu.models import get_model_config
     from skypilot_tpu.models.llama import Llama
-    cfg_m = _dc.replace(get_model_config('gemma-debug'),
-                        dtype=jnp.float32)
+    cfg_m = _dc.replace(get_model_config(name), dtype=jnp.float32)
+    assert cfg_m.head_dim_ * cfg_m.num_heads != cfg_m.hidden_size
     cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
                       max_new_tokens=6, cache_dtype=jnp.float32)
     eng = InferenceEngine(cfg_m, cfg, rng=jax.random.PRNGKey(23))
