@@ -919,11 +919,18 @@ def infer():
               help='Hard first-token backlog cap: shed (429) the moment '
                    'this many requests are queued ahead (bounds the '
                    'TTFT tail feedforward). Default: off.')
+@click.option('--draft-len', type=int, default=0,
+              help='Speculative decoding: prompt-lookup draft tokens '
+                   'verified per dispatch (greedy requests). Wins on '
+                   'input-grounded output; 0 disables.')
+@click.option('--ngram-max', type=int, default=4,
+              help='Longest n-gram tried when drafting (--draft-len).')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
                 tensor_parallel, weight_dtype, profile,
-                prefills_per_gap, platform, max_ttft, max_queue):
+                prefills_per_gap, platform, max_ttft, max_queue,
+                draft_len, ngram_max):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
@@ -941,7 +948,8 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      weight_dtype=weight_dtype,
                      prefills_per_gap=prefills_per_gap,
                      platform=platform, max_ttft=max_ttft,
-                     max_queue=max_queue)
+                     max_queue=max_queue, draft_len=draft_len,
+                     ngram_max=ngram_max)
 
 
 @infer.command('bench')
@@ -974,10 +982,17 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
               type=click.Choice(sorted(_INFER_PROFILES)),
               help='Preset operating point (docs/performance.md); '
                    'explicit flags win over the preset.')
+@click.option('--draft-len', type=int, default=0,
+              help='Speculative decoding: prompt-lookup draft tokens '
+                   'verified per dispatch (0 disables). The metrics '
+                   'line gains spec_* acceptance counters.')
+@click.option('--ngram-max', type=int, default=4,
+              help='Longest n-gram tried when drafting (--draft-len).')
 @click.pass_context
 def infer_bench(ctx, model, num_requests, prompt_len, new_tokens,
                 num_slots, max_cache_len, decode_steps, cache_dtype,
-                weight_dtype, serving, qps, prefills_per_gap, profile):
+                weight_dtype, serving, qps, prefills_per_gap, profile,
+                draft_len, ngram_max):
     """Benchmark the engine (req/s, tok/s, TTFT) with synthetic prompts."""
     import dataclasses as _dc
     import json as json_lib
@@ -995,7 +1010,8 @@ def infer_bench(ctx, model, num_requests, prompt_len, new_tokens,
                       max_cache_len=max_cache_len,
                       decode_steps=decode_steps,
                       prefills_per_gap=prefills_per_gap,
-                      cache_dtype=resolve_cache_dtype(cache_dtype))
+                      cache_dtype=resolve_cache_dtype(cache_dtype),
+                      draft_len=draft_len, ngram_max=ngram_max)
     model_config = get_model_config(model)
     if weight_dtype != 'bf16':
         from skypilot_tpu.models.llama import LlamaConfig
@@ -1013,6 +1029,9 @@ def infer_bench(ctx, model, num_requests, prompt_len, new_tokens,
         metrics = engine.benchmark(num_requests=num_requests,
                                    prompt_len=prompt_len,
                                    new_tokens=new_tokens)
+    if draft_len:
+        metrics.update({f'spec_{k}': v
+                        for k, v in engine.spec_stats.items()})
     click.echo(json_lib.dumps(metrics))
 
 

@@ -86,6 +86,22 @@ class InferConfig:
     # short chunks pad by duplicating a real lane).  Amortizes
     # per-dispatch latency the same way decode_steps does for decode.
     prefill_lanes: int = 4
+    # Speculative decoding via prompt-lookup (n-gram) drafting: 0
+    # disables (windowed decode).  With draft_len=D, every decode
+    # dispatch feeds [last_token, d1..dD] — D draft tokens proposed by
+    # matching the slot's recent n-gram against its own prompt+output
+    # history — through ONE [B, 1+D] cached forward and accepts the
+    # longest draft prefix the model agrees with (greedy slots only;
+    # sampled slots fall back to 1 token/dispatch).  Decode is
+    # weight-streaming-bound, so a [B, 1+D] forward costs about the same
+    # HBM traffic as [B, 1]: accepted drafts are (nearly) free tokens.
+    # Wins on input-grounded output (summarization, code edit, RAG);
+    # on unrelated output acceptance ~0 and windowed decode is faster.
+    # Parity: vLLM's prompt-lookup speculator (the reference delegates
+    # serving to vLLM); JetStream has no speculative path.
+    draft_len: int = 0
+    # Longest n-gram tried (then n-1 ... 1) when drafting.
+    ngram_max: int = 4
 
 
 @dataclasses.dataclass
@@ -115,6 +131,32 @@ class RequestResult:
     finish_reason: str            # 'eos' | 'length' | 'error'
     error: Optional[str] = None
     error_class: Optional[str] = None   # 'client' | 'internal'
+
+
+def prompt_lookup_draft(hist: Sequence[int], k: int,
+                        ngram_max: int) -> List[int]:
+    """Prompt-lookup drafting: propose up to `k` tokens continuing the
+    most recent earlier occurrence of the history's trailing n-gram
+    (longest n first).  Pure host-side numpy over the slot's own
+    prompt+output tokens — no draft model, no device work."""
+    length = len(hist)
+    if length < 2 or k < 1:
+        return []
+    h = np.asarray(hist, np.int32)
+    from numpy.lib.stride_tricks import sliding_window_view
+    for n in range(min(ngram_max, length - 1), 0, -1):
+        tail = h[length - n:]
+        # Window starts 0..length-1-n: the trailing n-gram itself
+        # (start length-n) is excluded, so a match is a genuine earlier
+        # occurrence.
+        windows = sliding_window_view(h[:length - 1], n)
+        cand = np.flatnonzero((windows == tail).all(axis=1))
+        if cand.size:
+            start = int(cand[-1]) + n
+            proposal = h[start:start + k]
+            if proposal.size:
+                return proposal.tolist()
+    return []
 
 
 class _Slot:
@@ -181,6 +223,20 @@ class InferenceEngine:
         if self.cfg.prefill_lanes < 1:
             raise ValueError(f'prefill_lanes must be >= 1 '
                              f'(got {self.cfg.prefill_lanes})')
+        if self.cfg.draft_len < 0:
+            raise ValueError(f'draft_len must be >= 0 '
+                             f'(got {self.cfg.draft_len})')
+        if self.cfg.draft_len + 1 >= self.cfg.max_cache_len:
+            raise ValueError(
+                f'draft_len + 1 ({self.cfg.draft_len + 1}) must be < '
+                f'max_cache_len ({self.cfg.max_cache_len})')
+        if self.cfg.draft_len and self.cfg.ngram_max < 1:
+            raise ValueError(f'ngram_max must be >= 1 '
+                             f'(got {self.cfg.ngram_max})')
+        # Speculation observability: dispatches that ran the verify path,
+        # draft tokens offered, draft tokens accepted (acceptance rate =
+        # accepted/offered; extra tok/dispatch = accepted/dispatches).
+        self.spec_stats = {'dispatches': 0, 'drafted': 0, 'accepted': 0}
         # Mixtral rides the same engine: shared attention geometry means
         # llama.init_cache covers its KV cache, and the MoE block's
         # router + experts simply run on the new tokens inside the same
@@ -367,8 +423,29 @@ class InferenceEngine:
                 one_step, (cache, tokens, lengths), keys)
             return toks, cache                               # [K, B]
 
+        def spec_verify(params, cache, tokens, lengths, temps, rng):
+            """One speculative verify dispatch.  tokens [B, 1+D]: column
+            0 is each slot's last generated token, columns 1.. are
+            drafts.  All 1+D rows are written to the cache (rows past
+            the accepted prefix are dead — the next dispatch's writes
+            start at the accepted length and cover them before any
+            query position reaches them, the same invariant as windowed
+            decode's EOS overrun).  Returns preds [B, 1+D]: the model's
+            next token after each fed position."""
+            k = tokens.shape[1]
+            positions = lengths[:, None] + jnp.arange(k)[None]
+            logits, cache = model.apply(params, tokens, positions, cache)
+            greedy = jnp.argmax(logits, axis=-1)             # [B, K]
+            temps_safe = jnp.maximum(temps, 1e-4)[:, None, None]
+            sampled = jax.random.categorical(rng, logits / temps_safe,
+                                             axis=-1)
+            preds = jnp.where(temps[:, None] > 0, sampled,
+                              greedy).astype(jnp.int32)
+            return preds, cache
+
         self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,))
         self._decode = jax.jit(decode, donate_argnums=(1,))
+        self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
 
     # ---------------------------------------------------------- schedule
 
@@ -548,6 +625,91 @@ class InferenceEngine:
             self._lengths[i] = s.length
             self._last_tokens[i] = s.generated[-1]
 
+    def _spec_step(self) -> None:
+        """One speculative-decode dispatch: draft with prompt-lookup,
+        verify [B, 1+D] in one forward, accept the agreed prefix plus
+        the model's own next token (so even zero acceptance yields one
+        token — exact greedy equivalence with plain decode)."""
+        k = self.cfg.draft_len + 1
+        cache_len = self.cfg.max_cache_len
+        # A slot within k of the cache end would get its k-row cache
+        # write CLAMPED by dynamic_update_slice (start > M-k), silently
+        # rewriting earlier, still-live rows.  Those slots finish within
+        # a few tokens anyway: run exact windowed decode until they do.
+        if any(s is not None and s.length > cache_len - k
+               for s in self._slots):
+            self._decode_step()
+            return
+        b = self.cfg.num_slots
+        tokens = np.zeros((b, k), np.int32)
+        tokens[:, 0] = self._last_tokens
+        drafted = np.zeros((b,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None or s.request.temperature > 0:
+                # Sampled slots can't accept greedy-verified drafts
+                # (that would need rejection sampling); they ride the
+                # dispatch at 1 token each.
+                continue
+            # A dispatch can append at most `budget` tokens (max_new /
+            # cache-boundary), of which the first needs no draft: don't
+            # draft past it — wasted lookup work that can never be
+            # accepted, and it would understate the reported
+            # acceptance rate.
+            budget = min(s.max_new - len(s.generated),
+                         cache_len - 1 - s.length)
+            want = min(self.cfg.draft_len, budget - 1)
+            if want < 1:
+                continue
+            hist = s.request.tokens + s.generated
+            drafts = prompt_lookup_draft(hist, want, self.cfg.ngram_max)
+            tokens[i, 1:1 + len(drafts)] = drafts
+            drafted[i] = len(drafts)
+        if not drafted.any():
+            # Nothing to verify (all-sampled batch, no n-gram matches,
+            # or every slot about to finish): the windowed decode's
+            # decode_steps tokens/dispatch beat a 1-token verify.
+            self._decode_step()
+            return
+        self._rng, key = jax.random.split(self._rng)
+        with self._ctx():
+            preds, self.cache = self._spec_verify(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(self._lengths), jnp.asarray(self._temps), key)
+        preds_np = np.asarray(preds)                         # [B, K]
+        self.spec_stats['dispatches'] += 1
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            self.spec_stats['drafted'] += int(drafted[i])
+            for t in range(k):
+                if len(s.generated) >= s.max_new:
+                    break
+                if (self.cfg.eos_id is not None and s.generated and
+                        s.generated[-1] == self.cfg.eos_id):
+                    break
+                if s.length + 1 >= cache_len:
+                    break
+                if t > 0:
+                    # Position t fed draft tokens[i, t]; it only counts
+                    # if the model's prediction at t-1 agrees (and only
+                    # for greedy slots — sampled ones verified nothing).
+                    if (s.request.temperature > 0 or t > drafted[i] or
+                            int(tokens[i, t]) != int(preds_np[i, t - 1])):
+                        break
+                    self.spec_stats['accepted'] += 1
+                s.length += 1
+                s.generated.append(int(preds_np[i, t]))
+            self._lengths[i] = s.length
+            self._last_tokens[i] = s.generated[-1]
+
+    def _step(self) -> None:
+        """One decode dispatch: speculative verify when drafting is
+        enabled, else the windowed (lax.scan) decode."""
+        if self.cfg.draft_len > 0:
+            self._spec_step()
+        else:
+            self._decode_step()
+
     def _harvest(self) -> List[Tuple[Request, RequestResult]]:
         done = []
         for i, s in enumerate(self._slots):
@@ -604,7 +766,7 @@ class InferenceEngine:
                 finished.extend(self._harvest())
                 if not any(s is not None for s in self._slots):
                     continue
-                self._decode_step()
+                self._step()
                 finished.extend(self._harvest())
             order = {id(r): i for i, r in enumerate(requests)}
             finished.sort(key=lambda pair: order.get(id(pair[0]), 0))
@@ -671,7 +833,7 @@ class InferenceEngine:
                 for _, res in self._harvest():   # prefill-only finishes
                     result_cb(res)
                 if any(s is not None for s in self._slots):
-                    self._decode_step()
+                    self._step()
                     self._flush_streams()
                     for _, res in self._harvest():
                         result_cb(res)

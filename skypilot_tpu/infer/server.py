@@ -471,7 +471,9 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         prefills_per_gap: int = 4,
         platform: Optional[str] = None,
         max_ttft: Optional[float] = None,
-        max_queue: Optional[int] = None) -> None:
+        max_queue: Optional[int] = None,
+        draft_len: int = 0,
+        ngram_max: int = 4) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -582,7 +584,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       max_cache_len=max_cache_len, eos_id=eos_id,
                       decode_steps=decode_steps,
                       prefills_per_gap=prefills_per_gap,
-                      cache_dtype=resolve_cache_dtype(cache_dtype))
+                      cache_dtype=resolve_cache_dtype(cache_dtype),
+                      draft_len=draft_len, ngram_max=ngram_max)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -613,13 +616,19 @@ def main() -> None:
                         choices=['bfloat16', 'fp8'])
     parser.add_argument('--tensor-parallel', type=int, default=0,
                         help='shard the model over N local chips')
+    parser.add_argument('--draft-len', type=int, default=0,
+                        help='speculative decoding: prompt-lookup draft '
+                             'tokens per dispatch (0 disables)')
+    parser.add_argument('--ngram-max', type=int, default=4,
+                        help='longest n-gram tried when drafting')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
         tokenizer_name=args.tokenizer, eos_id=args.eos_id,
         decode_steps=args.decode_steps, hf_model=args.hf_model,
         cache_dtype=args.cache_dtype,
-        tensor_parallel=args.tensor_parallel)
+        tensor_parallel=args.tensor_parallel,
+        draft_len=args.draft_len, ngram_max=args.ngram_max)
 
 
 if __name__ == '__main__':

@@ -802,3 +802,132 @@ def test_admission_hard_queue_cap():
     assert srv.shed_count == 1
     srv._note_first_token('q0', 0.5)
     srv._admit('q8')   # backlog back under the cap
+
+
+# ------------------------------------------------------- speculative decode
+
+
+def test_prompt_lookup_draft_basic():
+    from skypilot_tpu.infer.engine import prompt_lookup_draft
+    # Trailing bigram (7, 8) occurred earlier, followed by 9, 1, 2.
+    hist = [7, 8, 9, 1, 2, 3, 7, 8]
+    assert prompt_lookup_draft(hist, 3, 4) == [9, 1, 2]
+    # Longest n-gram wins: (1, 2, 3) over the (3,)-suffix match.
+    hist = [9, 1, 2, 3, 5, 4, 3, 6, 1, 2, 3]
+    assert prompt_lookup_draft(hist, 2, 4) == [5, 4]
+    # Most recent occurrence wins over an older one.
+    hist = [1, 2, 7, 5, 1, 2, 8, 5, 1, 2]
+    assert prompt_lookup_draft(hist, 1, 2) == [8]
+    # No earlier occurrence of any suffix n-gram -> no draft.
+    assert prompt_lookup_draft([1, 2, 3, 4], 3, 4) == []
+    assert prompt_lookup_draft([5], 3, 4) == []
+    assert prompt_lookup_draft([], 3, 4) == []
+
+
+def _spec_pair(tiny_config, draft_len, max_cache_len=64, eos_id=None):
+    """(plain, speculative) engines with identical params."""
+    base = dict(model='infer-test', num_slots=4,
+                max_cache_len=max_cache_len, prefill_buckets=(8, 16, 32),
+                max_new_tokens=16, cache_dtype=jnp.float32, eos_id=eos_id)
+    plain = InferenceEngine(tiny_config, InferConfig(**base),
+                            rng=jax.random.PRNGKey(7))
+    spec = InferenceEngine(tiny_config,
+                           InferConfig(**base, draft_len=draft_len),
+                           rng=jax.random.PRNGKey(7))
+    return plain, spec
+
+
+def test_spec_decode_matches_plain_greedy(tiny_config):
+    """Speculative decode is EXACT for greedy requests: identical output
+    to the windowed decode on repetitive and non-repetitive prompts."""
+    plain, spec = _spec_pair(tiny_config, draft_len=3)
+    prompts = [
+        [5, 6, 7, 8, 5, 6, 7, 8, 5, 6],      # repetitive: drafts fire
+        [3, 1, 4, 1, 5, 9, 2, 6],             # mixed
+        [42],                                  # minimal
+    ]
+    for prompt in prompts:
+        r_plain = plain.generate([Request(tokens=list(prompt),
+                                          max_new_tokens=12)])[0]
+        r_spec = spec.generate([Request(tokens=list(prompt),
+                                        max_new_tokens=12)])[0]
+        assert r_spec.output_tokens == r_plain.output_tokens, prompt
+    assert spec.spec_stats['dispatches'] > 0
+    assert spec.spec_stats['accepted'] <= spec.spec_stats['drafted']
+
+
+def test_spec_decode_oracle_drafts_full_acceptance(tiny_config,
+                                                   monkeypatch):
+    """With a perfect draft source, every dispatch yields 1+D tokens:
+    N tokens take ~ceil(N/(1+D)) dispatches instead of N/decode_steps,
+    and the output is still exactly the greedy continuation."""
+    from skypilot_tpu.infer import engine as engine_mod
+    plain, spec = _spec_pair(tiny_config, draft_len=3)
+    prompt = [11, 12, 13, 14]
+    expected = plain.generate([Request(tokens=list(prompt),
+                                       max_new_tokens=12)])[0].output_tokens
+
+    def oracle(hist, k, nmax):
+        done = len(hist) - len(prompt)
+        return expected[done:done + k]
+
+    monkeypatch.setattr(engine_mod, 'prompt_lookup_draft', oracle)
+    res = spec.generate([Request(tokens=list(prompt),
+                                 max_new_tokens=12)])[0]
+    assert res.output_tokens == expected
+    st = spec.spec_stats
+    assert st['accepted'] > 0
+    # 12 tokens at 4/dispatch: 3 verify dispatches (vs 12 plain steps).
+    assert st['dispatches'] <= 4
+
+
+def test_spec_decode_respects_eos_and_max_new(tiny_config):
+    plain, spec = _spec_pair(tiny_config, draft_len=3)
+    res = plain.generate([Request(tokens=[9, 8, 7], max_new_tokens=10)])[0]
+    eos = res.output_tokens[3]   # force an EOS mid-stream
+    plain_e, spec_e = _spec_pair(tiny_config, draft_len=3, eos_id=eos)
+    r_p = plain_e.generate([Request(tokens=[9, 8, 7],
+                                    max_new_tokens=10)])[0]
+    r_s = spec_e.generate([Request(tokens=[9, 8, 7],
+                                   max_new_tokens=10)])[0]
+    assert r_s.output_tokens == r_p.output_tokens
+    assert r_s.finish_reason == r_p.finish_reason == 'eos'
+    assert r_s.output_tokens[-1] == eos
+    # max_new_tokens=1 must still work (no drafts can be accepted).
+    r1 = spec_e.generate([Request(tokens=[4, 5], max_new_tokens=1)])[0]
+    assert len(r1.output_tokens) == 1
+
+
+def test_spec_decode_mixed_sampled_and_greedy(tiny_config):
+    """Sampled slots ride the verify dispatch at 1 token each; greedy
+    slots in the same batch still match the plain engine exactly."""
+    plain, spec = _spec_pair(tiny_config, draft_len=3)
+    greedy = Request(tokens=[5, 6, 7, 8, 5, 6, 7, 8], max_new_tokens=8,
+                     request_id='g')
+    sampled = Request(tokens=[1, 2, 3], max_new_tokens=8, temperature=0.9,
+                      request_id='s')
+    r_plain = plain.generate([Request(tokens=list(greedy.tokens),
+                                      max_new_tokens=8)])[0]
+    results = {r.request_id: r
+               for r in spec.generate([greedy, sampled])}
+    assert results['g'].output_tokens == r_plain.output_tokens
+    assert len(results['s'].output_tokens) == 8
+
+
+def test_spec_decode_near_cache_end_falls_back(tiny_config):
+    """Slots within draft_len+1 of the cache end take the exact windowed
+    path (a clamped k-row write would corrupt live rows); output still
+    matches the plain engine through the cache-length truncation."""
+    plain, spec = _spec_pair(tiny_config, draft_len=3, max_cache_len=16)
+    prompt = [2, 3, 4, 2, 3, 4, 2, 3]          # len 8; cache 16
+    r_p = plain.generate([Request(tokens=list(prompt),
+                                  max_new_tokens=8)])[0]
+    r_s = spec.generate([Request(tokens=list(prompt),
+                                 max_new_tokens=8)])[0]
+    assert r_s.output_tokens == r_p.output_tokens
+    assert r_s.finish_reason == 'length'
+    # The slot crossed length > M - (draft_len+1) = 12 mid-generation,
+    # so the fallback ran some windowed dispatches; the repetitive
+    # prompt still let earlier verify dispatches fire.
+    assert len(r_s.output_tokens) == 8
+    assert spec.spec_stats['dispatches'] >= 1
