@@ -925,12 +925,15 @@ def infer():
                    'input-grounded output; 0 disables.')
 @click.option('--ngram-max', type=int, default=4,
               help='Longest n-gram tried when drafting (--draft-len).')
+@click.option('--max-prefixes', type=int, default=16,
+              help='Resident prefix-KV entries for POST /cache_prefix '
+                   '(LRU-evicted; 0 disables prefix caching).')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
                 tensor_parallel, weight_dtype, profile,
                 prefills_per_gap, platform, max_ttft, max_queue,
-                draft_len, ngram_max):
+                draft_len, ngram_max, max_prefixes):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
@@ -949,7 +952,7 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      prefills_per_gap=prefills_per_gap,
                      platform=platform, max_ttft=max_ttft,
                      max_queue=max_queue, draft_len=draft_len,
-                     ngram_max=ngram_max)
+                     ngram_max=ngram_max, max_prefixes=max_prefixes)
 
 
 @infer.command('bench')

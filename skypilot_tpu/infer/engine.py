@@ -25,6 +25,7 @@ Role parity: replaces the reference's delegation to vLLM/JetStream
 (llm/vllm/, examples/tpu/v6e/serve-llama2-7b.yaml); the serve plane's
 replicas run this engine via `python -m skypilot_tpu.infer.server`.
 """
+import collections
 import contextlib
 import dataclasses
 import queue
@@ -102,6 +103,16 @@ class InferConfig:
     draft_len: int = 0
     # Longest n-gram tried (then n-1 ... 1) when drafting.
     ngram_max: int = 4
+    # Prefix KV caching: registered prefixes (system prompts) keep
+    # their per-layer KV rows resident on device; a request whose
+    # prompt starts with a registered prefix prefills ONLY its suffix —
+    # TTFT drops by the prefix share of prefill compute.  Rows are
+    # stored in cache_dtype, so reuse is bit-identical to a one-shot
+    # prefill (the suffix attends over the same quantized rows either
+    # way).  Max prefixes resident (LRU evicted); 0 disables.
+    # Parity: vLLM automatic-prefix-caching, here with explicit
+    # registration (engine.register_prefix / POST /cache_prefix).
+    max_prefixes: int = 16
 
 
 @dataclasses.dataclass
@@ -237,6 +248,13 @@ class InferenceEngine:
         # draft tokens offered, draft tokens accepted (acceptance rate =
         # accepted/offered; extra tok/dispatch = accepted/dispatches).
         self.spec_stats = {'dispatches': 0, 'drafted': 0, 'accepted': 0}
+        # Prefix KV cache: token-tuple -> per-layer [(k, v)] rows
+        # ([Hkv, L, D], cache dtype, device-resident), LRU-ordered.
+        self._prefixes: 'collections.OrderedDict[Tuple[int, ...], list]' \
+            = collections.OrderedDict()
+        # Requests whose prefill reused a cached prefix / prefix tokens
+        # skipped (prefill compute saved, in tokens).
+        self.prefix_stats = {'hits': 0, 'tokens_reused': 0}
         # Mixtral rides the same engine: shared attention geometry means
         # llama.init_cache covers its KV cache, and the MoE block's
         # router + experts simply run on the new tokens inside the same
@@ -443,9 +461,74 @@ class InferenceEngine:
                               greedy).astype(jnp.int32)
             return preds, cache
 
+        cache_dtype = self.cfg.cache_dtype
+
+        def prefill_capture(params, tokens, pcache):
+            """Forward a prefix [1, bucket] and return its KV rows (the
+            register_prefix path; logits are discarded)."""
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None], tokens.shape)
+            _, pc = model.apply(params, tokens, positions, pcache)
+            return pc
+
+        def prefix_prefill(params, tokens, start, true_lens, prefix_kv,
+                           cache, slots, temps, rng):
+            """Lane-batched suffix prefill over shared preloaded prefix
+            KV: P matched prompts forward only their suffixes, sample
+            first tokens, and insert all start+SB rows per slot — one
+            dispatch (the prefix-reuse twin of prefill_insert).
+
+            tokens [P, SB] (suffixes); start (STATIC) = reused prefix
+            rows; prefix_kv: per-layer ([Hkv, start, D]) pairs shared
+            by every lane.  Compiles per (start, SB): starts come only
+            from registered prefix lengths (len or len-1), so the key
+            space stays small — matching is restricted to full-prefix
+            matches for exactly this reason.
+            """
+            p, sb = tokens.shape
+            positions = start + jnp.broadcast_to(
+                jnp.arange(sb)[None], tokens.shape)
+            pcache = []
+            for pk, pv in prefix_kv:
+                hkv, _, hd = pk.shape
+                pad = jnp.zeros((p, hkv, sb, hd), cache_dtype)
+                pk_b = jnp.broadcast_to(pk[None].astype(cache_dtype),
+                                        (p,) + pk.shape)
+                pv_b = jnp.broadcast_to(pv[None].astype(cache_dtype),
+                                        (p,) + pv.shape)
+                pcache.append((jnp.concatenate([pk_b, pad], axis=2),
+                               jnp.concatenate([pv_b, pad], axis=2)))
+            logits, pc = model.apply(params, tokens, positions, pcache)
+            last = jnp.take_along_axis(
+                logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
+            greedy = jnp.argmax(last, axis=-1)
+            sampled = jax.random.categorical(
+                rng, last / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
+            first = jnp.where(temps > 0, sampled,
+                              greedy).astype(jnp.int32)
+            new_cache = []
+            for (k, v), (pk2, pv2) in zip(cache, pc):
+
+                def write(i, kv, pk2=pk2, pv2=pv2):
+                    kk, vv = kv
+                    sk = jax.lax.dynamic_slice_in_dim(pk2, i, 1, 0)
+                    sv = jax.lax.dynamic_slice_in_dim(pv2, i, 1, 0)
+                    at = (slots[i], 0, 0, 0)
+                    return (jax.lax.dynamic_update_slice(
+                                kk, sk.astype(kk.dtype), at),
+                            jax.lax.dynamic_update_slice(
+                                vv, sv.astype(vv.dtype), at))
+
+                kk, vv = jax.lax.fori_loop(0, p, write, (k, v))
+                new_cache.append((kk, vv))
+            return first, new_cache
+
         self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,))
         self._decode = jax.jit(decode, donate_argnums=(1,))
         self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
+        self._prefill_capture = jax.jit(prefill_capture)
+        self._prefix_prefill = jax.jit(prefix_prefill, static_argnums=(2,),
+                                       donate_argnums=(5,))
 
     # ---------------------------------------------------------- schedule
 
@@ -491,6 +574,130 @@ class InferenceEngine:
                 f'({self.cfg.max_cache_len})')
         return n, bucket, max_new
 
+    # ------------------------------------------------------- prefix cache
+
+    def register_prefix(self, tokens: Sequence[int]) -> int:
+        """Compute and keep a prefix's KV rows on device; later prompts
+        starting with these tokens prefill only their suffix.  Returns
+        the prefix length.  LRU-evicts past cfg.max_prefixes."""
+        if not self.cfg.max_prefixes:
+            raise ValueError('prefix caching disabled (max_prefixes=0)')
+        n = len(tokens)
+        if n < 1:
+            raise ValueError('empty prefix')
+        bucket = self._bucket(n)   # raises when no bucket can hold it
+        arr = np.zeros((1, bucket), np.int32)
+        arr[0, :n] = tokens
+        pcache = init_cache(self.model_config, 1, bucket,
+                            self.cfg.cache_dtype)
+        with self._lock:
+            with self._ctx():
+                pc = self._prefill_capture(self.params, jnp.asarray(arr),
+                                           pcache)
+            kv = [(k[0, :, :n], v[0, :, :n]) for k, v in pc]
+            if self._mesh is not None:
+                # Rows shard like the cache: kv heads over 'tensor'.
+                from skypilot_tpu.parallel import mesh as mesh_lib
+                sh = mesh_lib.named_sharding(self._mesh, 'kv_heads', None,
+                                             None)
+                kv = [(jax.device_put(k, sh), jax.device_put(v, sh))
+                      for k, v in kv]
+            key = tuple(int(t) for t in tokens)
+            self._prefixes[key] = kv
+            self._prefixes.move_to_end(key)
+            while len(self._prefixes) > self.cfg.max_prefixes:
+                self._prefixes.popitem(last=False)
+        return n
+
+    def _match_prefix(self, tokens: Sequence[int]):
+        """Longest registered prefix FULLY matching the prompt's head.
+        Returns (start, key): start = len(prefix) reused rows, or
+        len(prefix)-1 when the prompt IS the prefix (one token must
+        forward to produce logits).  Prompts lying strictly inside a
+        prefix fall back to full prefill: their start would equal the
+        client-chosen prompt length, an unbounded jit-key space (the
+        static `start` compiles per value)."""
+        n = len(tokens)
+        best = None
+        for key in self._prefixes:
+            lp = len(key)
+            if n > lp:
+                if tuple(tokens[:lp]) != key:
+                    continue
+                start = lp
+            elif n == lp:
+                start = lp - 1
+                if start < 1 or tuple(tokens[:start]) != key[:start]:
+                    continue
+            else:
+                continue
+            if best is None or start > best[0]:
+                best = (start, key)
+        if best is None:
+            return None
+        start, key = best
+        self._prefixes.move_to_end(key)          # LRU touch
+        return start, key
+
+    def _suffix_bucket(self, start: int, suffix_len: int) -> Optional[int]:
+        for b in self.cfg.prefill_buckets:
+            if b >= suffix_len and start + b <= self.cfg.max_cache_len:
+                return b
+        return None
+
+    def _start_prefixed_group(self, group, start: int, sb: int,
+                              key) -> None:
+        """Prefill prefix-matched requests sharing (prefix, start,
+        suffix bucket) in lane-batched dispatches — same chunking and
+        pad-lane-duplication rules as the normal prefill path."""
+        kv = self._prefixes[key]
+        if start < len(key):
+            # prompt == prefix: all rows but the last (row start..n-1
+            # would shadow the one forwarded token).
+            kv = [(k[:, :start], v[:, :start]) for k, v in kv]
+        lanes = self.cfg.prefill_lanes
+        for ofs in range(0, len(group), lanes):
+            chunk = group[ofs:ofs + lanes]
+            p = len(chunk)
+            width = lanes
+            tokens = np.zeros((width, sb), np.int32)
+            true_lens = np.ones((width,), np.int32)
+            slots = np.zeros((width,), np.int32)
+            temps = np.zeros((width,), np.float32)
+            for i in range(width):
+                req, slot, _, n, _, _ = chunk[min(i, p - 1)]
+                ns = n - start
+                tokens[i, :ns] = req.tokens[start:]
+                true_lens[i] = ns
+                slots[i] = slot
+                temps[i] = req.temperature
+            # Same pad-lane invariant as _start_batch: duplicated lanes
+            # rewrite the SAME slot with byte-identical rows.
+            assert all(slots[i] == slots[p - 1]
+                       for i in range(p, width)), (
+                f'pad lanes must duplicate the last real lane: '
+                f'{slots=} p={p}')
+            self._rng, rkey = jax.random.split(self._rng)
+            with self._ctx():
+                first, self.cache = self._prefix_prefill(
+                    self.params, jnp.asarray(tokens), start,
+                    jnp.asarray(true_lens), kv, self.cache,
+                    jnp.asarray(slots), jnp.asarray(temps), rkey)
+            first_np = np.asarray(first)
+            now = time.time()
+            for i, (req, slot, submit_time, n, _, max_new) in \
+                    enumerate(chunk):
+                s = _Slot(req, length=n, submit_time=submit_time,
+                          max_new=max_new)
+                s.first_token_time = now
+                s.generated.append(int(first_np[i]))
+                self._slots[slot] = s
+                self._lengths[slot] = n
+                self._last_tokens[slot] = s.generated[0]
+                self._temps[slot] = req.temperature
+            self.prefix_stats['hits'] += p
+            self.prefix_stats['tokens_reused'] += start * p
+
     def _start_batch(self, items) -> None:
         """Prefill validated requests in batched dispatches.
 
@@ -506,6 +713,23 @@ class InferenceEngine:
         duplicate the last real row — rewriting the same slot with the
         same KV rows is idempotent, so no validity masking is needed.
         """
+        if self._prefixes:
+            groups: Dict[Any, list] = {}
+            rest = []
+            for it in items:
+                m = self._match_prefix(it[0].tokens)
+                if m is None:
+                    rest.append(it)
+                    continue
+                start, key = m
+                sb = self._suffix_bucket(start, len(it[0].tokens) - start)
+                if sb is None:       # no bucket fits beside the prefix
+                    rest.append(it)
+                    continue
+                groups.setdefault((key, start, sb), []).append(it)
+            for (key, start, sb), group in groups.items():
+                self._start_prefixed_group(group, start, sb, key)
+            items = rest
         lanes = self.cfg.prefill_lanes
         by_bucket: Dict[int, list] = {}
         for it in items:

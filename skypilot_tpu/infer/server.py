@@ -9,6 +9,9 @@ Endpoints:
                       "temperature": T}
                   <- {"output_tokens": [...], "ttft_s": ..., ...}
   POST /generate_text (when --tokenizer is given: HF tokenizer name)
+  POST /cache_prefix -> {"tokens": [...]} (or {"prompt": "..."} with a
+                     tokenizer): pin a system prompt's KV on device so
+                     matching prompts prefill suffix-only (lower TTFT).
 
 stdlib-only (ThreadingHTTPServer): requests block their handler thread on
 a per-request event while the single engine thread runs continuous
@@ -367,6 +370,27 @@ def _make_handler(server: InferenceServer):
             except (ValueError, json.JSONDecodeError) as e:
                 self._json(400, {'error': str(e)})
                 return
+            if self.path == '/cache_prefix':
+                # Register a prefix (system prompt): its KV rows stay
+                # on device and matching prompts prefill suffix-only.
+                tokens = payload.get('tokens')
+                if tokens is None and server.tokenizer is not None:
+                    prompt = payload.get('prompt')
+                    if prompt:
+                        tokens = server.tokenizer.encode(prompt)
+                if not isinstance(tokens, list) or not tokens:
+                    self._json(400, {'error': '"tokens" list (or '
+                                     '"prompt" with a tokenizer) '
+                                     'required'})
+                    return
+                try:
+                    n = server.engine.register_prefix(
+                        [int(t) for t in tokens])
+                except (TypeError, ValueError) as e:
+                    self._json(400, {'error': str(e)})
+                    return
+                self._json(200, {'cached_prefix_len': n})
+                return
             if self.path == '/generate':
                 tokens = payload.get('tokens')
                 if not isinstance(tokens, list) or not tokens:
@@ -473,7 +497,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         max_ttft: Optional[float] = None,
         max_queue: Optional[int] = None,
         draft_len: int = 0,
-        ngram_max: int = 4) -> None:
+        ngram_max: int = 4,
+        max_prefixes: int = 16) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -585,7 +610,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       decode_steps=decode_steps,
                       prefills_per_gap=prefills_per_gap,
                       cache_dtype=resolve_cache_dtype(cache_dtype),
-                      draft_len=draft_len, ngram_max=ngram_max)
+                      draft_len=draft_len, ngram_max=ngram_max,
+                      max_prefixes=max_prefixes)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -621,6 +647,9 @@ def main() -> None:
                              'tokens per dispatch (0 disables)')
     parser.add_argument('--ngram-max', type=int, default=4,
                         help='longest n-gram tried when drafting')
+    parser.add_argument('--max-prefixes', type=int, default=16,
+                        help='resident prefix-KV entries for '
+                             '/cache_prefix (LRU; 0 disables)')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
@@ -628,7 +657,8 @@ def main() -> None:
         decode_steps=args.decode_steps, hf_model=args.hf_model,
         cache_dtype=args.cache_dtype,
         tensor_parallel=args.tensor_parallel,
-        draft_len=args.draft_len, ngram_max=args.ngram_max)
+        draft_len=args.draft_len, ngram_max=args.ngram_max,
+        max_prefixes=args.max_prefixes)
 
 
 if __name__ == '__main__':

@@ -931,3 +931,182 @@ def test_spec_decode_near_cache_end_falls_back(tiny_config):
     # prompt still let earlier verify dispatches fire.
     assert len(r_s.output_tokens) == 8
     assert spec.spec_stats['dispatches'] >= 1
+
+
+# ------------------------------------------------------------ prefix cache
+
+
+def _prefix_pair(tiny_config, **over):
+    base = dict(model='infer-test', num_slots=4, max_cache_len=64,
+                prefill_buckets=(8, 16, 32), max_new_tokens=16,
+                cache_dtype=jnp.float32)
+    base.update(over)
+    plain = InferenceEngine(tiny_config, InferConfig(**base),
+                            rng=jax.random.PRNGKey(7))
+    cached = InferenceEngine(tiny_config, InferConfig(**base),
+                             rng=jax.random.PRNGKey(7))
+    return plain, cached
+
+
+def test_prefix_cache_exact_vs_full_prefill(tiny_config):
+    """A prompt starting with a registered prefix generates EXACTLY the
+    same tokens as a full prefill (suffix-only forward attends over the
+    same rows a one-shot prefill would have written)."""
+    plain, cached = _prefix_pair(tiny_config)
+    prefix = [7, 3, 9, 9, 2, 5, 1, 4, 4, 8]
+    assert cached.register_prefix(prefix) == len(prefix)
+    for suffix in ([11, 12], [42], list(range(20, 39))):
+        prompt = prefix + suffix
+        r_p = plain.generate([Request(tokens=list(prompt),
+                                      max_new_tokens=8)])[0]
+        r_c = cached.generate([Request(tokens=list(prompt),
+                                       max_new_tokens=8)])[0]
+        assert r_c.output_tokens == r_p.output_tokens, suffix
+    assert cached.prefix_stats['hits'] == 3
+    assert cached.prefix_stats['tokens_reused'] == 3 * len(prefix)
+
+
+def test_prefix_cache_prompt_equals_prefix(tiny_config):
+    """Prompt == prefix reuses all rows but the last (one token must
+    forward to produce logits).  A prompt strictly INSIDE the prefix
+    falls back to full prefill (its jit key would be the client-chosen
+    prompt length — unbounded) but must stay exact and must not crash
+    even when the stored prefix is longer than start+suffix_bucket
+    (the r3 review crash: full-length kv written into a shorter
+    base)."""
+    plain, cached = _prefix_pair(tiny_config)
+    prefix = [5, 6, 7, 8, 9, 10, 11, 12]
+    cached.register_prefix(prefix)
+    r_p = plain.generate([Request(tokens=list(prefix),
+                                  max_new_tokens=6)])[0]
+    r_c = cached.generate([Request(tokens=list(prefix),
+                                   max_new_tokens=6)])[0]
+    assert r_c.output_tokens == r_p.output_tokens
+    assert cached.prefix_stats['hits'] == 1
+    # Inside-prefix prompt: exact via fallback, no new hit.
+    plain32, cached32 = _prefix_pair(tiny_config)
+    cached32.register_prefix(list(range(1, 33)))   # fills bucket 32
+    short = list(range(1, 6))                      # prefix[:5]
+    r_p = plain32.generate([Request(tokens=list(short),
+                                    max_new_tokens=6)])[0]
+    r_c = cached32.generate([Request(tokens=list(short),
+                                     max_new_tokens=6)])[0]
+    assert r_c.output_tokens == r_p.output_tokens
+    assert cached32.prefix_stats['hits'] == 0
+
+
+def test_prefix_cache_nonmatching_prompt_unaffected(tiny_config):
+    plain, cached = _prefix_pair(tiny_config)
+    cached.register_prefix([1, 2, 3, 4, 5, 6])
+    prompt = [9, 9, 9, 1, 2]                      # diverges at token 0
+    r_p = plain.generate([Request(tokens=list(prompt),
+                                  max_new_tokens=6)])[0]
+    r_c = cached.generate([Request(tokens=list(prompt),
+                                   max_new_tokens=6)])[0]
+    assert r_c.output_tokens == r_p.output_tokens
+    assert cached.prefix_stats['hits'] == 0
+
+
+def test_prefix_cache_lru_eviction(tiny_config):
+    _, cached = _prefix_pair(tiny_config, max_prefixes=2)
+    cached.register_prefix([1, 2, 3])
+    cached.register_prefix([4, 5, 6])
+    cached.register_prefix([7, 8, 9])             # evicts [1,2,3]
+    assert len(cached._prefixes) == 2
+    assert (1, 2, 3) not in cached._prefixes
+    # Disabled engine refuses registration.
+    _, off = _prefix_pair(tiny_config, max_prefixes=0)
+    with pytest.raises(ValueError):
+        off.register_prefix([1, 2])
+
+
+def test_prefix_cache_longest_match_wins(tiny_config):
+    plain, cached = _prefix_pair(tiny_config)
+    cached.register_prefix([1, 2, 3, 4])
+    cached.register_prefix([1, 2, 3, 4, 5, 6, 7, 8])
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 20, 21]
+    r_p = plain.generate([Request(tokens=list(prompt),
+                                  max_new_tokens=6)])[0]
+    r_c = cached.generate([Request(tokens=list(prompt),
+                                   max_new_tokens=6)])[0]
+    assert r_c.output_tokens == r_p.output_tokens
+    assert cached.prefix_stats['tokens_reused'] == 8
+
+
+def test_prefix_cache_composes_with_spec_decode(tiny_config):
+    """Prefix reuse + speculative decode together still match plain
+    greedy exactly (the two features touch prefill and decode
+    respectively)."""
+    plain, _ = _prefix_pair(tiny_config)
+    cfg = InferConfig(model='infer-test', num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=16,
+                      cache_dtype=jnp.float32, draft_len=3)
+    both = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(7))
+    prefix = [5, 6, 7, 8, 5, 6, 7, 8]
+    both.register_prefix(prefix)
+    prompt = prefix + [5, 6]
+    r_p = plain.generate([Request(tokens=list(prompt),
+                                  max_new_tokens=12)])[0]
+    r_b = both.generate([Request(tokens=list(prompt),
+                                 max_new_tokens=12)])[0]
+    assert r_b.output_tokens == r_p.output_tokens
+    assert both.prefix_stats['hits'] == 1
+
+
+def test_prefix_cache_http_endpoint(tiny_config):
+    """POST /cache_prefix registers through the live server; matched
+    generation is exact."""
+    import time as _time
+    from skypilot_tpu.infer import server as srv_mod
+    plain, cached = _prefix_pair(tiny_config)
+    prefix = [3, 1, 4, 1, 5, 9]
+    prompt = prefix + [2, 6]
+    expected = plain.generate([Request(tokens=list(prompt),
+                                       max_new_tokens=8)])[0].output_tokens
+    t = threading.Thread(target=srv_mod.serve, args=(cached,),
+                         kwargs={'host': '127.0.0.1', 'port': 8197},
+                         daemon=True)
+    t.start()
+    deadline = _time.time() + 120
+    while _time.time() < deadline:
+        try:
+            r = urllib.request.urlopen(
+                'http://127.0.0.1:8197/health', timeout=5)
+            if r.status == 200:
+                break
+        except Exception:
+            _time.sleep(0.2)
+    body = json.dumps({'tokens': prefix}).encode()
+    req = urllib.request.Request(
+        'http://127.0.0.1:8197/cache_prefix', data=body,
+        headers={'Content-Type': 'application/json'})
+    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out['cached_prefix_len'] == len(prefix)
+    body = json.dumps({'tokens': prompt, 'max_new_tokens': 8}).encode()
+    req = urllib.request.Request(
+        'http://127.0.0.1:8197/generate', data=body,
+        headers={'Content-Type': 'application/json'})
+    out = json.loads(urllib.request.urlopen(req, timeout=120).read())
+    assert out['output_tokens'] == expected
+    assert cached.prefix_stats['hits'] == 1
+
+
+def test_prefix_cache_lane_batched_burst(tiny_config):
+    """A burst of shared-prefix requests prefills in lane-batched
+    groups (not one dispatch per request) and every result is exact."""
+    plain, cached = _prefix_pair(tiny_config)
+    prefix = [7, 3, 9, 9, 2, 5]
+    cached.register_prefix(prefix)
+    reqs, expected = [], []
+    for i in range(6):                 # 6 > prefill_lanes (4)
+        prompt = prefix + [20 + i, 30 + i]
+        expected.append(plain.generate(
+            [Request(tokens=list(prompt), max_new_tokens=5)])[0]
+            .output_tokens)
+        reqs.append(Request(tokens=list(prompt), max_new_tokens=5,
+                            request_id=str(i)))
+    results = cached.generate(reqs)
+    for i, r in enumerate(results):
+        assert r.output_tokens == expected[i], i
+    assert cached.prefix_stats['hits'] == 6
+    assert cached.prefix_stats['tokens_reused'] == 6 * len(prefix)
