@@ -590,19 +590,24 @@ class InferenceEngine:
         arr[0, :n] = tokens
         pcache = init_cache(self.model_config, 1, bucket,
                             self.cfg.cache_dtype)
+        # The capture forward (and its first-call trace/compile, which
+        # can take tens of seconds on a real model) reads only
+        # self.params — run it OUTSIDE the engine lock so in-flight
+        # decode keeps producing tokens; only the registry insert needs
+        # mutual exclusion.
+        with self._ctx():
+            pc = self._prefill_capture(self.params, jnp.asarray(arr),
+                                       pcache)
+        kv = [(k[0, :, :n], v[0, :, :n]) for k, v in pc]
+        if self._mesh is not None:
+            # Rows shard like the cache: kv heads over 'tensor'.
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            sh = mesh_lib.named_sharding(self._mesh, 'kv_heads', None,
+                                         None)
+            kv = [(jax.device_put(k, sh), jax.device_put(v, sh))
+                  for k, v in kv]
+        key = tuple(int(t) for t in tokens)
         with self._lock:
-            with self._ctx():
-                pc = self._prefill_capture(self.params, jnp.asarray(arr),
-                                           pcache)
-            kv = [(k[0, :, :n], v[0, :, :n]) for k, v in pc]
-            if self._mesh is not None:
-                # Rows shard like the cache: kv heads over 'tensor'.
-                from skypilot_tpu.parallel import mesh as mesh_lib
-                sh = mesh_lib.named_sharding(self._mesh, 'kv_heads', None,
-                                             None)
-                kv = [(jax.device_put(k, sh), jax.device_put(v, sh))
-                      for k, v in kv]
-            key = tuple(int(t) for t in tokens)
             self._prefixes[key] = kv
             self._prefixes.move_to_end(key)
             while len(self._prefixes) > self.cfg.max_prefixes:

@@ -549,11 +549,12 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         # before the (potentially tens-of-GB) weight load.
         mt = getattr(transformers.AutoConfig.from_pretrained(hf_model),
                      'model_type', None)
-        if mt not in ('llama', 'qwen2', 'mixtral', 'gpt2', 'gemma'):
+        if mt not in ('llama', 'qwen2', 'mistral', 'mixtral', 'gpt2',
+                      'gemma'):
             raise ValueError(
                 f'--hf-model must be a supported causal-LM checkpoint '
-                f"(model_type 'llama', 'qwen2', 'mixtral', 'gpt2' or "
-                f"'gemma'); got model_type={mt!r}")
+                f"(model_type 'llama', 'qwen2', 'mistral', 'mixtral', "
+                f"'gpt2' or 'gemma'); got model_type={mt!r}")
         # Serving: bf16 weights end to end (half the host RAM and HBM,
         # MXU-native).
         model_config, tree = hf_import.load_hf_model(

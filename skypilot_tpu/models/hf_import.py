@@ -392,15 +392,20 @@ def config_from_hf(hf_config, name: Optional[str] = None):
     """Map a transformers config object to the matching framework config."""
     mt = getattr(hf_config, 'model_type', None)
     name = name or f'hf-{mt}'
-    if mt in ('llama', 'qwen2'):
+    if mt in ('llama', 'qwen2', 'mistral'):
         # Qwen2 is llama-architecture + unconditional q/k/v biases (no
-        # config flag); it shares this whole mapping, including the
+        # config flag); Mistral is llama-architecture + sliding-window
+        # attention.  Both share this whole mapping, including the
         # refuse-to-load guard on unsupported rope_scaling types.
         if mt == 'qwen2' and getattr(hf_config, 'use_sliding_window',
                                      False):
+            # Qwen2's flag windows only SOME layers (per
+            # max_window_layers) — a uniform band would be wrong.
             raise ValueError(
-                'use_sliding_window=true is not implemented (full '
-                'attention only); refusing to load with wrong masking')
+                'qwen2 use_sliding_window=true is layer-selective and '
+                'not implemented; refusing to load with wrong masking')
+        sliding = (getattr(hf_config, 'sliding_window', None)
+                   if mt == 'mistral' else None)
         scaling_kw = {}
         rs = getattr(hf_config, 'rope_scaling', None)
         rope_type = rs.get('rope_type', rs.get('type')) if rs else None
@@ -432,6 +437,7 @@ def config_from_hf(hf_config, name: Optional[str] = None):
             attention_bias=(mt == 'qwen2' or
                             getattr(hf_config, 'attention_bias', False)),
             tie_embeddings=getattr(hf_config, 'tie_word_embeddings', False),
+            sliding_window=sliding,
             **scaling_kw)
     if mt == 'gemma':
         # Gemma = llama topology + GeGLU, sqrt(H)-scaled embeddings,

@@ -47,6 +47,12 @@ class LlamaConfig:
     # Biases on the q/k/v projections (Qwen2-style; LLaMA proper has
     # none anywhere).
     attention_bias: bool = False
+    # Sliding-window (banded causal) attention, Mistral-style: query i
+    # attends keys j with 0 <= i-j < sliding_window.  None = full
+    # causal.  Training runs the banded flash kernel (KV blocks outside
+    # the band are skipped: O(S*W) FLOPs); decode masks the slot cache
+    # to the trailing window.  Not compatible with the 'seq' mesh axis.
+    sliding_window: Optional[int] = None
     # MLP gate activation: 'silu' (LLaMA/Qwen2) or 'gelu_tanh'
     # (Gemma's GeGLU — tanh-approximated GELU).
     hidden_act: str = 'silu'
@@ -168,7 +174,7 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
     return rotated.astype(x.dtype)
 
 
-def write_kv_and_attend(kv_cache, k, v, q, positions):
+def write_kv_and_attend(kv_cache, k, v, q, positions, window=None):
     """Shared incremental-decode cache step: write the new K/V rows at
     their absolute positions, attend over the whole cache.  Used by the
     Llama and GPT-2 attention modules so the cache-write contract has
@@ -182,19 +188,21 @@ def write_kv_and_attend(kv_cache, k, v, q, positions):
 
     k_cache = jax.vmap(upd)(k_cache, k, start)
     v_cache = jax.vmap(upd)(v_cache, v, start)
-    out = decode_attention(q, k_cache, v_cache, positions)
+    out = decode_attention(q, k_cache, v_cache, positions, window=window)
     return out, (k_cache, v_cache)
 
 
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                     q_positions: jax.Array) -> jax.Array:
+                     q_positions: jax.Array,
+                     window: Optional[int] = None) -> jax.Array:
     """Attention of T new queries over a [B, Hkv, M, D] KV cache.
 
     q: [B, Hq, T, D]; q_positions: [B, T] absolute positions (== cache
     indices) of the new tokens.  Cache entry j is visible to query i iff
     j <= position_i (causal over the slot's history; entries past the
     slot's filled length are masked by the same rule since positions are
-    always <= length).  O(T·M) scores — the decode path (T=1) is
+    always <= length), and additionally position_i - j < window for
+    sliding-window models.  O(T·M) scores — the decode path (T=1) is
     HBM-bandwidth-bound streaming the cache, which XLA handles well.
     """
     b, hq, t, d = q.shape
@@ -206,6 +214,8 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                         k_cache.astype(jnp.float32))
     cache_idx = jnp.arange(m)
     mask = cache_idx[None, None, :] <= q_positions[:, :, None]  # [B, T, M]
+    if window is not None:
+        mask &= (q_positions[:, :, None] - cache_idx[None, None]) < window
     scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('bhgtm,bhmd->bhgtd', probs,
@@ -335,7 +345,8 @@ class Attention(nn.Module):
             # Incremental decode/prefill: write the (roped) new K/V rows
             # into the cache, then attend over the whole cache.
             out, new_cache = write_kv_and_attend(kv_cache, k, v, q,
-                                                 positions)
+                                                 positions,
+                                                 window=cfg.sliding_window)
         else:
             q = nn.with_logical_constraint(
                 q, ('activation_batch', 'activation_heads', 'activation_seq',
@@ -349,7 +360,8 @@ class Attention(nn.Module):
             # Transparently sequence-parallel: when the active mesh has a
             # 'seq' axis >1 this becomes ring attention over ICI neighbors
             # (ops/ring_attention.py); otherwise plain (pallas) flash.
-            out = sequence_parallel_attention(q, k, v, causal=True)
+            out = sequence_parallel_attention(q, k, v, causal=True,
+                                              window=cfg.sliding_window)
         out = jnp.transpose(out, (0, 2, 1, 3))  # [B, S, H, D]
         # Depth-scaled init on the residual-branch output (GPT-2 style):
         # std 0.02/sqrt(2L) keeps residual variance bounded with depth.

@@ -53,6 +53,13 @@ _register(
                 intermediate_size=18944, num_layers=28, num_heads=28,
                 num_kv_heads=4, max_seq_len=32768, rope_theta=1e6,
                 norm_eps=1e-6, attention_bias=True))
+# Mistral-7B v0.1: llama architecture + sliding-window attention
+# (W=4096) and a 32k position budget (reference serves it via vLLM).
+_register(
+    LlamaConfig(name='mistral-7b', vocab_size=32000, hidden_size=4096,
+                intermediate_size=14336, num_layers=32, num_heads=32,
+                num_kv_heads=8, max_seq_len=32768, rope_theta=10000.0,
+                sliding_window=4096))
 # ~1.1B config (TinyLlama-class): the graft-entry flagship forward model.
 _register(
     LlamaConfig(name='llama-1b', vocab_size=32000, hidden_size=2048,

@@ -44,17 +44,25 @@ def _kv_head_index(hq: int, hkv: int):
 
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = True,
-                        scale: Optional[float] = None) -> jax.Array:
+                        scale: Optional[float] = None,
+                        window: Optional[int] = None) -> jax.Array:
     """Ground-truth O(S^2) attention.  q: [B, Hq, S, D]; k/v: [B, Hkv, S, D]
-    with Hq a multiple of Hkv (GQA)."""
+    with Hq a multiple of Hkv (GQA).  window: sliding-window (banded
+    causal) attention — query i sees keys j with 0 <= i-j < window
+    (Mistral-style SWA); requires causal."""
     b, hq, s, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
     scale = scale if scale is not None else d ** -0.5
     qr = q.reshape(b, hkv, group, s, d)
     scores = jnp.einsum('bhgqd,bhkd->bhgqk', qr * scale, k)
+    if window is not None and not causal:
+        raise ValueError('window requires causal attention')
     if causal:
         mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        if window is not None:
+            idx = jnp.arange(s)
+            mask &= (idx[:, None] - idx[None, :]) < window
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum('bhgqk,bhkd->bhgqd', probs.astype(v.dtype), v)
@@ -65,7 +73,8 @@ def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
-                causal: bool, block_kv: int, seq_len: int):
+                causal: bool, block_kv: int, seq_len: int,
+                window: Optional[int]):
     """One (batch*head, q_block) program: stream KV blocks, online softmax."""
     q_idx = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale            # [Bq, D]
@@ -82,7 +91,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = kv_idx * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+            keep = q_pos >= kv_pos
+            if window is not None:
+                keep &= (q_pos - kv_pos) < window
+            s = jnp.where(keep, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -97,10 +109,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         num_kv_needed = jax.lax.div(q_offset + block_q - 1, block_kv) + 1
     else:
         num_kv_needed = num_kv
+    if window is not None:
+        # Banded: blocks entirely below the window contribute nothing.
+        kv_first = jax.lax.max(0, jax.lax.div(
+            q_offset - window + 1, block_kv))
+    else:
+        kv_first = 0
     acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kv_needed, body, (acc, m0, l0))
+    acc, m, l = jax.lax.fori_loop(kv_first, num_kv_needed, body,
+                                  (acc, m0, l0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     lse = (m + jnp.log(l)).astype(jnp.float32)
     lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
@@ -124,7 +143,7 @@ def _out_struct(shape, dtype, *likes):
     return jax.ShapeDtypeStruct(shape, dtype, vma=union)
 
 
-def _flash_fwd(q, k, v, *, causal, scale, block_q, block_kv):
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_kv, window):
     b, hq, s, d = q.shape
     hkv = k.shape[1]
     block_q = min(block_q, s)
@@ -140,7 +159,7 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_kv):
     grid = (b * hq, s // block_q)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_kv=block_kv, seq_len=s),
+                          block_kv=block_kv, seq_len=s, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
@@ -164,7 +183,7 @@ def _flash_fwd(q, k, v, *, causal, scale, block_q, block_kv):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_kv, seq_len):
+                   *, scale, causal, block_kv, seq_len, window):
     q_idx = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -182,7 +201,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = kv_idx * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+            keep = q_pos >= kv_pos
+            if window is not None:
+                keep &= (q_pos - kv_pos) < window
+            s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = do @ v.T
         ds = p * (dp - delta[:, None]) * scale
@@ -192,13 +214,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         num_kv = jax.lax.div(q_offset + block_q - 1, block_kv) + 1
     else:
         num_kv = seq_len // block_kv
-    dq = jax.lax.fori_loop(0, num_kv,
+    if window is not None:
+        kv_first = jax.lax.max(0, jax.lax.div(
+            q_offset - window + 1, block_kv))
+    else:
+        kv_first = 0
+    dq = jax.lax.fori_loop(kv_first, num_kv,
                            body, jnp.zeros_like(q))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                    dv_ref, *, scale, causal, block_q):
+                    dv_ref, *, scale, causal, block_q, window):
     """One (batch*head, kv_block, q_block) program.
 
     The q axis is a GRID dimension, not a fori_loop over a full-sequence
@@ -231,7 +258,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 jnp.int32, (block_q, block_kv), 0)
             kv_pos = kv_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+            keep = q_pos >= kv_pos
+            if window is not None:
+                keep &= (q_pos - kv_pos) < window
+            s = jnp.where(keep, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dv_ref[0] += p.T @ do
         dp = do @ v.T
@@ -239,13 +269,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dk_ref[0] += ds.T @ q
 
     if causal:
-        # q blocks strictly before this kv block contribute nothing.
-        pl.when(q_offset + block_q - 1 >= kv_offset)(_accumulate)
+        # q blocks strictly before this kv block contribute nothing;
+        # with a window, q blocks entirely past the band neither.
+        overlap = q_offset + block_q - 1 >= kv_offset
+        if window is not None:
+            overlap &= (q_offset - (kv_offset + block_kv - 1)) < window
+        pl.when(overlap)(_accumulate)
     else:
         _accumulate()
 
 
-def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv):
+def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv,
+               window):
     b, hq, s, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
@@ -266,7 +301,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv):
     delta = jnp.broadcast_to(delta2d[:, :, None], (b * hq, s, _LANES))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_kv=block_kv, seq_len=s),
+                          block_kv=block_kv, seq_len=s, window=window),
         grid=(b * hq, s // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda h, i: (h, i, 0)),
@@ -283,7 +318,7 @@ def _flash_bwd(q, k, v, out, lse, do, *, causal, scale, block_q, block_kv):
     )(qf, kf, vf, dof, lsef, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, window=window),
         # q blocks are the INNER grid axis: dk/dv blocks stay resident
         # and accumulate across it (no full-seq VMEM refs — see kernel).
         grid=(b * hq, s // block_kv, s // block_q),
@@ -332,23 +367,25 @@ def _interpret() -> bool:
     return not _on_tpu()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_kv):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_kv, window):
     out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale,
-                        block_q=block_q, block_kv=block_kv)
+                        block_q=block_q, block_kv=block_kv, window=window)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv):
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_kv, window):
     out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
-                          block_q=block_q, block_kv=block_kv)
+                          block_q=block_q, block_kv=block_kv,
+                          window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_kv, res, do):
+def _flash_bwd_rule(causal, scale, block_q, block_kv, window, res, do):
     q, k, v, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, causal=causal,
-                            scale=scale, block_q=block_q, block_kv=block_kv)
+                            scale=scale, block_q=block_q,
+                            block_kv=block_kv, window=window)
     return dq, dk, dv
 
 
@@ -362,17 +399,30 @@ def flash_attention(q: jax.Array,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_kv: int = DEFAULT_BLOCK_KV,
-                    use_pallas: Optional[bool] = None) -> jax.Array:
+                    use_pallas: Optional[bool] = None,
+                    window: Optional[int] = None) -> jax.Array:
     """Multi-head attention, flash-style.
 
     Args:
       q: [batch, num_q_heads, seq, head_dim]
       k, v: [batch, num_kv_heads, seq, head_dim] (GQA when fewer kv heads)
+      window: sliding-window (banded causal) attention — query i attends
+        keys j with 0 <= i-j < window (Mistral-style SWA).  Requires
+        causal=True.  KV blocks outside the band are skipped, so long-
+        sequence FLOPs scale O(S*window) instead of O(S^2/2).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None:
+        if not causal:
+            raise ValueError('window requires causal attention')
+        if window < 1:
+            raise ValueError(f'window must be >= 1 (got {window})')
+        if window >= q.shape[2]:
+            window = None   # band covers everything: plain causal
     if use_pallas is None:
         use_pallas = _on_tpu()
     if not use_pallas:
-        return reference_attention(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, causal, scale, block_q, block_kv)
+        return reference_attention(q, k, v, causal=causal, scale=scale,
+                                   window=window)
+    return _flash(q, k, v, causal, scale, block_q, block_kv, window)

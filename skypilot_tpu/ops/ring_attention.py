@@ -179,7 +179,8 @@ def sequence_parallel_attention(q: jax.Array,
                                 causal: bool = True,
                                 scale: Optional[float] = None,
                                 mode: str = 'ring',
-                                mesh: Optional[jax.sharding.Mesh] = None
+                                mesh: Optional[jax.sharding.Mesh] = None,
+                                window: Optional[int] = None
                                 ) -> jax.Array:
     """Attention with the seq dim sharded over the mesh's 'seq' axis.
 
@@ -187,9 +188,15 @@ def sequence_parallel_attention(q: jax.Array,
     mesh.  Inputs are GLOBAL [B, H, S, D] arrays (GSPMD keeps them sharded;
     shard_map hands each device its block).  Falls back to plain flash
     attention when the mesh has no seq parallelism.
+
+    window: sliding-window (banded causal) attention.  Supported on the
+    flash paths; ring/ulysses sequence parallelism raises — a banded
+    mask across ring steps needs per-hop block culling that is not
+    implemented (shard batch/tensor axes for windowed models instead).
     """
     if _inside_manual_region():
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               window=window)
     mesh = mesh if mesh is not None else _active_mesh()
     p = jax.sharding.PartitionSpec
     if mesh is not None and not _shapes_divide(q, k, mesh):
@@ -197,15 +204,21 @@ def sequence_parallel_attention(q: jax.Array,
         # block-sharded over this mesh; the math is identical either way.
         mesh = None
     degree = 1 if mesh is None else seq_parallel_degree(mesh)
+    if degree > 1 and window is not None:
+        raise NotImplementedError(
+            'sliding-window attention with sequence parallelism is not '
+            'supported; use data/fsdp/tensor axes for windowed models')
     if degree == 1:
         if mesh is None:
-            return flash_attention(q, k, v, causal=causal, scale=scale)
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   window=window)
         # No seq parallelism, but a mesh is active: run flash per-shard
         # under shard_map so the pallas kernel partitions over the
         # batch/tensor axes instead of relying on GSPMD rules for
         # pallas_call (seq stays replicated within each shard).
         spec = p(('data', 'fsdp'), 'tensor', None, None)
-        fn = functools.partial(flash_attention, causal=causal, scale=scale)
+        fn = functools.partial(flash_attention, causal=causal, scale=scale,
+                               window=window)
         return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec)(q, k, v)
     inner = ring_attention if mode == 'ring' else ulysses_attention

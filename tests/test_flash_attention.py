@@ -77,3 +77,60 @@ def test_uneven_blocks():
     out = flash_attention(q, k, v, block_q=128, block_kv=64, use_pallas=True)
     ref = reference_attention(q, k, v)
     np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+# ------------------------------------------------------- sliding window
+
+
+@pytest.mark.parametrize('window', [32, 96, 128])
+def test_forward_window_matches_reference(window):
+    """Banded (sliding-window) forward vs reference, incl. windows that
+    cross KV-block boundaries (96 with 64-blocks)."""
+    q, k, v = _rand(2, 4, 256, 64, jax.random.PRNGKey(4))
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_kv=64,
+                          use_pallas=True, window=window)
+    ref = reference_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+def test_forward_window_gqa():
+    q, k, v = _rand(2, 8, 128, 64, jax.random.PRNGKey(5), hkv=2)
+    out = flash_attention(q, k, v, block_q=64, block_kv=64,
+                          use_pallas=True, window=48)
+    ref = reference_attention(q, k, v, window=48)
+    np.testing.assert_allclose(out, ref, atol=1e-2, rtol=1e-2)
+
+
+def test_window_covering_sequence_equals_causal():
+    q, k, v = _rand(1, 2, 128, 32, jax.random.PRNGKey(6))
+    banded = flash_attention(q, k, v, block_q=64, block_kv=64,
+                             use_pallas=True, window=128)
+    plain = flash_attention(q, k, v, block_q=64, block_kv=64,
+                            use_pallas=True)
+    np.testing.assert_allclose(banded, plain, atol=1e-6)
+
+
+@pytest.mark.parametrize('window', [32, 80])
+def test_gradients_window_match_reference(window):
+    q, k, v = _rand(1, 2, 128, 32, jax.random.PRNGKey(7))
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, block_q=64,
+                                block_kv=64, use_pallas=True,
+                                window=window) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True,
+                                    window=window) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, 'qkv'):
+        np.testing.assert_allclose(gf, gr, atol=2e-2, rtol=2e-2,
+                                   err_msg=f'd{name} mismatch')
+
+
+def test_window_requires_causal():
+    q, k, v = _rand(1, 2, 64, 32, jax.random.PRNGKey(8))
+    with pytest.raises(ValueError, match='causal'):
+        flash_attention(q, k, v, causal=False, window=16)

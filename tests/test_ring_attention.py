@@ -114,3 +114,18 @@ def test_trainer_with_seq_parallel_mesh():
         state, metrics = step(state, next(data))
         loss = float(metrics['loss'])
     assert np.isfinite(loss)
+
+
+def test_sliding_window_rejected_under_seq_parallelism():
+    """A banded mask across ring hops is not implemented — the seam
+    must refuse loudly, not silently compute full attention."""
+    import pytest as _pytest
+
+    from skypilot_tpu.ops import sequence_parallel_attention
+    from skypilot_tpu.parallel import MeshSpec, make_mesh
+    mesh = make_mesh(MeshSpec(seq=2), devices=jax.devices()[:2])
+    q = jnp.zeros((2, 4, 64, 16), jnp.float32)
+    with _pytest.raises(NotImplementedError, match='sliding-window'):
+        with mesh:
+            jax.jit(lambda a: sequence_parallel_attention(
+                a, a, a, causal=True, window=8, mesh=mesh))(q)
