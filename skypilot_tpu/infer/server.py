@@ -12,6 +12,13 @@ Endpoints:
   POST /cache_prefix -> {"tokens": [...]} (or {"prompt": "..."} with a
                      tokenizer): pin a system prompt's KV on device so
                      matching prompts prefill suffix-only (lower TTFT).
+  OpenAI-compatible surface (drop-in for clients written against the
+  reference's vLLM recipes, llm/vllm/README.md curl examples):
+  POST /v1/completions        prompt (string or token array), max_tokens,
+                              temperature, stop, stream (SSE + [DONE])
+  POST /v1/chat/completions   messages via the tokenizer's chat template
+  GET  /v1/models             the served model id
+  GET  /stats                 slots/queue/shed/spec/prefix counters
 
 stdlib-only (ThreadingHTTPServer): requests block their handler thread on
 a per-request event while the single engine thread runs continuous
@@ -301,10 +308,9 @@ def _make_handler(server: InferenceServer):
                              'bound_s': e.bound_s},
                        extra_headers={'Retry-After': str(retry_after)})
 
-        def _stream(self, req: Request) -> None:
-            """Server-sent events: one `data:` line per token chunk, a
-            final `data:` with the full result, then connection close
-            (no Content-Length — SSE semantics)."""
+        def _sse_begin(self):
+            """200 + SSE headers; returns the `data:`-line emitter
+            (shared by the native and OpenAI streaming paths)."""
             self.send_response(200)
             self.send_header('Content-Type', 'text/event-stream')
             self.send_header('Cache-Control', 'no-cache')
@@ -314,6 +320,14 @@ def _make_handler(server: InferenceServer):
                 self.wfile.write(
                     b'data: ' + json.dumps(payload).encode() + b'\n\n')
                 self.wfile.flush()
+
+            return emit
+
+        def _stream(self, req: Request) -> None:
+            """Server-sent events: one `data:` line per token chunk, a
+            final `data:` with the full result, then connection close
+            (no Content-Length — SSE semantics)."""
+            emit = self._sse_begin()
 
             streamed: list = []
             prev_text = ''
@@ -360,8 +374,263 @@ def _make_handler(server: InferenceServer):
                     self._json(200, {'status': 'ok'})
                 else:
                     self._json(503, {'status': 'starting'})
+            elif self.path == '/v1/models':
+                name = server.engine.model_config.name
+                self._json(200, {'object': 'list', 'data': [{
+                    'id': name, 'object': 'model', 'created': 0,
+                    'owned_by': 'skypilot_tpu'}]})
+            elif self.path == '/stats':
+                eng = server.engine
+                self._json(200, {
+                    'slots_active': sum(s is not None
+                                        for s in eng._slots),
+                    'num_slots': eng.cfg.num_slots,
+                    'queue_depth': server._queue.qsize(),
+                    'awaiting_first_token': len(server._awaiting_first),
+                    'shed_count': server.shed_count,
+                    'spec': dict(eng.spec_stats),
+                    'prefix': dict(eng.prefix_stats),
+                    'resident_prefixes': len(eng._prefixes),
+                })
             else:
                 self._json(404, {'error': 'not found'})
+
+        # ----------------------------------------- OpenAI-compatible API
+
+        def _openai_request(self, payload, chat: bool):
+            """Parse a /v1/* body into (Request, echo_text) or answer
+            the error and return None."""
+            try:
+                max_new = payload.get('max_tokens', 16)
+                max_new = None if max_new is None else int(max_new)
+                temperature = float(payload.get('temperature', 0.0))
+                stop = payload.get('stop') or []
+                if isinstance(stop, str):
+                    stop = [stop]
+                stop = [str(s) for s in stop]
+            except (TypeError, ValueError) as e:
+                self._json(400, {'error': {'message': f'bad field: {e}',
+                                           'type': 'invalid_request_error'}})
+                return None
+            if chat:
+                messages = payload.get('messages')
+                if (not isinstance(messages, list) or not messages or
+                        not all(isinstance(m, dict) for m in messages)):
+                    self._json(400, {'error': {
+                        'message': '"messages" must be a non-empty list '
+                                   'of {role, content} objects',
+                        'type': 'invalid_request_error'}})
+                    return None
+                if server.tokenizer is None:
+                    self._json(400, {'error': {
+                        'message': 'chat API needs a tokenizer '
+                                   '(--tokenizer / --hf-model)',
+                        'type': 'invalid_request_error'}})
+                    return None
+                try:
+                    tokens = server.tokenizer.apply_chat_template(
+                        messages, tokenize=True,
+                        add_generation_prompt=True)
+                except Exception:  # noqa: BLE001 — no template in ckpt
+                    text = ''.join(
+                        f"{m.get('role', 'user')}: {m.get('content', '')}\n"
+                        for m in messages) + 'assistant: '
+                    tokens = server.tokenizer.encode(text)
+            else:
+                prompt = payload.get('prompt')
+                if isinstance(prompt, list) and all(
+                        isinstance(t, int) for t in prompt):
+                    tokens = prompt        # OpenAI token-array form
+                elif isinstance(prompt, str):
+                    if server.tokenizer is None:
+                        self._json(400, {'error': {
+                            'message': 'string prompts need a tokenizer '
+                                       '(--tokenizer / --hf-model); pass '
+                                       'a token array instead',
+                            'type': 'invalid_request_error'}})
+                        return None
+                    tokens = server.tokenizer.encode(prompt)
+                else:
+                    self._json(400, {'error': {
+                        'message': '"prompt" (string or token array) '
+                                   'required',
+                        'type': 'invalid_request_error'}})
+                    return None
+                if not tokens:
+                    self._json(400, {'error': {
+                        'message': 'empty prompt',
+                        'type': 'invalid_request_error'}})
+                    return None
+            req = Request(tokens=[int(t) for t in tokens],
+                          max_new_tokens=max_new,
+                          temperature=temperature,
+                          request_id=uuid.uuid4().hex)
+            return req, stop
+
+        @staticmethod
+        def _openai_finish(reason: str) -> str:
+            return {'eos': 'stop', 'length': 'length'}.get(reason, reason)
+
+        def _openai_generate(self, payload, chat: bool) -> None:
+            parsed = self._openai_request(payload, chat)
+            if parsed is None:
+                return
+            req, stop = parsed
+            kind = 'chat.completion' if chat else 'text_completion'
+            rid = ('chatcmpl-' if chat else 'cmpl-') + req.request_id[:24]
+            model_name = server.engine.model_config.name
+            if payload.get('stream'):
+                try:
+                    server._admit(req.request_id)
+                except AdmissionError as e:
+                    self._shed(e)
+                    return
+                try:
+                    self._openai_stream(req, stop, chat, rid, model_name)
+                finally:
+                    server._drop_admitted(req.request_id)
+                return
+            try:
+                res = server.submit(req)
+            except AdmissionError as e:
+                self._shed(e)
+                return
+            if res is None:
+                self._json(504, {'error': {'message': 'timed out',
+                                           'type': 'timeout'}})
+                return
+            if res.finish_reason == 'error':
+                code = 500 if res.error_class == 'internal' else 400
+                self._json(code, {'error': {
+                    'message': res.error or 'bad request',
+                    'type': 'invalid_request_error'
+                    if code == 400 else 'internal_error'}})
+                return
+            finish = self._openai_finish(res.finish_reason)
+            text = None
+            if server.tokenizer is not None:
+                text = server.tokenizer.decode(res.output_tokens)
+                at = self._find_stop(text, stop)
+                if at >= 0:
+                    text, finish = text[:at], 'stop'
+            usage = {'prompt_tokens': len(res.prompt_tokens),
+                     'completion_tokens': len(res.output_tokens),
+                     'total_tokens': len(res.prompt_tokens) +
+                     len(res.output_tokens)}
+            if chat:
+                choice = {'index': 0, 'finish_reason': finish,
+                          'message': {'role': 'assistant',
+                                      'content': text or ''}}
+            else:
+                choice = {'index': 0, 'finish_reason': finish,
+                          'text': text if text is not None
+                          else '', 'logprobs': None}
+                if text is None:    # token-only serving
+                    choice['tokens'] = res.output_tokens
+            self._json(200, {'id': rid, 'object': kind,
+                             'created': int(time.time()),
+                             'model': model_name,
+                             'choices': [choice], 'usage': usage})
+
+        @staticmethod
+        def _find_stop(text: str, stop) -> int:
+            """Earliest stop-string position in text, or -1."""
+            hit = -1
+            for s in stop:
+                at = text.find(s)
+                if at >= 0 and (hit < 0 or at < hit):
+                    hit = at
+            return hit
+
+        def _openai_stream(self, req, stop, chat, rid, model_name) -> None:
+            """OpenAI-style SSE: one chunk object per decode window,
+            a finish chunk, then `data: [DONE]`."""
+            emit = self._sse_begin()
+            kind = ('chat.completion.chunk' if chat
+                    else 'text_completion')
+            created = int(time.time())
+
+            def emit_done() -> None:
+                self.wfile.write(b'data: [DONE]\n\n')   # literal, no JSON
+                self.wfile.flush()
+
+            def chunk(delta_text, finish=None, first=False, tokens=None):
+                if chat:
+                    delta = {}
+                    if first:
+                        delta['role'] = 'assistant'
+                    if delta_text:
+                        delta['content'] = delta_text
+                    choice = {'index': 0, 'delta': delta,
+                              'finish_reason': finish}
+                else:
+                    choice = {'index': 0, 'text': delta_text,
+                              'finish_reason': finish}
+                    if tokens is not None:   # token-only serving
+                        choice['tokens'] = tokens
+                return {'id': rid, 'object': kind, 'created': created,
+                        'model': model_name, 'choices': [choice]}
+
+            streamed: list = []
+            emitted = 0          # chars of decoded text already sent
+            # A stop string can straddle decode windows: hold back the
+            # longest possible stop-prefix so an already-emitted chunk
+            # never contains part of a match (stream == non-stream).
+            hold = max((len(s) for s in stop), default=1) - 1
+            first = True
+            try:
+                for item_kind, value in server.submit_stream(
+                        req, pre_admitted=True):
+                    if item_kind == 'tokens':
+                        streamed.extend(value)
+                        if server.tokenizer is None:
+                            # Token-only serving: the ids ARE the data.
+                            emit(chunk('', tokens=value, first=first))
+                            first = False
+                            continue
+                        # Full-prefix decode, emit the suffix delta
+                        # (chunk-local decoding breaks BPE merges).
+                        text = server.tokenizer.decode(streamed)
+                        hit = self._find_stop(text, stop)
+                        if hit >= 0:
+                            # Truncate at the stop string; closing the
+                            # generator lets the engine finish solo
+                            # (same contract as a disconnect).
+                            delta = text[:hit][emitted:]
+                            if delta:
+                                emit(chunk(delta, first=first))
+                            emit(chunk('', finish='stop'))
+                            emit_done()
+                            return
+                        safe = max(emitted, len(text) - hold)
+                        delta = text[emitted:safe]
+                        if delta:
+                            emit(chunk(delta, first=first))
+                            first = False
+                            emitted = safe
+                    elif item_kind == 'done':
+                        finish = ('error' if value.finish_reason ==
+                                  'error' else self._openai_finish(
+                                      value.finish_reason))
+                        if (server.tokenizer is not None and
+                                value.finish_reason != 'error'):
+                            # Flush the held-back tail (stop-checked).
+                            text = server.tokenizer.decode(
+                                value.output_tokens)
+                            hit = self._find_stop(text, stop)
+                            if hit >= 0:
+                                text, finish = text[:hit], 'stop'
+                            delta = text[emitted:]
+                            if delta:
+                                emit(chunk(delta, first=first))
+                                first = False
+                        emit(chunk('', finish=finish))
+                        emit_done()
+                    else:   # timeout
+                        emit(chunk('', finish='error'))
+                        emit_done()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
 
         def do_POST(self):
             try:
@@ -369,6 +638,12 @@ def _make_handler(server: InferenceServer):
                 payload = json.loads(self.rfile.read(n) or b'{}')
             except (ValueError, json.JSONDecodeError) as e:
                 self._json(400, {'error': str(e)})
+                return
+            if self.path == '/v1/completions':
+                self._openai_generate(payload, chat=False)
+                return
+            if self.path == '/v1/chat/completions':
+                self._openai_generate(payload, chat=True)
                 return
             if self.path == '/cache_prefix':
                 # Register a prefix (system prompt): its KV rows stay

@@ -1110,3 +1110,194 @@ def test_prefix_cache_lane_batched_burst(tiny_config):
         assert r.output_tokens == expected[i], i
     assert cached.prefix_stats['hits'] == 6
     assert cached.prefix_stats['tokens_reused'] == 6 * len(prefix)
+
+
+# ------------------------------------------------------ OpenAI-compat API
+
+
+class _Tok:
+    """Minimal offline tokenizer stub (the handler only uses encode/
+    decode/apply_chat_template/eos_token_id)."""
+    eos_token_id = None
+
+    def encode(self, text):
+        return [1 + (ord(c) % 90) for c in text] or [1]
+
+    def decode(self, toks):
+        return ''.join(chr(97 + (t % 26)) for t in toks)
+
+    def apply_chat_template(self, messages, tokenize=True,
+                            add_generation_prompt=True):
+        return self.encode(''.join(m['content'] for m in messages))
+
+
+def _openai_server(tiny_config, port, tokenizer=None):
+    from skypilot_tpu.infer import server as srv_mod
+    eng = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=4, max_cache_len=64,
+                    prefill_buckets=(8, 16, 32), max_new_tokens=8,
+                    cache_dtype=jnp.float32),
+        rng=jax.random.PRNGKey(7))
+    t = threading.Thread(target=srv_mod.serve, args=(eng,),
+                         kwargs={'host': '127.0.0.1', 'port': port,
+                                 'tokenizer': tokenizer},
+                         daemon=True)
+    t.start()
+    import time as _time
+    deadline = _time.time() + 120
+    while _time.time() < deadline:
+        try:
+            if urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/health',
+                    timeout=3).status == 200:
+                return eng
+        except Exception:
+            _time.sleep(0.2)
+    raise TimeoutError('server did not become ready')
+
+
+def _post(port, path, body, raw=False):
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{port}{path}',
+        data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json'})
+    resp = urllib.request.urlopen(req, timeout=120)
+    data = resp.read()
+    return data if raw else json.loads(data)
+
+
+def test_openai_completions_token_array(tiny_config):
+    import urllib.error
+    eng = _openai_server(tiny_config, 8191)
+    out = _post(8191, '/v1/completions',
+                {'prompt': [5, 6, 7, 8], 'max_tokens': 6})
+    assert out['object'] == 'text_completion'
+    choice = out['choices'][0]
+    assert choice['finish_reason'] == 'length'
+    assert len(choice['tokens']) == 6
+    assert out['usage'] == {'prompt_tokens': 4, 'completion_tokens': 6,
+                            'total_tokens': 10}
+    # Token-exact vs the engine's own generate.
+    expected = eng.generate([Request(tokens=[5, 6, 7, 8],
+                                     max_new_tokens=6)])[0].output_tokens
+    assert choice['tokens'] == expected
+    # /v1/models lists the served model.
+    models = json.loads(urllib.request.urlopen(
+        'http://127.0.0.1:8191/v1/models', timeout=30).read())
+    assert models['data'][0]['id'] == tiny_config.name
+    # /stats exposes live counters.
+    stats = json.loads(urllib.request.urlopen(
+        'http://127.0.0.1:8191/stats', timeout=30).read())
+    assert stats['num_slots'] == 4 and 'spec' in stats
+
+    # String prompt without a tokenizer is a clean 400, not a crash.
+    try:
+        _post(8191, '/v1/completions', {'prompt': 'hello'})
+        raise AssertionError('expected 400')
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_openai_completions_text_and_stop(tiny_config):
+    _openai_server(tiny_config, 8190, tokenizer=_Tok())
+    out = _post(8190, '/v1/completions',
+                {'prompt': 'abcd', 'max_tokens': 8})
+    text = out['choices'][0]['text']
+    assert isinstance(text, str) and len(text) == 8
+    # stop strings truncate and flip finish_reason to 'stop'.
+    out2 = _post(8190, '/v1/completions',
+                 {'prompt': 'abcd', 'max_tokens': 8,
+                  'stop': [text[2]]})
+    assert out2['choices'][0]['finish_reason'] == 'stop'
+    assert text[2] not in out2['choices'][0]['text']
+
+
+def test_openai_completions_stream_matches_nonstream(tiny_config):
+    _openai_server(tiny_config, 8189, tokenizer=_Tok())
+    want = _post(8189, '/v1/completions',
+                 {'prompt': 'wxyz', 'max_tokens': 8})['choices'][0]['text']
+    raw = _post(8189, '/v1/completions',
+                {'prompt': 'wxyz', 'max_tokens': 8, 'stream': True},
+                raw=True).decode()
+    events = [line[6:] for line in raw.split('\n\n')
+              if line.startswith('data: ')]
+    assert events[-1] == '[DONE]'
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c['object'] == 'text_completion' for c in chunks)
+    got = ''.join(c['choices'][0]['text'] for c in chunks)
+    assert got == want
+    assert chunks[-1]['choices'][0]['finish_reason'] == 'length'
+
+
+def test_openai_chat_completions(tiny_config):
+    _openai_server(tiny_config, 8188, tokenizer=_Tok())
+    out = _post(8188, '/v1/chat/completions',
+                {'messages': [{'role': 'user', 'content': 'hi'}],
+                 'max_tokens': 6})
+    assert out['object'] == 'chat.completion'
+    msg = out['choices'][0]['message']
+    assert msg['role'] == 'assistant' and len(msg['content']) == 6
+    # Streaming: first delta carries the role; concatenation matches.
+    raw = _post(8188, '/v1/chat/completions',
+                {'messages': [{'role': 'user', 'content': 'hi'}],
+                 'max_tokens': 6, 'stream': True}, raw=True).decode()
+    events = [line[6:] for line in raw.split('\n\n')
+              if line.startswith('data: ')]
+    assert events[-1] == '[DONE]'
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]['object'] == 'chat.completion.chunk'
+    assert chunks[0]['choices'][0]['delta'].get('role') == 'assistant'
+    got = ''.join(c['choices'][0]['delta'].get('content', '')
+                  for c in chunks)
+    assert got == msg['content']
+
+
+def test_openai_stream_token_only_and_bad_messages(tiny_config):
+    """r3 review: token-only servers must stream the ids (not empty
+    text), and non-dict chat messages must 400, not drop the socket."""
+    import urllib.error
+    eng = _openai_server(tiny_config, 8187)
+    raw = _post(8187, '/v1/completions',
+                {'prompt': [5, 6, 7, 8], 'max_tokens': 6,
+                 'stream': True}, raw=True).decode()
+    events = [line[6:] for line in raw.split('\n\n')
+              if line.startswith('data: ')]
+    assert events[-1] == '[DONE]'
+    chunks = [json.loads(e) for e in events[:-1]]
+    got = [t for c in chunks for t in c['choices'][0].get('tokens', [])]
+    expected = eng.generate([Request(tokens=[5, 6, 7, 8],
+                                     max_new_tokens=6)])[0].output_tokens
+    assert got == expected
+    try:
+        _post(8187, '/v1/chat/completions',
+              {'messages': ['hi'], 'max_tokens': 4})
+        raise AssertionError('expected 400')
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_openai_stream_stop_straddling_windows(tiny_config):
+    """A stop string split across decode windows must still truncate
+    exactly like the non-stream path (held-back emission)."""
+    _openai_server(tiny_config, 8186, tokenizer=_Tok())
+    base = _post(8186, '/v1/completions',
+                 {'prompt': 'mnop', 'max_tokens': 12})['choices'][0]['text']
+    # A 2-char stop whose halves land in different windows (window = 8
+    # decode steps -> single chars per event after BPE-free _Tok): pick
+    # chars 3-4 of the continuation.
+    stop = base[3:5]
+    want = _post(8186, '/v1/completions',
+                 {'prompt': 'mnop', 'max_tokens': 12,
+                  'stop': [stop]})['choices'][0]
+    raw = _post(8186, '/v1/completions',
+                {'prompt': 'mnop', 'max_tokens': 12, 'stop': [stop],
+                 'stream': True}, raw=True).decode()
+    events = [line[6:] for line in raw.split('\n\n')
+              if line.startswith('data: ')]
+    chunks = [json.loads(e) for e in events[:-1]]
+    got = ''.join(c['choices'][0]['text'] for c in chunks)
+    assert got == want['text']
+    assert stop not in got
+    assert chunks[-1]['choices'][0]['finish_reason'] == \
+        want['finish_reason']
