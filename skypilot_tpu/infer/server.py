@@ -17,8 +17,14 @@ Endpoints:
   POST /v1/completions        prompt (string or token array), max_tokens,
                               temperature, stop, stream (SSE + [DONE])
   POST /v1/chat/completions   messages via the tokenizer's chat template
-  GET  /v1/models             the served model id
+  GET  /v1/models             the served model id + loaded adapters
   GET  /stats                 slots/queue/shed/spec/prefix counters
+  POST /load_adapter -> {"name": ..., "path": "adapter.npz"}: load a
+                     trained LoRA adapter (train.lora.save_adapter_npz)
+                     into a stack slot; requests select it via
+                     "adapter" or the OpenAI "model" field — concurrent
+                     requests for different adapters decode in one
+                     batch (the reference's LoRAX recipe, llm/lorax/).
 
 stdlib-only (ThreadingHTTPServer): requests block their handler thread on
 a per-request event while the single engine thread runs continuous
@@ -376,9 +382,12 @@ def _make_handler(server: InferenceServer):
                     self._json(503, {'status': 'starting'})
             elif self.path == '/v1/models':
                 name = server.engine.model_config.name
-                self._json(200, {'object': 'list', 'data': [{
-                    'id': name, 'object': 'model', 'created': 0,
-                    'owned_by': 'skypilot_tpu'}]})
+                rows = [{'id': name, 'object': 'model', 'created': 0,
+                         'owned_by': 'skypilot_tpu'}]
+                rows += [{'id': a, 'object': 'model', 'created': 0,
+                          'owned_by': 'skypilot_tpu', 'parent': name}
+                         for a in sorted(server.engine.adapters)]
+                self._json(200, {'object': 'list', 'data': rows})
             elif self.path == '/stats':
                 eng = server.engine
                 self._json(200, {
@@ -391,6 +400,7 @@ def _make_handler(server: InferenceServer):
                     'spec': dict(eng.spec_stats),
                     'prefix': dict(eng.prefix_stats),
                     'resident_prefixes': len(eng._prefixes),
+                    'adapters': sorted(eng.adapters),
                 })
             else:
                 self._json(404, {'error': 'not found'})
@@ -461,10 +471,31 @@ def _make_handler(server: InferenceServer):
                         'message': 'empty prompt',
                         'type': 'invalid_request_error'}})
                     return None
+            # Adapter selection, LoRAX-style: the OpenAI "model" field
+            # naming a registered adapter selects it (an "adapter"
+            # field works too); the base model id or absence = base.
+            # An unknown model value is a 404 (vLLM-compatible), never
+            # a silent base-model response.
+            adapter = payload.get('adapter')
+            model_field = payload.get('model')
+            if adapter is None and model_field:
+                if model_field in server.engine.adapters:
+                    adapter = model_field
+                elif model_field != server.engine.model_config.name:
+                    self._json(404, {'error': {
+                        'message': f'model {model_field!r} not found '
+                                   '(served: '
+                                   f'{server.engine.model_config.name}'
+                                   ' + adapters '
+                                   f'{sorted(server.engine.adapters)})',
+                        'type': 'invalid_request_error',
+                        'code': 'model_not_found'}})
+                    return None
             req = Request(tokens=[int(t) for t in tokens],
                           max_new_tokens=max_new,
                           temperature=temperature,
-                          request_id=uuid.uuid4().hex)
+                          request_id=uuid.uuid4().hex,
+                          adapter=adapter)
             return req, stop
 
         @staticmethod
@@ -478,7 +509,9 @@ def _make_handler(server: InferenceServer):
             req, stop = parsed
             kind = 'chat.completion' if chat else 'text_completion'
             rid = ('chatcmpl-' if chat else 'cmpl-') + req.request_id[:24]
-            model_name = server.engine.model_config.name
+            # Echo the model that actually serves the request (the
+            # adapter name when one is selected).
+            model_name = req.adapter or server.engine.model_config.name
             if payload.get('stream'):
                 try:
                     server._admit(req.request_id)
@@ -508,15 +541,24 @@ def _make_handler(server: InferenceServer):
                 return
             finish = self._openai_finish(res.finish_reason)
             text = None
+            n_completion = len(res.output_tokens)
             if server.tokenizer is not None:
                 text = server.tokenizer.decode(res.output_tokens)
                 at = self._find_stop(text, stop)
                 if at >= 0:
                     text, finish = text[:at], 'stop'
+                    # Usage counts only tokens up to the truncation
+                    # (vLLM-consistent): smallest token prefix whose
+                    # decode covers the kept text.
+                    for i in range(len(res.output_tokens) + 1):
+                        if len(server.tokenizer.decode(
+                                res.output_tokens[:i])) >= at:
+                            n_completion = i
+                            break
             usage = {'prompt_tokens': len(res.prompt_tokens),
-                     'completion_tokens': len(res.output_tokens),
+                     'completion_tokens': n_completion,
                      'total_tokens': len(res.prompt_tokens) +
-                     len(res.output_tokens)}
+                     n_completion}
             if chat:
                 choice = {'index': 0, 'finish_reason': finish,
                           'message': {'role': 'assistant',
@@ -645,6 +687,29 @@ def _make_handler(server: InferenceServer):
             if self.path == '/v1/chat/completions':
                 self._openai_generate(payload, chat=True)
                 return
+            if self.path == '/load_adapter':
+                # Multi-LoRA: load a trained adapter artifact (.npz from
+                # train.lora.save_adapter_npz) into a stack slot; later
+                # requests select it by name ("adapter" field, or the
+                # OpenAI "model" field).
+                name = payload.get('name')
+                path = payload.get('path')
+                if not name or not path:
+                    self._json(400, {'error': '"name" and "path" '
+                                     'required'})
+                    return
+                from skypilot_tpu.train.lora import load_adapter_npz
+                try:
+                    tree = load_adapter_npz(path)
+                    idx = server.engine.register_adapter(name, tree)
+                except FileNotFoundError as e:
+                    self._json(400, {'error': str(e)})
+                    return
+                except (TypeError, ValueError, KeyError) as e:
+                    self._json(400, {'error': str(e)})
+                    return
+                self._json(200, {'adapter': name, 'slot': idx})
+                return
             if self.path == '/cache_prefix':
                 # Register a prefix (system prompt): its KV rows stay
                 # on device and matching prompts prefill suffix-only.
@@ -660,7 +725,8 @@ def _make_handler(server: InferenceServer):
                     return
                 try:
                     n = server.engine.register_prefix(
-                        [int(t) for t in tokens])
+                        [int(t) for t in tokens],
+                        adapter=payload.get('adapter'))
                 except (TypeError, ValueError) as e:
                     self._json(400, {'error': str(e)})
                     return
@@ -694,7 +760,8 @@ def _make_handler(server: InferenceServer):
                 return
             req = Request(tokens=tokens, max_new_tokens=max_new,
                           temperature=temperature,
-                          request_id=uuid.uuid4().hex)
+                          request_id=uuid.uuid4().hex,
+                          adapter=payload.get('adapter'))
             if payload.get('stream'):
                 # Admit BEFORE the SSE 200 goes out: a shed must be a
                 # clean 429 the client (and LB) can act on.
@@ -773,7 +840,9 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         max_queue: Optional[int] = None,
         draft_len: int = 0,
         ngram_max: int = 4,
-        max_prefixes: int = 16) -> None:
+        max_prefixes: int = 16,
+        lora_rank: int = 0,
+        lora_max_adapters: int = 8) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -887,7 +956,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       prefills_per_gap=prefills_per_gap,
                       cache_dtype=resolve_cache_dtype(cache_dtype),
                       draft_len=draft_len, ngram_max=ngram_max,
-                      max_prefixes=max_prefixes)
+                      max_prefixes=max_prefixes, lora_rank=lora_rank,
+                      lora_max_adapters=lora_max_adapters)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -926,6 +996,11 @@ def main() -> None:
     parser.add_argument('--max-prefixes', type=int, default=16,
                         help='resident prefix-KV entries for '
                              '/cache_prefix (LRU; 0 disables)')
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='multi-LoRA serving: adapter rank '
+                             '(0 disables; POST /load_adapter to load)')
+    parser.add_argument('--lora-max-adapters', type=int, default=8,
+                        help='resident adapter slots (--lora-rank)')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
@@ -934,7 +1009,8 @@ def main() -> None:
         cache_dtype=args.cache_dtype,
         tensor_parallel=args.tensor_parallel,
         draft_len=args.draft_len, ngram_max=args.ngram_max,
-        max_prefixes=args.max_prefixes)
+        max_prefixes=args.max_prefixes, lora_rank=args.lora_rank,
+        lora_max_adapters=args.lora_max_adapters)
 
 
 if __name__ == '__main__':

@@ -73,6 +73,11 @@ class LlamaConfig:
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ('q_proj', 'k_proj', 'v_proj',
                                      'o_proj')
+    # Multi-LoRA serving (the reference's LoRAX recipe): >0 stacks this
+    # many adapters per target ([N, in, r] / [N, r, out]); the forward
+    # takes adapter_ids [batch] selecting one per sequence (<0 = base
+    # only).  0 = single-adapter training behavior.
+    lora_num_adapters: int = 0
     # Rematerialization policy for decoder blocks: 'full' saves nothing
     # (min HBM, max recompute), 'dots' saves matmul outputs and recomputes
     # elementwise ops (the usual best FLOPs/HBM trade when memory allows),
@@ -281,7 +286,8 @@ class QuantDenseGeneral(nn.Module):
 
 
 def _proj(cfg: LlamaConfig, name: str, feats, axes, *, axis=-1,
-          init_std: float = 0.02, use_bias: bool = False):
+          init_std: float = 0.02, use_bias: bool = False,
+          adapter_ids=None):
     """A named projection: DenseGeneral plus, when `name` is a configured
     LoRA target, a sibling '<name>_lora' adapter added to its output.
     Must be called from inside the owning module's @nn.compact __call__
@@ -313,7 +319,10 @@ def _proj(cfg: LlamaConfig, name: str, feats, axes, *, axis=-1,
         features=feats if isinstance(feats, tuple) else (feats,),
         rank=cfg.lora_rank, alpha=cfg.lora_alpha,
         num_contract_dims=len(axis) if isinstance(axis, tuple) else 1,
-        dtype=cfg.dtype, name=f'{name}_lora')
+        dtype=cfg.dtype, num_adapters=cfg.lora_num_adapters,
+        name=f'{name}_lora')
+    if cfg.lora_num_adapters:
+        return lambda inp: base(inp) + adapter(inp, adapter_ids)
     return lambda inp: base(inp) + adapter(inp)
 
 
@@ -321,19 +330,22 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None):
+    def __call__(self, x, positions, kv_cache=None, adapter_ids=None):
         cfg = self.config
         d = cfg.head_dim_
 
         q = _proj(cfg, 'q_proj', (cfg.num_heads, d),
                   ('embed', 'heads', 'qkv_embed'),
-                  use_bias=cfg.attention_bias)(x)
+                  use_bias=cfg.attention_bias,
+                  adapter_ids=adapter_ids)(x)
         k = _proj(cfg, 'k_proj', (cfg.num_kv_heads, d),
                   ('embed', 'kv_heads', 'qkv_embed'),
-                  use_bias=cfg.attention_bias)(x)
+                  use_bias=cfg.attention_bias,
+                  adapter_ids=adapter_ids)(x)
         v = _proj(cfg, 'v_proj', (cfg.num_kv_heads, d),
                   ('embed', 'kv_heads', 'qkv_embed'),
-                  use_bias=cfg.attention_bias)(x)
+                  use_bias=cfg.attention_bias,
+                  adapter_ids=adapter_ids)(x)
         # [B, S, H, D] -> [B, H, S, D]
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))
@@ -367,7 +379,8 @@ class Attention(nn.Module):
         # std 0.02/sqrt(2L) keeps residual variance bounded with depth.
         out = _proj(cfg, 'o_proj', cfg.hidden_size,
                     ('heads', 'qkv_embed', 'embed'), axis=(-2, -1),
-                    init_std=0.02 / (2 * cfg.num_layers) ** 0.5)(out)
+                    init_std=0.02 / (2 * cfg.num_layers) ** 0.5,
+                    adapter_ids=adapter_ids)(out)
         if kv_cache is not None:
             return out, new_cache
         return out
@@ -377,12 +390,12 @@ class MLP(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         cfg = self.config
         gate = _proj(cfg, 'gate_proj', cfg.intermediate_size,
-                     ('embed', 'mlp'))(x)
+                     ('embed', 'mlp'), adapter_ids=adapter_ids)(x)
         up = _proj(cfg, 'up_proj', cfg.intermediate_size,
-                   ('embed', 'mlp'))(x)
+                   ('embed', 'mlp'), adapter_ids=adapter_ids)(x)
         if cfg.hidden_act == 'gelu_tanh':       # Gemma GeGLU
             h = nn.gelu(gate, approximate=True) * up
         elif cfg.hidden_act == 'gelu':          # exact (erf) GELU
@@ -396,14 +409,14 @@ class MLP(nn.Module):
         h = nn.with_logical_constraint(
             h, ('activation_batch', 'activation_seq', 'activation_mlp'))
         return _proj(cfg, 'down_proj', cfg.hidden_size,
-                     ('mlp', 'embed'))(h)
+                     ('mlp', 'embed'), adapter_ids=adapter_ids)(h)
 
 
 class DecoderLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None):
+    def __call__(self, x, positions, kv_cache=None, adapter_ids=None):
         # Residual-stream activations are anchored to the batch-sharded
         # layout at BOTH norm seams, not just the layer output: without
         # an anchor on the norm outputs, the backward of the qkv/mlp
@@ -417,13 +430,17 @@ class DecoderLayer(nn.Module):
             RMSNorm(self.config.norm_eps, name='input_norm')(x), resid)
         attn = Attention(self.config, name='attn')
         if kv_cache is not None:
-            attn_out, new_cache = attn(attn_in, positions, kv_cache)
+            attn_out, new_cache = attn(attn_in, positions, kv_cache,
+                                       adapter_ids=adapter_ids)
         else:
-            attn_out, new_cache = attn(attn_in, positions), None
+            attn_out = attn(attn_in, positions,
+                            adapter_ids=adapter_ids)
+            new_cache = None
         h = nn.with_logical_constraint(x + attn_out, resid)
         mlp_in = nn.with_logical_constraint(
             RMSNorm(self.config.norm_eps, name='post_attn_norm')(h), resid)
-        out = h + MLP(self.config, name='mlp')(mlp_in)
+        out = h + MLP(self.config, name='mlp')(mlp_in,
+                                               adapter_ids=adapter_ids)
         out = nn.with_logical_constraint(out, resid)
         if kv_cache is not None:
             return out, new_cache
@@ -436,7 +453,7 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, cache=None,
-                 hidden_only=False):
+                 hidden_only=False, adapter_ids=None):
         """Training/scoring: __call__(tokens) -> logits.
 
         hidden_only=True returns the final-norm hidden states [B, S, H]
@@ -469,8 +486,13 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             layer = DecoderLayer(cfg, name=f'layer_{i}')
             if cache is not None:
-                x, layer_cache = layer(x, positions, cache[i])
+                x, layer_cache = layer(x, positions, cache[i],
+                                       adapter_ids=adapter_ids)
                 new_cache.append(layer_cache)
+            elif adapter_ids is not None:
+                # Multi-LoRA scoring (no cache): remat is a training
+                # concern; thread the ids straight through.
+                x = layer(x, positions, adapter_ids=adapter_ids)
             elif cfg.remat_policy == 'none':
                 x = layer(x, positions)
             else:

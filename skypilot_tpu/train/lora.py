@@ -30,33 +30,62 @@ import numpy as np
 
 class LoRAAdapter(nn.Module):
     """Low-rank delta for a DenseGeneral: contracts the same input axes,
-    produces the same output feature dims."""
+    produces the same output feature dims.
+
+    num_adapters=0 (training): one adapter, params ``lora_a [in, r]`` /
+    ``lora_b [r, out]``.
+
+    num_adapters=N (multi-LoRA serving, the reference's LoRAX recipe
+    llm/lorax/README.md rebuilt natively): params are STACKED
+    ``[N, ...]`` and ``adapter_ids [batch]`` selects one adapter per
+    sequence — concurrent requests for different adapters run in one
+    batch.  ``adapter_ids < 0`` = base model only (zero delta).  The
+    per-row gather of two skinny matrices is the standard multi-LoRA
+    cost (punica-style BGMV), tiny next to the base weight streaming.
+    """
     features: Tuple[int, ...]      # output feature dims of the base proj
     rank: int
     alpha: float
     num_contract_dims: int = 1     # trailing input dims to contract
     dtype: Any = jnp.bfloat16
+    num_adapters: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         k = self.num_contract_dims
         batch_shape = x.shape[:-k]
         in_dim = int(np.prod(x.shape[-k:]))
         out_dim = int(np.prod(self.features))
         xf = x.reshape(*batch_shape, in_dim)
+        n = self.num_adapters
+        a_shape = (in_dim, self.rank) if not n else (n, in_dim, self.rank)
+        b_shape = (self.rank, out_dim) if not n else (n, self.rank,
+                                                      out_dim)
+        axes = (None, None) if not n else (None, None, None)
         a = self.param(
             'lora_a',
             nn.with_logical_partitioning(nn.initializers.normal(0.02),
-                                         (None, None)),
-            (in_dim, self.rank))
+                                         axes), a_shape)
         b = self.param(
             'lora_b',
-            nn.with_logical_partitioning(nn.initializers.zeros,
-                                         (None, None)),
-            (self.rank, out_dim))
-        y = (xf.astype(self.dtype) @ a.astype(self.dtype)) \
-            @ b.astype(self.dtype)
-        y = y * (self.alpha / self.rank)
+            nn.with_logical_partitioning(nn.initializers.zeros, axes),
+            b_shape)
+        if not n:
+            y = (xf.astype(self.dtype) @ a.astype(self.dtype)) \
+                @ b.astype(self.dtype)
+            y = y * (self.alpha / self.rank)
+            return y.reshape(*batch_shape, *self.features)
+        if adapter_ids is None:
+            raise ValueError(
+                'multi-adapter LoRA needs adapter_ids [batch]')
+        idx = jnp.clip(adapter_ids, 0, n - 1)
+        a_g = a[idx].astype(self.dtype)            # [B, in, r]
+        b_g = b[idx].astype(self.dtype)            # [B, r, out]
+        h = jnp.einsum('b...i,bir->b...r', xf.astype(self.dtype), a_g)
+        y = jnp.einsum('b...r,bro->b...o', h, b_g)
+        scale = jnp.where(adapter_ids >= 0, self.alpha / self.rank, 0.0)
+        scale = scale.reshape((-1,) + (1,) * (y.ndim - 1))
+        y = y * scale.astype(self.dtype)
         return y.reshape(*batch_shape, *self.features)
 
 
@@ -101,6 +130,45 @@ def merge_base_params(state_params, base_params):
         return out
 
     return merge(state_params, base_params)
+
+
+def extract_adapter_tree(params):
+    """The `*_lora` subtrees only (the portable adapter artifact)."""
+
+    def walk(tree):
+        out = {}
+        for key, val in tree.items():
+            if isinstance(val, dict):
+                if str(key).endswith('_lora'):
+                    out[key] = val
+                else:
+                    sub = walk(val)
+                    if sub:
+                        out[key] = sub
+        return out
+
+    return walk(params)
+
+
+def save_adapter_npz(params, path: str) -> int:
+    """Write the adapter (`*_lora`) leaves of a param tree as a flat
+    .npz — the interchange format `skytpu infer` loads via
+    POST /load_adapter.  Returns the number of leaves written."""
+    import flax
+    flat = flax.traverse_util.flatten_dict(
+        jax.tree.map(np.asarray, extract_adapter_tree(params)), sep='/')
+    if not flat:
+        raise ValueError('no *_lora leaves in the given tree')
+    np.savez(path, **flat)
+    return len(flat)
+
+
+def load_adapter_npz(path: str):
+    """Inverse of save_adapter_npz: nested adapter tree from a .npz."""
+    import flax
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    return flax.traverse_util.unflatten_dict(flat, sep='/')
 
 
 def num_adapter_params(params) -> int:
