@@ -142,6 +142,12 @@ class Request:
     stream_cb: Optional[Callable[[List[int]], None]] = None
     # Multi-LoRA: name of a registered adapter (None = base model).
     adapter: Optional[str] = None
+    # Score the PROMPT too: RequestResult.prompt_logprobs carries
+    # log P(token_t | tokens_<t) for t >= 1 (None at t=0) — the
+    # lm-eval-harness loglikelihood pattern (OpenAI echo+logprobs).
+    # Such requests bypass prefix-KV reuse (reused rows have no
+    # logits).
+    want_prompt_logprobs: bool = False
 
 
 @dataclasses.dataclass
@@ -154,6 +160,21 @@ class RequestResult:
     finish_reason: str            # 'eos' | 'length' | 'error'
     error: Optional[str] = None
     error_class: Optional[str] = None   # 'client' | 'internal'
+    # log P(token | context) for each generated token (always present
+    # on success — computed on-device next to sampling, cost is one
+    # logsumexp the softmax path needs anyway).
+    logprobs: Optional[List[float]] = None
+    # The argmax alternative at each generated position: (token_id,
+    # logprob) — the OpenAI top_logprobs k=1 entry (equals the chosen
+    # token for greedy requests; is_greedy for eval harnesses).
+    top_logprobs: Optional[List[Tuple[int, float]]] = None
+    # Prompt scores (want_prompt_logprobs): entry t is
+    # log P(prompt_t | prompt_<t); entry 0 is None (no context).
+    prompt_logprobs: Optional[List[Optional[float]]] = None
+    # Argmax alternative per prompt position (aligned with
+    # prompt_logprobs; entry 0 is None).
+    prompt_top_logprobs: Optional[List[Optional[Tuple[int,
+                                                      float]]]] = None
 
 
 def prompt_lookup_draft(hist: Sequence[int], k: int,
@@ -184,7 +205,8 @@ def prompt_lookup_draft(hist: Sequence[int], k: int,
 
 class _Slot:
     __slots__ = ('request', 'length', 'generated', 'submit_time',
-                 'first_token_time', 'max_new', 'streamed')
+                 'first_token_time', 'max_new', 'streamed', 'lps',
+                 'tops', 'prompt_lps', 'prompt_tops')
 
     def __init__(self, request: Request, length: int, submit_time: float,
                  max_new: int):
@@ -195,6 +217,10 @@ class _Slot:
         self.first_token_time: Optional[float] = None
         self.max_new = max_new
         self.streamed = 0                  # tokens already stream_cb'd
+        self.lps: List[float] = []         # logprob per generated token
+        self.tops: List[Tuple[int, float]] = []   # argmax alternative
+        self.prompt_lps: Optional[list] = None
+        self.prompt_tops: Optional[list] = None
 
 
 class InferenceEngine:
@@ -446,8 +472,22 @@ class InferenceEngine:
             __call__ doesn't take the argument)."""
             return {'adapter_ids': adapter_ids} if use_lora else {}
 
+        def chosen_logprob(logits, chosen):
+            """log softmax of `chosen` ([...]) under `logits` ([..., V])
+            — one logsumexp, cheap next to the forward."""
+            logz = jax.scipy.special.logsumexp(logits, axis=-1)
+            sel = jnp.take_along_axis(logits, chosen[..., None],
+                                      axis=-1)[..., 0]
+            return sel - logz
+
+        def greedy_and_lp(logits):
+            """(argmax token, its logprob): the top-1 alternative
+            reported as OpenAI top_logprobs (is_greedy for evals)."""
+            g = jnp.argmax(logits, axis=-1)
+            return g.astype(jnp.int32), chosen_logprob(logits, g)
+
         def prefill_insert(params, tokens, true_lens, pcache, cache,
-                           slots, temps, rng, adapter_ids):
+                           slots, temps, rng, adapter_ids, want_plp):
             """Fused batched prefill: P prompts forward + first-token
             sampling + KV insertion into their slots, ONE dispatch.
 
@@ -466,6 +506,19 @@ class InferenceEngine:
             sampled = jax.random.categorical(
                 rng, last / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
             first = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            first_lp = chosen_logprob(last, first)
+            first_top = (greedy, chosen_logprob(last, greedy))
+            if want_plp:   # STATIC: prompt scoring is a full [P,S,V]
+                # reduction pass + transfer — only when a request in
+                # the chunk asked (position t-1 predicts token t).
+                prompt_lps = chosen_logprob(logits[:, :-1],
+                                            tokens[:, 1:])  # [P, S-1]
+                prompt_tops = greedy_and_lp(logits[:, :-1])
+            else:
+                p_ = tokens.shape[0]
+                prompt_lps = jnp.zeros((p_, 0), jnp.float32)
+                prompt_tops = (jnp.zeros((p_, 0), jnp.int32),
+                               jnp.zeros((p_, 0), jnp.float32))
 
             new_cache = []
             for (k, v), (pk, pv) in zip(cache, pc):
@@ -482,7 +535,8 @@ class InferenceEngine:
 
                 kk, vv = jax.lax.fori_loop(0, p, write, (k, v))
                 new_cache.append((kk, vv))
-            return first, new_cache
+            return (first, first_lp, first_top, prompt_lps, prompt_tops,
+                    new_cache)
 
         def decode(params, cache, tokens, lengths, temps, rng,
                    adapter_ids):
@@ -501,12 +555,15 @@ class InferenceEngine:
                                                  axis=-1)
                 next_tokens = jnp.where(temps > 0, sampled,
                                         greedy).astype(jnp.int32)
-                return (cache, next_tokens, lengths + 1), next_tokens
+                lp = chosen_logprob(logits, next_tokens)
+                g_lp = chosen_logprob(logits, greedy)
+                return (cache, next_tokens, lengths + 1), (
+                    next_tokens, lp, greedy.astype(jnp.int32), g_lp)
 
             keys = jax.random.split(rng, self.cfg.decode_steps)
-            (cache, _, _), toks = jax.lax.scan(
+            (cache, _, _), (toks, lps, gtoks, glps) = jax.lax.scan(
                 one_step, (cache, tokens, lengths), keys)
-            return toks, cache                               # [K, B]
+            return toks, lps, gtoks, glps, cache             # [K, B] x4
 
         def spec_verify(params, cache, tokens, lengths, temps, rng,
                         adapter_ids):
@@ -528,7 +585,9 @@ class InferenceEngine:
                                              axis=-1)
             preds = jnp.where(temps[:, None] > 0, sampled,
                               greedy).astype(jnp.int32)
-            return preds, cache
+            preds_lp = chosen_logprob(logits, preds)         # [B, K]
+            g_lp = chosen_logprob(logits, greedy)
+            return preds, preds_lp, greedy.astype(jnp.int32), g_lp, cache
 
         cache_dtype = self.cfg.cache_dtype
 
@@ -577,6 +636,9 @@ class InferenceEngine:
                 rng, last / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
             first = jnp.where(temps > 0, sampled,
                               greedy).astype(jnp.int32)
+            first_lp = chosen_logprob(last, first)
+            first_top = (greedy.astype(jnp.int32),
+                         chosen_logprob(last, greedy))
             new_cache = []
             for (k, v), (pk2, pv2) in zip(cache, pc):
 
@@ -592,9 +654,10 @@ class InferenceEngine:
 
                 kk, vv = jax.lax.fori_loop(0, p, write, (k, v))
                 new_cache.append((kk, vv))
-            return first, new_cache
+            return first, first_lp, first_top, new_cache
 
-        self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,))
+        self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,),
+                                       static_argnums=(9,))
         self._decode = jax.jit(decode, donate_argnums=(1,))
         self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
         self._prefill_capture = jax.jit(prefill_capture)
@@ -849,12 +912,15 @@ class InferenceEngine:
                 f'{slots=} p={p}')
             self._rng, rkey = jax.random.split(self._rng)
             with self._ctx():
-                first, self.cache = self._prefix_prefill(
-                    self.params, jnp.asarray(tokens), start,
-                    jnp.asarray(true_lens), kv, self.cache,
-                    jnp.asarray(slots), jnp.asarray(temps), rkey,
-                    jnp.full((width,), aid, jnp.int32))
+                first, first_lp, first_top, self.cache = \
+                    self._prefix_prefill(
+                        self.params, jnp.asarray(tokens), start,
+                        jnp.asarray(true_lens), kv, self.cache,
+                        jnp.asarray(slots), jnp.asarray(temps), rkey,
+                        jnp.full((width,), aid, jnp.int32))
             first_np = np.asarray(first)
+            first_lp_np = np.asarray(first_lp)
+            top_np = (np.asarray(first_top[0]), np.asarray(first_top[1]))
             now = time.time()
             for i, (req, slot, submit_time, n, _, max_new) in \
                     enumerate(chunk):
@@ -862,6 +928,8 @@ class InferenceEngine:
                           max_new=max_new)
                 s.first_token_time = now
                 s.generated.append(int(first_np[i]))
+                s.lps.append(float(first_lp_np[i]))
+                s.tops.append((int(top_np[0][i]), float(top_np[1][i])))
                 self._slots[slot] = s
                 self._lengths[slot] = n
                 self._last_tokens[slot] = s.generated[0]
@@ -889,7 +957,10 @@ class InferenceEngine:
             groups: Dict[Any, list] = {}
             rest = []
             for it in items:
-                m = self._match_prefix(it[0].tokens, it[0].adapter)
+                # Prompt scoring needs every prompt position's logits:
+                # reused prefix rows have none — full prefill.
+                m = (None if it[0].want_prompt_logprobs else
+                     self._match_prefix(it[0].tokens, it[0].adapter))
                 if m is None:
                     rest.append(it)
                     continue
@@ -936,14 +1007,24 @@ class InferenceEngine:
                     f'{slots=} p={p}')
                 pcache = init_cache(self.model_config, width, bucket,
                                     self.cfg.cache_dtype)
+                want_plp = any(it[0].want_prompt_logprobs
+                               for it in chunk)
                 self._rng, key = jax.random.split(self._rng)
                 with self._ctx():   # mesh+rules active at trace time
-                    first, self.cache = self._prefill_insert(
-                        self.params, jnp.asarray(tokens),
-                        jnp.asarray(true_lens), pcache, self.cache,
-                        jnp.asarray(slots), jnp.asarray(temps), key,
-                        jnp.asarray(aids))
+                    (first, first_lp, first_top, prompt_lps,
+                     prompt_tops, self.cache) = self._prefill_insert(
+                         self.params, jnp.asarray(tokens),
+                         jnp.asarray(true_lens), pcache, self.cache,
+                         jnp.asarray(slots), jnp.asarray(temps), key,
+                         jnp.asarray(aids), want_plp)
                 first_np = np.asarray(first)
+                first_lp_np = np.asarray(first_lp)
+                top_np = (np.asarray(first_top[0]),
+                          np.asarray(first_top[1]))
+                if want_plp:
+                    plp_np = np.asarray(prompt_lps)
+                    ptop_np = (np.asarray(prompt_tops[0]),
+                               np.asarray(prompt_tops[1]))
                 now = time.time()
                 for i, (req, slot, submit_time, n, _, max_new) in \
                         enumerate(chunk):
@@ -951,6 +1032,16 @@ class InferenceEngine:
                               max_new=max_new)
                     s.first_token_time = now
                     s.generated.append(int(first_np[i]))
+                    s.lps.append(float(first_lp_np[i]))
+                    s.tops.append((int(top_np[0][i]),
+                                   float(top_np[1][i])))
+                    if req.want_prompt_logprobs:
+                        s.prompt_lps = [None] + [
+                            float(x) for x in plp_np[i, :n - 1]]
+                        s.prompt_tops = [None] + [
+                            (int(ptop_np[0][i, t]),
+                             float(ptop_np[1][i, t]))
+                            for t in range(n - 1)]
                     self._slots[slot] = s
                     self._lengths[slot] = n
                     self._last_tokens[slot] = s.generated[0]
@@ -989,7 +1080,13 @@ class InferenceEngine:
             output_tokens=list(s.generated),
             ttft_s=(s.first_token_time or now) - s.submit_time,
             latency_s=now - s.submit_time,
-            finish_reason=reason)
+            finish_reason=reason,
+            logprobs=list(s.lps),
+            top_logprobs=list(s.tops),
+            prompt_logprobs=(list(s.prompt_lps)
+                             if s.prompt_lps is not None else None),
+            prompt_top_logprobs=(list(s.prompt_tops)
+                                 if s.prompt_tops is not None else None))
         req = s.request
         self._slots[i] = None
         self._lengths[i] = 0
@@ -1005,11 +1102,14 @@ class InferenceEngine:
         slot is recycled)."""
         self._rng, key = jax.random.split(self._rng)
         with self._ctx():           # mesh+rules active at trace time
-            toks, self.cache = self._decode(
+            toks, lps, gtoks, glps, self.cache = self._decode(
                 self.params, self.cache, jnp.asarray(self._last_tokens),
                 jnp.asarray(self._lengths), jnp.asarray(self._temps), key,
                 jnp.asarray(self._slot_adapters))
         toks_np = np.asarray(toks)                           # [K, B]
+        lps_np = np.asarray(lps)
+        gtoks_np = np.asarray(gtoks)
+        glps_np = np.asarray(glps)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -1024,6 +1124,9 @@ class InferenceEngine:
                 s.length += 1        # the token we just fed is now cached
                 tok = int(toks_np[k, i])
                 s.generated.append(tok)
+                s.lps.append(float(lps_np[k, i]))
+                s.tops.append((int(gtoks_np[k, i]),
+                               float(glps_np[k, i])))
             self._lengths[i] = s.length
             self._last_tokens[i] = s.generated[-1]
 
@@ -1085,11 +1188,14 @@ class InferenceEngine:
         self._spec_skips = 0
         self._rng, key = jax.random.split(self._rng)
         with self._ctx():
-            preds, self.cache = self._spec_verify(
+            preds, preds_lp, g_np_, g_lp_, self.cache = self._spec_verify(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.asarray(self._lengths), jnp.asarray(self._temps), key,
                 jnp.asarray(self._slot_adapters))
         preds_np = np.asarray(preds)                         # [B, K]
+        preds_lp_np = np.asarray(preds_lp)
+        g_toks_np = np.asarray(g_np_)
+        g_lps_np = np.asarray(g_lp_)
         self.spec_stats['dispatches'] += 1
         accepted_before = self.spec_stats['accepted']
         for i, s in enumerate(self._slots):
@@ -1114,6 +1220,9 @@ class InferenceEngine:
                     self.spec_stats['accepted'] += 1
                 s.length += 1
                 s.generated.append(int(preds_np[i, t]))
+                s.lps.append(float(preds_lp_np[i, t]))
+                s.tops.append((int(g_toks_np[i, t]),
+                               float(g_lps_np[i, t])))
             self._lengths[i] = s.length
             self._last_tokens[i] = s.generated[-1]
         dispatch_drafted = int(drafted.sum())

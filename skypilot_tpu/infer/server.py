@@ -360,6 +360,11 @@ def _make_handler(server: InferenceServer):
                             'latency_s': value.latency_s,
                             'finish_reason': value.finish_reason,
                         }
+                        if value.logprobs is not None:
+                            final['logprobs'] = value.logprobs
+                        if value.prompt_logprobs is not None:
+                            final['prompt_logprobs'] = \
+                                value.prompt_logprobs
                         if value.error:
                             final['error'] = value.error
                         if server.tokenizer is not None:
@@ -408,8 +413,11 @@ def _make_handler(server: InferenceServer):
         # ----------------------------------------- OpenAI-compatible API
 
         def _openai_request(self, payload, chat: bool):
-            """Parse a /v1/* body into (Request, echo_text) or answer
-            the error and return None."""
+            """Parse a /v1/* body into (Request, stop, opts) or answer
+            the error and return None.  opts: logprobs (bool), echo
+            (bool), zero_max (max_tokens=0 — the lm-eval-harness
+            loglikelihood pattern: score the prompt, generate
+            nothing)."""
             try:
                 max_new = payload.get('max_tokens', 16)
                 max_new = None if max_new is None else int(max_new)
@@ -418,9 +426,32 @@ def _make_handler(server: InferenceServer):
                 if isinstance(stop, str):
                     stop = [stop]
                 stop = [str(s) for s in stop]
+                want_lp = bool(payload.get('logprobs'))
+                echo = bool(payload.get('echo'))
             except (TypeError, ValueError) as e:
                 self._json(400, {'error': {'message': f'bad field: {e}',
                                            'type': 'invalid_request_error'}})
+                return None
+            opts = {'logprobs': want_lp, 'echo': echo,
+                    'zero_max': max_new == 0}
+            if opts['zero_max']:
+                # The engine always produces the prefill token; trim it
+                # from the response instead of rejecting the request.
+                max_new = 1
+            if chat and (want_lp or echo):
+                self._json(400, {'error': {
+                    'message': 'logprobs/echo are supported on '
+                               '/v1/completions only',
+                    'type': 'invalid_request_error'}})
+                return None
+            if payload.get('stream') and (want_lp or echo or
+                                          opts['zero_max']):
+                # Reject loudly instead of silently diverging from
+                # OpenAI semantics on the streaming path.
+                self._json(400, {'error': {
+                    'message': 'logprobs/echo/max_tokens=0 are not '
+                               'supported with stream',
+                    'type': 'invalid_request_error'}})
                 return None
             if chat:
                 messages = payload.get('messages')
@@ -495,8 +526,9 @@ def _make_handler(server: InferenceServer):
                           max_new_tokens=max_new,
                           temperature=temperature,
                           request_id=uuid.uuid4().hex,
-                          adapter=adapter)
-            return req, stop
+                          adapter=adapter,
+                          want_prompt_logprobs=want_lp and echo)
+            return req, stop, opts
 
         @staticmethod
         def _openai_finish(reason: str) -> str:
@@ -506,7 +538,7 @@ def _make_handler(server: InferenceServer):
             parsed = self._openai_request(payload, chat)
             if parsed is None:
                 return
-            req, stop = parsed
+            req, stop, opts = parsed
             kind = 'chat.completion' if chat else 'text_completion'
             rid = ('chatcmpl-' if chat else 'cmpl-') + req.request_id[:24]
             # Echo the model that actually serves the request (the
@@ -540,19 +572,25 @@ def _make_handler(server: InferenceServer):
                     if code == 400 else 'internal_error'}})
                 return
             finish = self._openai_finish(res.finish_reason)
+            out_tokens = list(res.output_tokens)
+            out_lps = list(res.logprobs or [])
+            if opts['zero_max']:
+                # max_tokens=0: the engine generated one token for the
+                # prefill; the client asked for none.
+                out_tokens, out_lps, finish = [], [], 'length'
             text = None
-            n_completion = len(res.output_tokens)
+            n_completion = len(out_tokens)
             if server.tokenizer is not None:
-                text = server.tokenizer.decode(res.output_tokens)
+                text = server.tokenizer.decode(out_tokens)
                 at = self._find_stop(text, stop)
                 if at >= 0:
                     text, finish = text[:at], 'stop'
                     # Usage counts only tokens up to the truncation
                     # (vLLM-consistent): smallest token prefix whose
                     # decode covers the kept text.
-                    for i in range(len(res.output_tokens) + 1):
+                    for i in range(len(out_tokens) + 1):
                         if len(server.tokenizer.decode(
-                                res.output_tokens[:i])) >= at:
+                                out_tokens[:i])) >= at:
                             n_completion = i
                             break
             usage = {'prompt_tokens': len(res.prompt_tokens),
@@ -564,11 +602,46 @@ def _make_handler(server: InferenceServer):
                           'message': {'role': 'assistant',
                                       'content': text or ''}}
             else:
+                if opts['echo'] and text is not None:
+                    text = server.tokenizer.decode(
+                        res.prompt_tokens) + text
                 choice = {'index': 0, 'finish_reason': finish,
                           'text': text if text is not None
                           else '', 'logprobs': None}
                 if text is None:    # token-only serving
-                    choice['tokens'] = res.output_tokens
+                    choice['tokens'] = out_tokens
+                if opts['logprobs']:
+                    ids = (list(res.prompt_tokens) if opts['echo']
+                           else []) + out_tokens[:n_completion]
+                    lps = ((list(res.prompt_logprobs or [])
+                            if opts['echo'] else []) +
+                           out_lps[:n_completion])
+                    tops = ((list(res.prompt_top_logprobs or [])
+                             if opts['echo'] else []) +
+                            list(res.top_logprobs
+                                 or [])[:n_completion])
+                    tok = server.tokenizer
+
+                    def tstr(t):
+                        return tok.decode([t]) if tok else str(t)
+
+                    strs = [tstr(t) for t in ids]
+                    offsets, pos = [], 0
+                    for s_ in strs:
+                        offsets.append(pos)
+                        pos += len(s_)
+                    choice['logprobs'] = {
+                        'tokens': strs,
+                        'token_logprobs': lps,
+                        # k=1: the argmax alternative per position
+                        # (is_greedy for eval harnesses); entry 0 of an
+                        # echo is null like its token_logprob.
+                        'top_logprobs': [
+                            None if t is None else {tstr(t[0]): t[1]}
+                            for t in tops
+                        ],
+                        'text_offset': offsets,
+                    }
             self._json(200, {'id': rid, 'object': kind,
                              'created': int(time.time()),
                              'model': model_name,
@@ -761,7 +834,9 @@ def _make_handler(server: InferenceServer):
             req = Request(tokens=tokens, max_new_tokens=max_new,
                           temperature=temperature,
                           request_id=uuid.uuid4().hex,
-                          adapter=payload.get('adapter'))
+                          adapter=payload.get('adapter'),
+                          want_prompt_logprobs=bool(
+                              payload.get('prompt_logprobs')))
             if payload.get('stream'):
                 # Admit BEFORE the SSE 200 goes out: a shed must be a
                 # clean 429 the client (and LB) can act on.
@@ -796,6 +871,10 @@ def _make_handler(server: InferenceServer):
                 'latency_s': res.latency_s,
                 'finish_reason': res.finish_reason,
             }
+            if payload.get('logprobs'):
+                out['logprobs'] = res.logprobs
+            if payload.get('prompt_logprobs'):
+                out['prompt_logprobs'] = res.prompt_logprobs
             if server.tokenizer is not None:
                 out['text'] = server.tokenizer.decode(res.output_tokens)
             self._json(200, out)

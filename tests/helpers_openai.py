@@ -1,0 +1,56 @@
+"""Shared helpers for tests that drive the inference server's HTTP
+surface (imported by test_infer.py and test_serve.py — one definition,
+no copies to drift)."""
+import threading
+import time
+import urllib.request
+
+
+class Tok:
+    """Minimal offline tokenizer stub (the handler only uses encode/
+    decode/apply_chat_template/eos_token_id)."""
+    eos_token_id = None
+
+    def encode(self, text):
+        return [1 + (ord(c) % 90) for c in text] or [1]
+
+    def decode(self, toks):
+        return ''.join(chr(97 + (t % 26)) for t in toks)
+
+    def apply_chat_template(self, messages, tokenize=True,
+                            add_generation_prompt=True):
+        return self.encode(''.join(m['content'] for m in messages))
+
+
+def start_openai_server(model_config, port, tokenizer=None, num_slots=4,
+                        max_cache_len=64, prefill_buckets=(8, 16, 32),
+                        max_new_tokens=8, rng_seed=7):
+    """Engine + live HTTP server on 127.0.0.1:port; blocks until
+    /health answers.  Returns the engine (daemon threads die with the
+    test process)."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import InferConfig, InferenceEngine
+    from skypilot_tpu.infer import server as srv_mod
+    eng = InferenceEngine(
+        model_config,
+        InferConfig(num_slots=num_slots, max_cache_len=max_cache_len,
+                    prefill_buckets=prefill_buckets,
+                    max_new_tokens=max_new_tokens,
+                    cache_dtype=jnp.float32),
+        rng=jax.random.PRNGKey(rng_seed))
+    threading.Thread(target=srv_mod.serve, args=(eng,),
+                     kwargs={'host': '127.0.0.1', 'port': port,
+                             'tokenizer': tokenizer},
+                     daemon=True).start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            if urllib.request.urlopen(
+                    f'http://127.0.0.1:{port}/health',
+                    timeout=3).status == 200:
+                return eng
+        except Exception:  # noqa: BLE001 — still starting
+            time.sleep(0.2)
+    raise TimeoutError('server did not become ready')

@@ -1115,46 +1115,8 @@ def test_prefix_cache_lane_batched_burst(tiny_config):
 # ------------------------------------------------------ OpenAI-compat API
 
 
-class _Tok:
-    """Minimal offline tokenizer stub (the handler only uses encode/
-    decode/apply_chat_template/eos_token_id)."""
-    eos_token_id = None
-
-    def encode(self, text):
-        return [1 + (ord(c) % 90) for c in text] or [1]
-
-    def decode(self, toks):
-        return ''.join(chr(97 + (t % 26)) for t in toks)
-
-    def apply_chat_template(self, messages, tokenize=True,
-                            add_generation_prompt=True):
-        return self.encode(''.join(m['content'] for m in messages))
-
-
-def _openai_server(tiny_config, port, tokenizer=None):
-    from skypilot_tpu.infer import server as srv_mod
-    eng = InferenceEngine(
-        tiny_config,
-        InferConfig(num_slots=4, max_cache_len=64,
-                    prefill_buckets=(8, 16, 32), max_new_tokens=8,
-                    cache_dtype=jnp.float32),
-        rng=jax.random.PRNGKey(7))
-    t = threading.Thread(target=srv_mod.serve, args=(eng,),
-                         kwargs={'host': '127.0.0.1', 'port': port,
-                                 'tokenizer': tokenizer},
-                         daemon=True)
-    t.start()
-    import time as _time
-    deadline = _time.time() + 120
-    while _time.time() < deadline:
-        try:
-            if urllib.request.urlopen(
-                    f'http://127.0.0.1:{port}/health',
-                    timeout=3).status == 200:
-                return eng
-        except Exception:
-            _time.sleep(0.2)
-    raise TimeoutError('server did not become ready')
+from helpers_openai import Tok as _Tok  # noqa: E402 (shared stub)
+from helpers_openai import start_openai_server as _openai_server  # noqa: E402,E501
 
 
 def _post(port, path, body, raw=False):
@@ -1301,3 +1263,91 @@ def test_openai_stream_stop_straddling_windows(tiny_config):
     assert stop not in got
     assert chunks[-1]['choices'][0]['finish_reason'] == \
         want['finish_reason']
+
+
+# ------------------------------------------------------------- logprobs
+
+
+def test_logprobs_match_full_forward(tiny_config):
+    """Generated-token and prompt logprobs from the engine equal the
+    full-forward log_softmax (the lm-eval loglikelihood contract)."""
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=6, cache_dtype=jnp.float32)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(7))
+    prompt = [3, 1, 4, 1, 5]
+    [res] = eng.generate([Request(tokens=list(prompt), max_new_tokens=4,
+                                  want_prompt_logprobs=True)])
+    assert len(res.logprobs) == 4
+    assert res.prompt_logprobs[0] is None
+    assert len(res.prompt_logprobs) == len(prompt)
+    m, params = eng.model, eng.params
+    seq = list(prompt)
+    for t, tok in enumerate(res.output_tokens):
+        logits = np.asarray(m.apply(params, jnp.asarray([seq]))[0, -1])
+        want = logits[tok] - np.log(np.exp(logits - logits.max()).sum()) \
+            - logits.max()
+        np.testing.assert_allclose(res.logprobs[t], want, atol=1e-3)
+        seq.append(tok)
+    logits_all = np.asarray(m.apply(params, jnp.asarray([prompt]))[0])
+    for t in range(1, len(prompt)):
+        row = logits_all[t - 1]
+        want = row[prompt[t]] - np.log(np.exp(row - row.max()).sum()) \
+            - row.max()
+        np.testing.assert_allclose(res.prompt_logprobs[t], want,
+                                   atol=1e-3)
+    # Spec decode carries identical logprobs for identical tokens.
+    spec = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                    max_new_tokens=6, cache_dtype=jnp.float32,
+                    draft_len=3),
+        rng=jax.random.PRNGKey(7))
+    rep = [5, 6, 7, 8, 5, 6, 7, 8]
+    [r_p] = eng.generate([Request(tokens=list(rep), max_new_tokens=6)])
+    [r_s] = spec.generate([Request(tokens=list(rep), max_new_tokens=6)])
+    assert r_s.output_tokens == r_p.output_tokens
+    np.testing.assert_allclose(r_s.logprobs, r_p.logprobs, atol=1e-4)
+
+
+def test_openai_logprobs_echo_and_zero_max(tiny_config):
+    """The lm-eval pattern over HTTP: echo=True, logprobs=1,
+    max_tokens=0 returns prompt token logprobs and nothing generated."""
+    import urllib.error
+    _openai_server(tiny_config, 8182, tokenizer=_Tok())
+    out = _post(8182, '/v1/completions',
+                {'prompt': 'abcde', 'max_tokens': 0, 'echo': True,
+                 'logprobs': 1})
+    choice = out['choices'][0]
+    assert out['usage']['completion_tokens'] == 0
+    lp = choice['logprobs']
+    assert lp['token_logprobs'][0] is None
+    assert len(lp['token_logprobs']) == 5       # prompt only
+    assert all(isinstance(x, float) and x <= 0.0
+               for x in lp['token_logprobs'][1:])
+    assert len(lp['tokens']) == 5
+    # top_logprobs carries the k=1 argmax alternative per position
+    # (is_greedy support); text_offset aligns with tokens.
+    assert lp['top_logprobs'][0] is None
+    for entry, actual_lp in zip(lp['top_logprobs'][1:],
+                                lp['token_logprobs'][1:]):
+        assert isinstance(entry, dict) and len(entry) == 1
+        assert list(entry.values())[0] >= actual_lp - 1e-6
+    assert lp['text_offset'] == [
+        sum(len(t) for t in lp['tokens'][:i])
+        for i in range(len(lp['tokens']))]
+    # echo text prepends the (tokenizer-roundtripped) prompt.
+    t = _Tok()
+    assert choice['text'].startswith(t.decode(t.encode('abcde')))
+    # Generated logprobs without echo.
+    out2 = _post(8182, '/v1/completions',
+                 {'prompt': 'abcde', 'max_tokens': 4, 'logprobs': 1})
+    lp2 = out2['choices'][0]['logprobs']
+    assert len(lp2['token_logprobs']) == 4
+    assert all(x <= 0.0 for x in lp2['token_logprobs'])
+    # stream + logprobs is a clean 400.
+    try:
+        _post(8182, '/v1/completions',
+              {'prompt': 'ab', 'logprobs': 1, 'stream': True})
+        raise AssertionError('expected 400')
+    except urllib.error.HTTPError as e:
+        assert e.code == 400

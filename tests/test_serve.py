@@ -812,3 +812,80 @@ def test_autoscaler_suspended_while_update_in_progress(serve_home,
     # drain (5 alive > min 2) did NOT fire.
     assert mgr.downs == []
     assert mgr.ups == 1
+
+
+def test_openai_api_streams_through_load_balancer():
+    """The serve plane proxies the OpenAI surface transparently: a
+    /v1/completions SSE stream through the LB is byte-equivalent to
+    hitting the replica directly (chunked deltas + data: [DONE])."""
+    import jax.numpy as jnp
+    from helpers_openai import Tok, start_openai_server
+
+    from skypilot_tpu.models.llama import LlamaConfig
+
+    cfg_m = LlamaConfig(name='lb-openai', vocab_size=101, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=4,
+                        num_kv_heads=2, max_seq_len=128,
+                        tie_embeddings=True, dtype=jnp.float32)
+    start_openai_server(cfg_m, 8183, tokenizer=Tok(), num_slots=2,
+                        prefill_buckets=(8,))
+    policy = RoundRobinPolicy()
+    policy.set_ready_replicas(['http://127.0.0.1:8183'])
+    lb = load_balancer.SkyTpuLoadBalancer('http://unused', 0, policy)
+    srv = ThreadingHTTPServer(('127.0.0.1', 0), type(
+        'H', (BaseHTTPRequestHandler,), {
+            'protocol_version': 'HTTP/1.1',
+            'log_message': lambda self, *a: None,
+            'do_GET': lambda self: lb.handle_request(self),
+            'do_POST': lambda self: lb.handle_request(self),
+        }))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    lb_port = srv.server_address[1]
+    try:
+        body = json.dumps({'prompt': 'abcd', 'max_tokens': 6,
+                           'stream': True}).encode()
+
+        def sse(endpoint):
+            req = urllib.request.Request(
+                endpoint + '/v1/completions', data=body,
+                headers={'Content-Type': 'application/json'})
+            return urllib.request.urlopen(req, timeout=120).read()
+
+        direct = sse('http://127.0.0.1:8183')
+        through_lb = sse(f'http://127.0.0.1:{lb_port}')
+
+        def normalize(raw):
+            out = []
+            for line in raw.decode().split('\n\n'):
+                if not line.startswith('data: '):
+                    continue
+                payload = line[6:]
+                if payload == '[DONE]':
+                    out.append(payload)
+                    continue
+                obj = json.loads(payload)
+                obj.pop('id', None)        # fresh uuid per request
+                obj.pop('created', None)
+                out.append(obj)
+            return out
+
+        events = normalize(through_lb)
+        assert events[-1] == '[DONE]'
+        chunks = events[:-1]
+        text = ''.join(c['choices'][0]['text'] for c in chunks)
+        assert len(text) == 6
+        # The LB added no framing of its own: same event stream.
+        assert normalize(direct) == events
+        # Non-stream + /v1/models through the LB too.
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{lb_port}/v1/completions',
+            data=json.dumps({'prompt': 'abcd',
+                             'max_tokens': 6}).encode(),
+            headers={'Content-Type': 'application/json'})
+        out = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert out['choices'][0]['text'] == text
+        models = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{lb_port}/v1/models', timeout=30).read())
+        assert models['data'][0]['id'] == 'lb-openai'
+    finally:
+        srv.shutdown()
