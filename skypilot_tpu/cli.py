@@ -928,12 +928,24 @@ def infer():
 @click.option('--max-prefixes', type=int, default=16,
               help='Resident prefix-KV entries for POST /cache_prefix '
                    '(LRU-evicted; 0 disables prefix caching).')
+@click.option('--lora-rank', type=int, default=0,
+              help='Multi-LoRA serving: build the model with stacked '
+                   'rank-R adapters (POST /load_adapter to register; '
+                   '0 disables).')
+@click.option('--lora-max-adapters', type=int, default=8,
+              help='Resident adapter slots (--lora-rank).')
+@click.option('--adapter-dir', default=None,
+              help='Directory POST /load_adapter may read adapters '
+                   'from. Unset: runtime adapter loading is disabled '
+                   '(the API is unauthenticated; an open path would '
+                   'let any client probe the filesystem).')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
                 tensor_parallel, weight_dtype, profile,
                 prefills_per_gap, platform, max_ttft, max_queue,
-                draft_len, ngram_max, max_prefixes):
+                draft_len, ngram_max, max_prefixes, lora_rank,
+                lora_max_adapters, adapter_dir):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
@@ -952,7 +964,10 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      prefills_per_gap=prefills_per_gap,
                      platform=platform, max_ttft=max_ttft,
                      max_queue=max_queue, draft_len=draft_len,
-                     ngram_max=ngram_max, max_prefixes=max_prefixes)
+                     ngram_max=ngram_max, max_prefixes=max_prefixes,
+                     lora_rank=lora_rank,
+                     lora_max_adapters=lora_max_adapters,
+                     adapter_dir=adapter_dir)
 
 
 @infer.command('bench')

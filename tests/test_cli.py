@@ -181,3 +181,24 @@ def test_infer_profile_presets(runner, monkeypatch):
     assert r.exit_code == 0, r.output
     assert captured['num_slots'] == 12          # explicit wins
     assert captured['decode_steps'] == 8        # preset fills the rest
+
+
+def test_infer_serve_lora_flags(runner, monkeypatch):
+    """The DOCUMENTED multi-LoRA entry point (`skytpu infer serve
+    --lora-rank R`, examples/serve_lora.yaml) must accept the flags and
+    thread them through to the server (r3 advisor: the options were
+    missing and the shipped YAML crash-looped on 'No such option')."""
+    captured = {}
+
+    def fake_run(**kw):
+        captured.update(kw)
+
+    from skypilot_tpu.infer import server as infer_server
+    monkeypatch.setattr(infer_server, 'run', fake_run)
+    r = runner.invoke(cli.cli, [
+        'infer', 'serve', '--model', 'llama-debug', '--lora-rank', '8',
+        '--lora-max-adapters', '4', '--adapter-dir', '/adapters'])
+    assert r.exit_code == 0, r.output
+    assert captured['lora_rank'] == 8
+    assert captured['lora_max_adapters'] == 4
+    assert captured['adapter_dir'] == '/adapters'

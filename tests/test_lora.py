@@ -343,7 +343,8 @@ def test_multi_lora_http_server_e2e(tmp_path):
                     lora_rank=4, lora_alpha=8.0, lora_max_adapters=2),
         rng=jax.random.PRNGKey(7))
     t = threading.Thread(target=srv_mod.serve, args=(eng,),
-                         kwargs={'host': '127.0.0.1', 'port': 8185},
+                         kwargs={'host': '127.0.0.1', 'port': 8185,
+                                 'adapter_dir': str(tmp_path)},
                          daemon=True)
     t.start()
     deadline = time.time() + 120
@@ -361,7 +362,14 @@ def test_multi_lora_http_server_e2e(tmp_path):
             headers={'Content-Type': 'application/json'})
         return json.loads(urllib.request.urlopen(req, timeout=120).read())
 
-    assert post('/load_adapter', {'name': 'tuned', 'path': npz}) == \
+    # Paths resolve RELATIVE to the server's --adapter-dir allowlist;
+    # anything escaping it (absolute outside, ../ traversal) is a 400,
+    # and an in-dir absolute path is tolerated.
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post('/load_adapter', {'name': 'evil', 'path': '../a.npz'})
+    assert err.value.code == 400
+    assert post('/load_adapter', {'name': 'tuned', 'path': 'a.npz'}) == \
         {'adapter': 'tuned', 'slot': 0}
     models = json.loads(urllib.request.urlopen(
         'http://127.0.0.1:8185/v1/models', timeout=30).read())
