@@ -1,0 +1,112 @@
+"""Minimal lm-eval-harness loglikelihood client for the OpenAI API.
+
+Implements exactly the request pattern lm-eval's OpenAI adapter
+(lm_eval/models/openai_completions.py upstream) uses for
+`loglikelihood` scoring — the path HellaSwag/ARC/LAMBADA-style
+multiple-choice tasks take:
+
+    POST /v1/completions
+        prompt      = context_tokens + continuation_tokens
+        max_tokens  = 0          (score only, generate nothing)
+        echo        = True       (return prompt logprobs)
+        logprobs    = 1          (chosen + argmax alternative)
+
+and sums log P(continuation | context) over the continuation
+positions; `is_greedy` is whether every continuation token was the
+model's argmax (needed for tasks reporting exact-match greedy
+accuracy).
+
+The in-repo inference server serves this contract (engine
+want_prompt_logprobs path); lm-eval-harness itself is not vendored, so
+this client doubles as the compatibility artifact: anything it can
+score, the real harness can.  Usage:
+
+    python scripts/lm_eval_loglikelihood.py \
+        --endpoint http://HOST:8100 --context 5,6,7 \
+        --choices 8,9 10,11 12
+"""
+import argparse
+import json
+import urllib.request
+from typing import List, Sequence, Tuple
+
+
+def loglikelihood(endpoint: str, context: Sequence[int],
+                  continuation: Sequence[int],
+                  model: str = None,
+                  timeout: float = 120.0) -> Tuple[float, bool]:
+    """(sum of continuation logprobs, is_greedy) for one (context,
+    continuation) pair — the lm-eval `loglikelihood` primitive."""
+    context = [int(t) for t in context]
+    continuation = [int(t) for t in continuation]
+    if not context or not continuation:
+        raise ValueError('context and continuation must be non-empty')
+    body = {
+        'prompt': context + continuation,
+        'max_tokens': 0,
+        'echo': True,
+        'logprobs': 1,
+        'temperature': 0,
+    }
+    if model is not None:
+        body['model'] = model
+    req = urllib.request.Request(
+        endpoint.rstrip('/') + '/v1/completions',
+        data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json'})
+    out = json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+    lp = out['choices'][0]['logprobs']
+    token_lps = lp['token_logprobs']
+    tops = lp['top_logprobs']
+    n = len(continuation)
+    assert len(token_lps) == len(context) + n, (
+        'server must echo one logprob per prompt position')
+    cont_lps = token_lps[-n:]
+    total = float(sum(cont_lps))
+    # is_greedy: at every continuation position the chosen token's
+    # logprob equals the argmax alternative's (argmax == chosen).
+    is_greedy = all(
+        tops[len(context) + i] is not None and
+        abs(max(tops[len(context) + i].values()) - cont_lps[i]) < 1e-6
+        for i in range(n))
+    return total, is_greedy
+
+
+def rank_choices(endpoint: str, context: Sequence[int],
+                 choices: Sequence[Sequence[int]],
+                 model: str = None) -> List[int]:
+    """Choice indices best-first by loglikelihood — the multiple-choice
+    accuracy primitive (argmax = the model's answer)."""
+    scores = [
+        loglikelihood(endpoint, context, c, model=model)[0]
+        for c in choices
+    ]
+    return sorted(range(len(choices)), key=lambda i: -scores[i])
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--endpoint', required=True)
+    parser.add_argument('--context', required=True,
+                        help='comma-separated token ids')
+    parser.add_argument('--choices', nargs='+', required=True,
+                        help='one comma-separated token list per choice')
+    parser.add_argument('--model', default=None)
+    args = parser.parse_args()
+    context = [int(t) for t in args.context.split(',')]
+    choices = [[int(t) for t in c.split(',')] for c in args.choices]
+    rows = []
+    for i, cont in enumerate(choices):
+        score, greedy = loglikelihood(args.endpoint, context, cont,
+                                      model=args.model)
+        rows.append({'choice': i, 'loglikelihood': score,
+                     'is_greedy': greedy})
+    # Rank from the scores in hand — no second scoring pass.
+    ranked = sorted(range(len(rows)),
+                    key=lambda i: -rows[i]['loglikelihood'])
+    print(json.dumps({'scores': rows, 'ranking': ranked,
+                      'answer': ranked[0]}))
+
+
+if __name__ == '__main__':
+    main()

@@ -113,6 +113,13 @@ class InferConfig:
     lora_rank: int = 0
     lora_max_adapters: int = 8
     lora_alpha: float = 16.0
+    # Alternatives reported per position in RequestResult.top_logprobs
+    # (OpenAI `logprobs`/`top_logprobs` k; the API caps requests at 5).
+    # STATIC at trace time — one jax.lax.top_k over the log-softmax the
+    # sampling path already computes, so the cost is one [B, V] top-k
+    # and a [B, K] transfer per step.  Entry 0 is always the argmax
+    # (is_greedy for eval harnesses).
+    logprob_topk: int = 5
     # Prefix KV caching: registered prefixes (system prompts) keep
     # their per-layer KV rows resident on device; a request whose
     # prompt starts with a registered prefix prefills ONLY its suffix —
@@ -164,17 +171,18 @@ class RequestResult:
     # on success — computed on-device next to sampling, cost is one
     # logsumexp the softmax path needs anyway).
     logprobs: Optional[List[float]] = None
-    # The argmax alternative at each generated position: (token_id,
-    # logprob) — the OpenAI top_logprobs k=1 entry (equals the chosen
-    # token for greedy requests; is_greedy for eval harnesses).
-    top_logprobs: Optional[List[Tuple[int, float]]] = None
+    # The top-k alternatives at each generated position: a list of
+    # (token_id, logprob) pairs, best first — entry 0 is the argmax
+    # (equals the chosen token for greedy requests; is_greedy for eval
+    # harnesses).  k = InferConfig.logprob_topk (OpenAI top_logprobs).
+    top_logprobs: Optional[List[List[Tuple[int, float]]]] = None
     # Prompt scores (want_prompt_logprobs): entry t is
     # log P(prompt_t | prompt_<t); entry 0 is None (no context).
     prompt_logprobs: Optional[List[Optional[float]]] = None
-    # Argmax alternative per prompt position (aligned with
+    # Top-k alternatives per prompt position (aligned with
     # prompt_logprobs; entry 0 is None).
-    prompt_top_logprobs: Optional[List[Optional[Tuple[int,
-                                                      float]]]] = None
+    prompt_top_logprobs: Optional[List[Optional[List[Tuple[
+        int, float]]]]] = None
 
 
 def prompt_lookup_draft(hist: Sequence[int], k: int,
@@ -203,6 +211,12 @@ def prompt_lookup_draft(hist: Sequence[int], k: int,
     return []
 
 
+def _pairs(ids_row, lps_row) -> List[Tuple[int, float]]:
+    """[k] ids + [k] logprobs -> [(id, lp), ...] best-first (the
+    host-side shape of one position's top_logprobs entry)."""
+    return [(int(i), float(l)) for i, l in zip(ids_row, lps_row)]
+
+
 class _Slot:
     __slots__ = ('request', 'length', 'generated', 'submit_time',
                  'first_token_time', 'max_new', 'streamed', 'lps',
@@ -218,7 +232,8 @@ class _Slot:
         self.max_new = max_new
         self.streamed = 0                  # tokens already stream_cb'd
         self.lps: List[float] = []         # logprob per generated token
-        self.tops: List[Tuple[int, float]] = []   # argmax alternative
+        # Per generated token: top-k (id, logprob) pairs, argmax first.
+        self.tops: List[List[Tuple[int, float]]] = []
         self.prompt_lps: Optional[list] = None
         self.prompt_tops: Optional[list] = None
 
@@ -282,6 +297,11 @@ class InferenceEngine:
         if self.cfg.draft_len and self.cfg.ngram_max < 1:
             raise ValueError(f'ngram_max must be >= 1 '
                              f'(got {self.cfg.ngram_max})')
+        if not 1 <= self.cfg.logprob_topk <= model_config.vocab_size:
+            raise ValueError(
+                f'logprob_topk must be in [1, vocab_size='
+                f'{model_config.vocab_size}] (got '
+                f'{self.cfg.logprob_topk})')
         # Speculation observability: dispatches that ran the verify path,
         # draft tokens offered, draft tokens accepted (acceptance rate =
         # accepted/offered; extra tok/dispatch = accepted/dispatches).
@@ -480,11 +500,16 @@ class InferenceEngine:
                                       axis=-1)[..., 0]
             return sel - logz
 
-        def greedy_and_lp(logits):
-            """(argmax token, its logprob): the top-1 alternative
-            reported as OpenAI top_logprobs (is_greedy for evals)."""
-            g = jnp.argmax(logits, axis=-1)
-            return g.astype(jnp.int32), chosen_logprob(logits, g)
+        topk = self.cfg.logprob_topk
+
+        def topk_lp(logits):
+            """([..., k] token ids, [..., k] logprobs), best first: the
+            OpenAI top_logprobs alternatives (entry 0 = argmax, so
+            is_greedy for evals is free).  One top-k over the same
+            log-softmax the sampling path computes."""
+            logz = jax.scipy.special.logsumexp(logits, axis=-1)
+            vals, ids = jax.lax.top_k(logits, topk)
+            return ids.astype(jnp.int32), vals - logz[..., None]
 
         def prefill_insert(params, tokens, true_lens, pcache, cache,
                            slots, temps, rng, adapter_ids, want_plp):
@@ -507,18 +532,18 @@ class InferenceEngine:
                 rng, last / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
             first = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
             first_lp = chosen_logprob(last, first)
-            first_top = (greedy, chosen_logprob(last, greedy))
+            first_top = topk_lp(last)                    # [P, k] x2
             if want_plp:   # STATIC: prompt scoring is a full [P,S,V]
                 # reduction pass + transfer — only when a request in
                 # the chunk asked (position t-1 predicts token t).
                 prompt_lps = chosen_logprob(logits[:, :-1],
                                             tokens[:, 1:])  # [P, S-1]
-                prompt_tops = greedy_and_lp(logits[:, :-1])
+                prompt_tops = topk_lp(logits[:, :-1])    # [P, S-1, k]
             else:
                 p_ = tokens.shape[0]
                 prompt_lps = jnp.zeros((p_, 0), jnp.float32)
-                prompt_tops = (jnp.zeros((p_, 0), jnp.int32),
-                               jnp.zeros((p_, 0), jnp.float32))
+                prompt_tops = (jnp.zeros((p_, 0, topk), jnp.int32),
+                               jnp.zeros((p_, 0, topk), jnp.float32))
 
             new_cache = []
             for (k, v), (pk, pv) in zip(cache, pc):
@@ -556,14 +581,15 @@ class InferenceEngine:
                 next_tokens = jnp.where(temps > 0, sampled,
                                         greedy).astype(jnp.int32)
                 lp = chosen_logprob(logits, next_tokens)
-                g_lp = chosen_logprob(logits, greedy)
+                t_ids, t_lps = topk_lp(logits)               # [B, k]
                 return (cache, next_tokens, lengths + 1), (
-                    next_tokens, lp, greedy.astype(jnp.int32), g_lp)
+                    next_tokens, lp, t_ids, t_lps)
 
             keys = jax.random.split(rng, self.cfg.decode_steps)
             (cache, _, _), (toks, lps, gtoks, glps) = jax.lax.scan(
                 one_step, (cache, tokens, lengths), keys)
-            return toks, lps, gtoks, glps, cache             # [K, B] x4
+            # toks/lps [K, B]; gtoks/glps [K, B, topk]
+            return toks, lps, gtoks, glps, cache
 
         def spec_verify(params, cache, tokens, lengths, temps, rng,
                         adapter_ids):
@@ -586,8 +612,8 @@ class InferenceEngine:
             preds = jnp.where(temps[:, None] > 0, sampled,
                               greedy).astype(jnp.int32)
             preds_lp = chosen_logprob(logits, preds)         # [B, K]
-            g_lp = chosen_logprob(logits, greedy)
-            return preds, preds_lp, greedy.astype(jnp.int32), g_lp, cache
+            t_ids, t_lps = topk_lp(logits)                   # [B, K, k]
+            return preds, preds_lp, t_ids, t_lps, cache
 
         cache_dtype = self.cfg.cache_dtype
 
@@ -637,8 +663,7 @@ class InferenceEngine:
             first = jnp.where(temps > 0, sampled,
                               greedy).astype(jnp.int32)
             first_lp = chosen_logprob(last, first)
-            first_top = (greedy.astype(jnp.int32),
-                         chosen_logprob(last, greedy))
+            first_top = topk_lp(last)                    # [P, k] x2
             new_cache = []
             for (k, v), (pk2, pv2) in zip(cache, pc):
 
@@ -929,7 +954,7 @@ class InferenceEngine:
                 s.first_token_time = now
                 s.generated.append(int(first_np[i]))
                 s.lps.append(float(first_lp_np[i]))
-                s.tops.append((int(top_np[0][i]), float(top_np[1][i])))
+                s.tops.append(_pairs(top_np[0][i], top_np[1][i]))
                 self._slots[slot] = s
                 self._lengths[slot] = n
                 self._last_tokens[slot] = s.generated[0]
@@ -1033,14 +1058,12 @@ class InferenceEngine:
                     s.first_token_time = now
                     s.generated.append(int(first_np[i]))
                     s.lps.append(float(first_lp_np[i]))
-                    s.tops.append((int(top_np[0][i]),
-                                   float(top_np[1][i])))
+                    s.tops.append(_pairs(top_np[0][i], top_np[1][i]))
                     if req.want_prompt_logprobs:
                         s.prompt_lps = [None] + [
                             float(x) for x in plp_np[i, :n - 1]]
                         s.prompt_tops = [None] + [
-                            (int(ptop_np[0][i, t]),
-                             float(ptop_np[1][i, t]))
+                            _pairs(ptop_np[0][i, t], ptop_np[1][i, t])
                             for t in range(n - 1)]
                     self._slots[slot] = s
                     self._lengths[slot] = n
@@ -1125,8 +1148,7 @@ class InferenceEngine:
                 tok = int(toks_np[k, i])
                 s.generated.append(tok)
                 s.lps.append(float(lps_np[k, i]))
-                s.tops.append((int(gtoks_np[k, i]),
-                               float(glps_np[k, i])))
+                s.tops.append(_pairs(gtoks_np[k, i], glps_np[k, i]))
             self._lengths[i] = s.length
             self._last_tokens[i] = s.generated[-1]
 
@@ -1221,8 +1243,7 @@ class InferenceEngine:
                 s.length += 1
                 s.generated.append(int(preds_np[i, t]))
                 s.lps.append(float(preds_lp_np[i, t]))
-                s.tops.append((int(g_toks_np[i, t]),
-                               float(g_lps_np[i, t])))
+                s.tops.append(_pairs(g_toks_np[i, t], g_lps_np[i, t]))
             self._lengths[i] = s.length
             self._last_tokens[i] = s.generated[-1]
         dispatch_drafted = int(drafted.sum())

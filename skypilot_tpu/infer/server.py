@@ -428,19 +428,42 @@ def _make_handler(server: InferenceServer):
             try:
                 max_new = payload.get('max_tokens', 16)
                 max_new = None if max_new is None else int(max_new)
-                temperature = float(payload.get('temperature', 0.0))
+                # OpenAI/vLLM default: sample at temperature 1.0.  A
+                # client wanting greedy must ask for it — defaulting to
+                # 0.0 silently made every temperature-less request
+                # greedy (r3 advisor).  (The native /generate API keeps
+                # its documented greedy default.)
+                temperature = float(payload.get('temperature', 1.0))
                 stop = payload.get('stop') or []
                 if isinstance(stop, str):
                     stop = [stop]
                 stop = [str(s) for s in stop]
-                want_lp = bool(payload.get('logprobs'))
+                # Completions `logprobs` is an int (alternatives per
+                # position, OpenAI caps it at 5); tolerate bool True as
+                # 1.  None/False/absent = no logprobs.
+                lp_raw = payload.get('logprobs')
+                if lp_raw is None or lp_raw is False:
+                    lp_k = None
+                elif lp_raw is True:
+                    lp_k = 1
+                else:
+                    lp_k = int(lp_raw)
                 echo = bool(payload.get('echo'))
             except (TypeError, ValueError) as e:
                 self._json(400, {'error': {'message': f'bad field: {e}',
                                            'type': 'invalid_request_error'}})
                 return None
-            opts = {'logprobs': want_lp, 'echo': echo,
-                    'zero_max': max_new == 0}
+            want_lp = lp_k is not None
+            max_k = min(5, server.engine.cfg.logprob_topk)
+            if want_lp and not 0 <= lp_k <= max_k:
+                # Never silently return fewer alternatives than asked
+                # (r3: k>1 requests got k=1 without an error).
+                self._json(400, {'error': {
+                    'message': f'logprobs must be between 0 and {max_k}',
+                    'type': 'invalid_request_error'}})
+                return None
+            opts = {'logprobs': want_lp, 'logprob_k': lp_k or 0,
+                    'echo': echo, 'zero_max': max_new == 0}
             if opts['zero_max']:
                 # The engine always produces the prefill token; trim it
                 # from the response instead of rejecting the request.
@@ -637,14 +660,19 @@ def _make_handler(server: InferenceServer):
                     for s_ in strs:
                         offsets.append(pos)
                         pos += len(s_)
+                    # The requested k alternatives per position (the
+                    # engine computes logprob_topk, best first; entry 0
+                    # of an echo is null like its token_logprob).
+                    # k=0: OpenAI still returns the dicts, holding only
+                    # positions' chosen-token entries via token_logprobs
+                    # — we emit empty dicts, matching vLLM.
+                    k = opts['logprob_k']
                     choice['logprobs'] = {
                         'tokens': strs,
                         'token_logprobs': lps,
-                        # k=1: the argmax alternative per position
-                        # (is_greedy for eval harnesses); entry 0 of an
-                        # echo is null like its token_logprob.
                         'top_logprobs': [
-                            None if t is None else {tstr(t[0]): t[1]}
+                            None if t is None else
+                            {tstr(i): l for i, l in t[:k]}
                             for t in tops
                         ],
                         'text_offset': offsets,

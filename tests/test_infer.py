@@ -1,6 +1,7 @@
 """Inference engine: cached decode correctness, continuous batching, and
 the HTTP server surface (tier-2: everything on the CPU mesh)."""
 import json
+import os
 import threading
 import urllib.request
 
@@ -1133,7 +1134,8 @@ def test_openai_completions_token_array(tiny_config):
     import urllib.error
     eng = _openai_server(tiny_config, 8191)
     out = _post(8191, '/v1/completions',
-                {'prompt': [5, 6, 7, 8], 'max_tokens': 6})
+                {'prompt': [5, 6, 7, 8], 'max_tokens': 6,
+                 'temperature': 0})
     assert out['object'] == 'text_completion'
     choice = out['choices'][0]
     assert choice['finish_reason'] == 'length'
@@ -1164,12 +1166,12 @@ def test_openai_completions_token_array(tiny_config):
 def test_openai_completions_text_and_stop(tiny_config):
     _openai_server(tiny_config, 8190, tokenizer=_Tok())
     out = _post(8190, '/v1/completions',
-                {'prompt': 'abcd', 'max_tokens': 8})
+                {'prompt': 'abcd', 'max_tokens': 8, 'temperature': 0})
     text = out['choices'][0]['text']
     assert isinstance(text, str) and len(text) == 8
     # stop strings truncate and flip finish_reason to 'stop'.
     out2 = _post(8190, '/v1/completions',
-                 {'prompt': 'abcd', 'max_tokens': 8,
+                 {'prompt': 'abcd', 'max_tokens': 8, 'temperature': 0,
                   'stop': [text[2]]})
     assert out2['choices'][0]['finish_reason'] == 'stop'
     assert text[2] not in out2['choices'][0]['text']
@@ -1178,10 +1180,11 @@ def test_openai_completions_text_and_stop(tiny_config):
 def test_openai_completions_stream_matches_nonstream(tiny_config):
     _openai_server(tiny_config, 8189, tokenizer=_Tok())
     want = _post(8189, '/v1/completions',
-                 {'prompt': 'wxyz', 'max_tokens': 8})['choices'][0]['text']
+                 {'prompt': 'wxyz', 'max_tokens': 8,
+                  'temperature': 0})['choices'][0]['text']
     raw = _post(8189, '/v1/completions',
-                {'prompt': 'wxyz', 'max_tokens': 8, 'stream': True},
-                raw=True).decode()
+                {'prompt': 'wxyz', 'max_tokens': 8, 'temperature': 0,
+                 'stream': True}, raw=True).decode()
     events = [line[6:] for line in raw.split('\n\n')
               if line.startswith('data: ')]
     assert events[-1] == '[DONE]'
@@ -1196,14 +1199,15 @@ def test_openai_chat_completions(tiny_config):
     _openai_server(tiny_config, 8188, tokenizer=_Tok())
     out = _post(8188, '/v1/chat/completions',
                 {'messages': [{'role': 'user', 'content': 'hi'}],
-                 'max_tokens': 6})
+                 'max_tokens': 6, 'temperature': 0})
     assert out['object'] == 'chat.completion'
     msg = out['choices'][0]['message']
     assert msg['role'] == 'assistant' and len(msg['content']) == 6
     # Streaming: first delta carries the role; concatenation matches.
     raw = _post(8188, '/v1/chat/completions',
                 {'messages': [{'role': 'user', 'content': 'hi'}],
-                 'max_tokens': 6, 'stream': True}, raw=True).decode()
+                 'max_tokens': 6, 'temperature': 0,
+                 'stream': True}, raw=True).decode()
     events = [line[6:] for line in raw.split('\n\n')
               if line.startswith('data: ')]
     assert events[-1] == '[DONE]'
@@ -1222,7 +1226,7 @@ def test_openai_stream_token_only_and_bad_messages(tiny_config):
     eng = _openai_server(tiny_config, 8187)
     raw = _post(8187, '/v1/completions',
                 {'prompt': [5, 6, 7, 8], 'max_tokens': 6,
-                 'stream': True}, raw=True).decode()
+                 'temperature': 0, 'stream': True}, raw=True).decode()
     events = [line[6:] for line in raw.split('\n\n')
               if line.startswith('data: ')]
     assert events[-1] == '[DONE]'
@@ -1244,17 +1248,18 @@ def test_openai_stream_stop_straddling_windows(tiny_config):
     exactly like the non-stream path (held-back emission)."""
     _openai_server(tiny_config, 8186, tokenizer=_Tok())
     base = _post(8186, '/v1/completions',
-                 {'prompt': 'mnop', 'max_tokens': 12})['choices'][0]['text']
+                 {'prompt': 'mnop', 'max_tokens': 12,
+                  'temperature': 0})['choices'][0]['text']
     # A 2-char stop whose halves land in different windows (window = 8
     # decode steps -> single chars per event after BPE-free _Tok): pick
     # chars 3-4 of the continuation.
     stop = base[3:5]
     want = _post(8186, '/v1/completions',
-                 {'prompt': 'mnop', 'max_tokens': 12,
+                 {'prompt': 'mnop', 'max_tokens': 12, 'temperature': 0,
                   'stop': [stop]})['choices'][0]
     raw = _post(8186, '/v1/completions',
-                {'prompt': 'mnop', 'max_tokens': 12, 'stop': [stop],
-                 'stream': True}, raw=True).decode()
+                {'prompt': 'mnop', 'max_tokens': 12, 'temperature': 0,
+                 'stop': [stop], 'stream': True}, raw=True).decode()
     events = [line[6:] for line in raw.split('\n\n')
               if line.startswith('data: ')]
     chunks = [json.loads(e) for e in events[:-1]]
@@ -1351,3 +1356,99 @@ def test_openai_logprobs_echo_and_zero_max(tiny_config):
         raise AssertionError('expected 400')
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_openai_top_logprobs_k5(tiny_config):
+    """logprobs=5 returns five alternatives per position whose probs
+    are internally consistent: best-first, the top entry is >= the
+    chosen token's logprob, and the chosen token appears among the
+    alternatives with its exact token_logprob (greedy request)."""
+    import urllib.error
+    # Token-only server: top_logprobs keys are str(token_id), so five
+    # distinct alternatives stay five dict entries (a many-to-one
+    # tokenizer collapses colliding keys — inherent to OpenAI's
+    # dict-keyed format, not to the engine).
+    _openai_server(tiny_config, 8181)
+    out = _post(8181, '/v1/completions',
+                {'prompt': [5, 6, 7, 8], 'max_tokens': 4,
+                 'temperature': 0, 'logprobs': 5})
+    lp = out['choices'][0]['logprobs']
+    assert len(lp['token_logprobs']) == 4
+    for pos, (tok_s, tok_lp, top) in enumerate(zip(
+            lp['tokens'], lp['token_logprobs'], lp['top_logprobs'])):
+        assert isinstance(top, dict) and len(top) == 5, pos
+        vals = list(top.values())
+        assert vals == sorted(vals, reverse=True), pos   # best first
+        assert all(v <= 0.0 for v in vals), pos
+        # Greedy: the chosen token IS the argmax, with the same lp.
+        assert abs(vals[0] - tok_lp) < 1e-6, pos
+        assert tok_s in top and abs(top[tok_s] - tok_lp) < 1e-6, pos
+    # k beyond the server's cap is a loud 400, never silently fewer.
+    try:
+        _post(8181, '/v1/completions',
+              {'prompt': 'ab', 'logprobs': 6})
+        raise AssertionError('expected 400')
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # OpenAI default temperature is 1.0: two temperature-less sampled
+    # requests from one server almost surely diverge over 24 tokens
+    # (the r3 advisor found them silently greedy).
+    a = _post(8181, '/v1/completions',
+              {'prompt': [5, 6, 7], 'max_tokens': 24})
+    b = _post(8181, '/v1/completions',
+              {'prompt': [5, 6, 7], 'max_tokens': 24})
+    assert a['choices'][0]['tokens'] != b['choices'][0]['tokens']
+
+
+def test_lm_eval_loglikelihood_client_end_to_end(tiny_config):
+    """The shipped lm-eval mini-client (scripts/lm_eval_loglikelihood)
+    scores (context, continuation) pairs over live HTTP and its
+    loglikelihoods + ranking reproduce a direct full-forward
+    log-softmax ranking exactly (r3 verdict #5: prove the
+    echo+logprobs+max_tokens=0 path with a real consumer)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        'lm_eval_loglikelihood',
+        os.path.join(os.path.dirname(__file__), '..', 'scripts',
+                     'lm_eval_loglikelihood.py'))
+    client = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(client)
+
+    eng = _openai_server(tiny_config, 8180)
+    endpoint = 'http://127.0.0.1:8180'
+    context = [3, 1, 4, 1, 5]
+    choices = [[9, 2, 6], [5, 3], [5, 8, 9, 7], [2]]
+
+    # Direct full-forward reference: sum log softmax(logits)[token]
+    # over continuation positions (teacher forcing).
+    m, params = eng.model, eng.params
+    def direct_score(cont):
+        seq = context + list(cont)
+        logits = np.asarray(m.apply(params, jnp.asarray([seq]))[0])
+        total = 0.0
+        for i, tok in enumerate(cont):
+            row = logits[len(context) + i - 1]
+            total += float(row[tok] - np.log(np.exp(
+                row - row.max()).sum()) - row.max())
+        return total
+
+    want_scores = [direct_score(c) for c in choices]
+    got = [client.loglikelihood(endpoint, context, c) for c in choices]
+    for (score, _), want in zip(got, want_scores):
+        np.testing.assert_allclose(score, want, atol=1e-3)
+    want_rank = sorted(range(len(choices)), key=lambda i: -want_scores[i])
+    assert client.rank_choices(endpoint, context, choices) == want_rank
+
+    # is_greedy agrees with the engine's own greedy continuation: the
+    # greedy continuation IS greedy, a continuation diverging from it
+    # is not.
+    [res] = eng.generate([Request(tokens=list(context),
+                                  max_new_tokens=3)])
+    greedy_cont = res.output_tokens
+    _, greedy_flag = client.loglikelihood(endpoint, context, greedy_cont)
+    assert greedy_flag
+    diverged = list(greedy_cont)
+    diverged[0] = (diverged[0] + 1) % tiny_config.vocab_size
+    _, diverged_flag = client.loglikelihood(endpoint, context, diverged)
+    assert not diverged_flag

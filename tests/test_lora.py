@@ -379,7 +379,8 @@ def test_multi_lora_http_server_e2e(tmp_path):
     want = _greedy_ref(Llama(single_cfg), params_a, prompt, 6)
     via_openai = post('/v1/completions',
                       {'model': 'tuned', 'prompt': list(prompt),
-                       'max_tokens': 6})['choices'][0]['tokens']
+                       'max_tokens': 6,
+                       'temperature': 0})['choices'][0]['tokens']
     via_native = post('/generate', {'tokens': list(prompt),
                                     'adapter': 'tuned',
                                     'max_new_tokens': 6})['output_tokens']
@@ -387,7 +388,8 @@ def test_multi_lora_http_server_e2e(tmp_path):
     # The base model still serves alongside (model field = base id).
     base_out = post('/v1/completions',
                     {'model': 'ml-http', 'prompt': list(prompt),
-                     'max_tokens': 6})['choices'][0]['tokens']
+                     'max_tokens': 6,
+                     'temperature': 0})['choices'][0]['tokens']
     base_params = Llama(base_cfg).init(jax.random.PRNGKey(7),
                                        jnp.zeros((1, 8), jnp.int32))
     assert base_out == _greedy_ref(Llama(base_cfg), base_params, prompt, 6)

@@ -843,7 +843,7 @@ def test_openai_api_streams_through_load_balancer():
     lb_port = srv.server_address[1]
     try:
         body = json.dumps({'prompt': 'abcd', 'max_tokens': 6,
-                           'stream': True}).encode()
+                           'temperature': 0, 'stream': True}).encode()
 
         def sse(endpoint):
             req = urllib.request.Request(
@@ -879,7 +879,7 @@ def test_openai_api_streams_through_load_balancer():
         # Non-stream + /v1/models through the LB too.
         req = urllib.request.Request(
             f'http://127.0.0.1:{lb_port}/v1/completions',
-            data=json.dumps({'prompt': 'abcd',
+            data=json.dumps({'prompt': 'abcd', 'temperature': 0,
                              'max_tokens': 6}).encode(),
             headers={'Content-Type': 'application/json'})
         out = json.loads(urllib.request.urlopen(req, timeout=120).read())
