@@ -92,11 +92,18 @@ def launch(task_or_dag: Union[Task, dag_lib.Dag],
         controller_utils.JOBS_CONTROLLER)
     logger.info('%s Submitting managed job %r to controller %r.',
                 ux.emph('[jobs]'), dag.name, controller_name)
-    job_id = execution.launch(controller_task,
-                              cluster_name=controller_name,
-                              detach_run=True,
-                              stream_logs=stream_logs,
-                              fast=True)
+    job_id = execution.launch(
+        controller_task,
+        cluster_name=controller_name,
+        detach_run=True,
+        stream_logs=stream_logs,
+        fast=True,
+        # Idle controllers stop themselves (stop, not down: state
+        # survives; the next launch restarts the VM).  Parity:
+        # sky/jobs/core.py:142.
+        idle_minutes_to_autostop=(
+            controller_utils.controller_autostop_minutes(
+                controller_utils.JOBS_CONTROLLER)))
     assert job_id is not None
     # Register job info on the controller so queue/cancel know the name
     # even before the controller process initializes its tasks.

@@ -76,13 +76,24 @@ class AutostopEvent(PodletEvent):
     """
     interval_seconds = 20
 
+    def __init__(self):
+        super().__init__()
+        # A resumed cluster still carries the PREVIOUS life's
+        # autostop.json (idle, set_at long past): without counting this
+        # daemon's own boot as activity, the first tick would re-stop
+        # the cluster while the resuming launch is still in SETUP.
+        self._boot = time.time()
+
     def run(self) -> None:
+        if time.time() - self._boot < self.interval_seconds:
+            return   # startup grace: never fire on the boot tick
         config = autostop_lib.get_autostop_config()
         if config is None or config.idle_minutes < 0:
             return
         if not job_lib.is_idle():
             return
-        idle_since = max(job_lib.last_activity_time(), config.set_at)
+        idle_since = max(job_lib.last_activity_time(), config.set_at,
+                        self._boot)
         idle_minutes = (time.time() - idle_since) / 60.0
         if idle_minutes < config.idle_minutes:
             return
@@ -108,5 +119,12 @@ class AutostopEvent(PodletEvent):
             provision.stop_instances(info.provider, info.cluster_name)
         # The cluster (including this daemon's host) is gone/stopping; exit
         # cleanly.  SystemExit passes through maybe_run's exception guard.
+        # Drop the pid file first so a stop->resume's liveness probe
+        # cannot race our (possibly zombie-lingering) exit.
+        from skypilot_tpu.podlet import daemon as daemon_lib
+        try:
+            os.remove(os.path.expanduser(daemon_lib.PID_FILE))
+        except OSError:
+            pass
         logger.info('Autostop teardown complete; podlet exiting.')
         raise SystemExit(0)

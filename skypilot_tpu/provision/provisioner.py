@@ -261,7 +261,15 @@ def _start_podlet(cluster_name: str, head: runner_lib.CommandRunner,
         f'CUR=$(cat ~/.skytpu/podlet/version.token 2>/dev/null || echo none); '
         f'PID=$(cat ~/.skytpu/podlet/pid 2>/dev/null || true); '
         f'ALIVE=no; '
-        f'if [ -n "$PID" ] && kill -0 "$PID" 2>/dev/null; then ALIVE=yes; fi; '
+        # kill -0 alone counts ZOMBIES as alive: a nohup-orphaned daemon
+        # that exited (autostop) but has not been reaped by pid 1 yet
+        # still sits in the process table, and a stop->resume would then
+        # skip the restart, leaving the cluster daemon-less (jobs pend
+        # forever).  Alive = ps reports a non-empty, non-Z state (an
+        # empty stat means gone/reaped mid-probe — dead, not alive).
+        f'if [ -n "$PID" ]; then '
+        f'STAT=$(ps -o stat= -p "$PID" 2>/dev/null | tr -d \' \'); '
+        f'case "$STAT" in ""|Z*) ;; *) ALIVE=yes ;; esac; fi; '
         f'if [ "$CUR" != "{token}" ] || [ "$ALIVE" != yes ]; then '
         f'  if [ -n "$PID" ]; then kill "$PID" 2>/dev/null || true; fi; '
         f'  nohup python3 -m skypilot_tpu.podlet.daemon '

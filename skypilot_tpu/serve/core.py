@@ -109,8 +109,15 @@ def up(task: Task,
     logger.info('%s Launching service %r on controller %r.',
                 ux.emph('[serve]'), service_name, controller_name)
     try:
-        execution.launch(controller_task, cluster_name=controller_name,
-                         detach_run=True, stream_logs=False, fast=True)
+        execution.launch(
+            controller_task, cluster_name=controller_name,
+            detach_run=True, stream_logs=False, fast=True,
+            # Idle controllers stop themselves once every service is
+            # gone (stop, not down: the serve state DB survives).
+            # Parity: sky/serve/core.py:202-208.
+            idle_minutes_to_autostop=(
+                controller_utils.controller_autostop_minutes(
+                    controller_utils.SERVE_CONTROLLER)))
     finally:
         os.remove(local_yaml)
 

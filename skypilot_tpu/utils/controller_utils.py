@@ -32,6 +32,28 @@ JOBS_CONTROLLER = ControllerSpec(kind='jobs', config_key='jobs',
 SERVE_CONTROLLER = ControllerSpec(kind='serve', config_key='serve',
                                   default_cpus='4+')
 
+# Idle-autostop default for controller VMs (parity: the reference's
+# CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP, applied at sky/jobs/core.py:142
+# and sky/serve/core.py:202-208): an idle controller stops itself and
+# stops billing.  STOP, never down — its SQLite state (managed-job
+# history, service records) must survive, and the next jobs.launch /
+# serve.up reprovisions the stopped VM back up.  A controller is only
+# idle once every managed job / service process has finished (each is
+# a long-lived podlet job, and podlet job_lib.is_idle gates the event).
+CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP = 10
+
+
+def controller_autostop_minutes(spec: ControllerSpec) -> Optional[int]:
+    """Idle minutes before the controller stops itself, or None when
+    disabled (config `<kind>.controller.autostop_minutes`: a negative
+    value disables; unset = the default)."""
+    minutes = config_lib.get_nested(
+        (spec.config_key, 'controller', 'autostop_minutes'),
+        CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP)
+    if minutes is None or int(minutes) < 0:
+        return None
+    return int(minutes)
+
 # Shell prefix every controller-side command starts with: the controller
 # process must (1) use the host-local state root — NOT any SKYTPU_HOME that
 # leaked in from the client via the podlet daemon's environment — and

@@ -276,3 +276,57 @@ def test_managed_pipeline_two_stage_chain(local_jobs, skytpu_home):
     assert rows['stage1']['end_at'] is not None
     assert rows['stage2']['start_at'] is not None
     assert rows['stage2']['start_at'] >= rows['stage1']['end_at'], rows
+
+
+@pytest.mark.e2e
+def test_controller_idle_autostop_and_restart(local_jobs, skytpu_home):
+    """The jobs controller stops itself once idle (STOP, not down — the
+    managed-job history must survive) and the next jobs.launch restarts
+    the stopped VM.  Parity: the reference launches controllers with
+    idle_minutes_to_autostop (sky/jobs/core.py:142)."""
+    import yaml as yaml_lib
+
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu import core, jobs
+    from skypilot_tpu.status_lib import ClusterStatus
+    from skypilot_tpu.utils import controller_utils
+
+    # autostop_minutes 0: stop as soon as the podlet's AutostopEvent
+    # (20 s tick) sees the controller idle.
+    with open(os.path.join(skytpu_home, 'config.yaml'), 'w',
+              encoding='utf-8') as f:
+        yaml_lib.safe_dump(
+            {'jobs': {'controller': {'autostop_minutes': 0}}}, f)
+    config_lib.reload()
+    task = Task('as1', run='echo one')
+    task.set_resources(Resources(cloud='local'))
+    job_id = jobs.launch(task, stream_logs=False)
+    _wait_status(jobs, job_id, 'SUCCEEDED')
+
+    name = controller_utils.controller_cluster_name(
+        controller_utils.JOBS_CONTROLLER)
+    rec = state.get_cluster_from_name(name)
+    assert rec['autostop'] == 0 and not rec['to_down']
+
+    status = None
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        status = core.status(name, refresh=True)[0]['status']
+        if status == ClusterStatus.STOPPED:
+            break
+        time.sleep(2)
+    assert status == ClusterStatus.STOPPED, status
+
+    # The next launch restarts the stopped controller (full provision
+    # path: run_instances resumes, the podlet comes back) and the old
+    # job history is still there — the stop preserved controller state.
+    task2 = Task('as2', run='echo two')
+    task2.set_resources(Resources(cloud='local'))
+    job2 = jobs.launch(task2, stream_logs=False)
+    # Cancel autostop NOW (inside the restarted daemon's 20 s boot
+    # grace) so the post-success queue RPC below cannot race a second
+    # idle-stop tick — the stop behavior itself is already proven above.
+    core.autostop(name, -1)
+    _wait_status(jobs, job2, 'SUCCEEDED')
+    names = {r['job_name'] for r in jobs.queue()}
+    assert {'as1', 'as2'} <= names
