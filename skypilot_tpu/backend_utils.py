@@ -11,6 +11,7 @@ State machine inputs per refresh:
   (b) podlet daemon liveness on the head host;
 outputs: UP | INIT | STOPPED | <record removed>.
 """
+import functools
 import typing
 from typing import Dict, List, Optional
 
@@ -110,6 +111,56 @@ def refresh_cluster_status_handle(cluster_name: str):
     return record['status'], record['handle']
 
 
+@functools.lru_cache(maxsize=None)
+def _active_identity_cached(cloud_name: str):
+    """Per-process memo of the active cloud identity: the GCP lookup
+    shells out to gcloud (10 s timeout worst case), and every mutating
+    op runs the owner check — one subprocess per process, not per op.
+    An account switch mid-process is not observed, matching the
+    reference's per-process identity caching."""
+    from skypilot_tpu.clouds import Cloud
+    return Cloud.from_name(cloud_name).get_active_user_identity()
+
+
+def check_owner_identity(cluster_name: str) -> None:
+    """Raise ClusterOwnerIdentityMismatchError when the ACTIVE cloud
+    identity differs from the identity that created the cluster — a
+    second gcloud account must not silently mutate another user's
+    clusters.  Parity: reference check_owner_identity
+    (sky/backends/backend_utils.py:1421).
+
+    Identity-less clouds skip the check; records from before identities
+    were recorded (or whose stored owner is the legacy user hash) are
+    backfilled with the active identity instead of rejected."""
+    import json
+
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return
+    launched = getattr(record['handle'], 'launched_resources', None)
+    if launched is None or launched.cloud is None:
+        return
+    active = _active_identity_cached(launched.cloud)
+    if not active:
+        return
+    stored = record.get('owner')
+    try:
+        stored_list = json.loads(stored) if stored else None
+    except (TypeError, ValueError):
+        stored_list = None
+    if not isinstance(stored_list, list) or not stored_list:
+        state.set_cluster_owner(cluster_name, json.dumps(active))
+        return
+    # Element 0 is the primary identity (e.g. the gcloud account); the
+    # rest is context (project id) and must not satisfy the check.
+    if str(stored_list[0]) != str(active[0]):
+        raise exceptions.ClusterOwnerIdentityMismatchError(
+            f'Cluster {cluster_name!r} was created by cloud identity '
+            f'{stored_list[0]!r}, but the active identity is '
+            f'{active[0]!r}. Switch back (e.g. `gcloud config set '
+            f'account {stored_list[0]}`) before mutating this cluster.')
+
+
 def check_cluster_available(cluster_name: str):
     """Raise unless the cluster exists and is UP; returns its handle.
     Parity: backend_utils.check_cluster_available (:2032)."""
@@ -117,6 +168,7 @@ def check_cluster_available(cluster_name: str):
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} does not exist.')
+    check_owner_identity(cluster_name)
     status, handle = refresh_cluster_status_handle(cluster_name)
     if status is None:
         raise exceptions.ClusterDoesNotExist(

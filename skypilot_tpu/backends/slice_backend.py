@@ -233,6 +233,10 @@ class SliceBackend(Backend[SliceResourceHandle]):
         with locks.cluster_status_lock(cluster_name):
             existing = state.get_cluster_from_name(cluster_name)
             if existing is not None:
+                # A second cloud identity must not reuse (and thereby
+                # mutate) another user's cluster.
+                from skypilot_tpu import backend_utils
+                backend_utils.check_owner_identity(cluster_name)
                 handle = existing['handle']
                 launched = handle.launched_resources
                 wanted_ok = any(
@@ -264,8 +268,18 @@ class SliceBackend(Backend[SliceResourceHandle]):
                 task, candidates, retry_until_up, num_slices=width)
             handle = SliceResourceHandle(cluster_name, cand.resources,
                                          launched_nodes=width)
+            # Record the creating cloud identity (owner) so later
+            # mutating ops can detect an account switch
+            # (backend_utils.check_owner_identity).
+            import json as json_lib
+
+            from skypilot_tpu.clouds import Cloud
+            identity = Cloud.from_name(
+                cand.resources.cloud).get_active_user_identity()
+            owner = json_lib.dumps(identity) if identity else None
             state.add_or_update_cluster(cluster_name, handle,
-                                        set(task.resources), ready=False)
+                                        set(task.resources), ready=False,
+                                        owner=owner)
             try:
                 info = provision.get_cluster_info(cand.resources.cloud,
                                                   cand.region, cand.zone,

@@ -33,6 +33,7 @@ def start(cluster_name: str, retry_until_up: bool = False) -> None:
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} does not exist.')
+    backend_utils.check_owner_identity(cluster_name)
     handle = record['handle']
     if handle.launched_resources.is_tpu:
         raise exceptions.NotSupportedError(
@@ -64,6 +65,7 @@ def stop(cluster_name: str, purge: bool = False) -> None:
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} does not exist.')
+    backend_utils.check_owner_identity(cluster_name)
     SliceBackend().teardown(record['handle'], terminate=False, purge=purge)
 
 
@@ -73,6 +75,7 @@ def down(cluster_name: str, purge: bool = False) -> None:
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} does not exist.')
+    backend_utils.check_owner_identity(cluster_name)
     SliceBackend().teardown(record['handle'], terminate=True, purge=purge)
 
 

@@ -84,9 +84,15 @@ def add_or_update_cluster(cluster_name: str,
                           cluster_handle: Any,
                           requested_resources: Optional[set],
                           ready: bool,
-                          is_launch: bool = True) -> None:
+                          is_launch: bool = True,
+                          owner: Optional[str] = None) -> None:
     """Record a (re)provisioned cluster.  Parity:
-    sky/global_user_state.py:139."""
+    sky/global_user_state.py:139.
+
+    owner: the creating cloud identity (JSON list from
+    Cloud.get_active_user_identity) — consulted by
+    backend_utils.check_owner_identity on mutating ops.  Kept on
+    conflict (first writer wins) unless explicitly given."""
     status = ClusterStatus.UP if ready else ClusterStatus.INIT
     now = int(time.time())
     handle_blob = pickle.dumps(cluster_handle)
@@ -105,17 +111,18 @@ def add_or_update_cluster(cluster_name: str,
             ' VALUES (?,?,?,?,?,'
             '  COALESCE((SELECT autostop FROM clusters WHERE name=?), -1),'
             '  COALESCE((SELECT to_down FROM clusters WHERE name=?), 0),'
-            '  COALESCE((SELECT owner FROM clusters WHERE name=?), ?),'
+            '  COALESCE(?, (SELECT owner FROM clusters WHERE name=?), ?),'
             '  COALESCE((SELECT metadata FROM clusters WHERE name=?), \'{}\'),'
             '  ?, ?)'
             ' ON CONFLICT(name) DO UPDATE SET launched_at=excluded.launched_at,'
             ' handle=excluded.handle,'
             ' last_use=COALESCE(excluded.last_use, last_use),'
             ' status=excluded.status, cluster_hash=excluded.cluster_hash,'
+            ' owner=COALESCE(?, owner),'
             ' status_updated_at=excluded.status_updated_at',
             (cluster_name, launched_at, handle_blob, last_use, status.value,
-             cluster_name, cluster_name, cluster_name, common.get_user_hash(),
-             cluster_name, cluster_hash, now))
+             cluster_name, cluster_name, owner, cluster_name,
+             common.get_user_hash(), cluster_name, cluster_hash, now, owner))
         if requested_resources is not None:
             _record_history(conn, cluster_name, cluster_hash,
                             cluster_handle, requested_resources, now)
@@ -227,6 +234,15 @@ def _row_to_record(row) -> Dict[str, Any]:
         'cluster_hash': cluster_hash,
         'status_updated_at': status_updated_at,
     }
+
+
+def set_cluster_owner(cluster_name: str, owner: str) -> None:
+    """Record the creating cloud identity (JSON list) — the backfill
+    path of backend_utils.check_owner_identity."""
+    conn = _db()
+    with conn:
+        conn.execute('UPDATE clusters SET owner=? WHERE name=?',
+                     (owner, cluster_name))
 
 
 def set_cluster_autostop(cluster_name: str, idle_minutes: int,

@@ -32,11 +32,15 @@ def skytpu_home(tmp_path, monkeypatch):
     monkeypatch.setenv('SKYTPU_HOME', str(home))
     # Never let a test write the real ~/.ssh (ssh_config integration).
     monkeypatch.setenv('SKYTPU_SSH_DIR', str(tmp_path / '.ssh'))
-    from skypilot_tpu import config, state
+    from skypilot_tpu import backend_utils, config, state
     state.reset_for_tests()
     config.reload()
+    # The owner-identity memo must not leak a (possibly monkeypatched)
+    # identity from one test into the next.
+    backend_utils._active_identity_cached.cache_clear()
     yield str(home)
     state.reset_for_tests()
+    backend_utils._active_identity_cached.cache_clear()
 
 
 @pytest.fixture

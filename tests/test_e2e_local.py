@@ -241,3 +241,54 @@ def test_exec_on_missing_cluster_raises(tmp_path):
     task.set_resources(Resources(cloud='local'))
     with pytest.raises(exceptions.ClusterDoesNotExist):
         execution.exec_(task, 'nope')
+
+
+def test_launch_16_host_gang_full_slice_width(tmp_path):
+    """Gang fan-out at REAL slice width (r3 verdict #7): a v5e-64 is 16
+    hosts — parallel setup, rank env on every host, log fan-in from all
+    16, and gang-cancel at that width.  The reference handles this
+    per-IP fan-out via num_ips_per_node
+    (sky/backends/cloud_vm_ray_backend.py:4786)."""
+    task = Task(
+        'wide',
+        run='echo "start=$(date +%s.%N) rank=$SKYTPU_NODE_RANK '
+            'of $SKYTPU_NUM_NODES"')
+    task.set_resources(
+        Resources(cloud='local', accelerator='tpu-v5e-64'))
+    t0 = time.time()
+    job_id = execution.launch(task, cluster_name='wide16',
+                              detach_run=True, stream_logs=False)
+    assert _wait_job('wide16', job_id, timeout=180) == 'SUCCEEDED'
+    wall = time.time() - t0
+    log_dir = core.download_logs('wide16', job_id)
+    content = open(os.path.join(log_dir, 'run.log')).read()
+    starts = {}
+    for line in content.splitlines():
+        if 'start=' in line and 'rank=' in line:
+            parts = dict(kv.split('=') for kv in line.split()
+                         if '=' in kv)
+            starts[int(parts['rank'])] = float(parts['start'])
+    assert sorted(starts) == list(range(16)), sorted(starts)
+    assert all(f'rank={r} of 16' in content for r in range(16))
+    for rank in range(16):
+        assert os.path.exists(
+            os.path.join(log_dir, 'tasks', f'host{rank}.log')), rank
+    # Fan-out spread: the driver starts all 16 ranks near-concurrently
+    # (parallel fan-out), not serially.
+    spread = max(starts.values()) - min(starts.values())
+    assert spread < 10.0, f'fan-out spread {spread:.1f}s looks serial'
+    print(f'\n16-host gang: wall={wall:.1f}s fan-out spread='
+          f'{spread:.2f}s')
+
+    # Gang-cancel at width 16: one failing rank cancels the other 15
+    # long before their sleep would finish.
+    fail = Task(
+        'widefail',
+        run='if [ "$SKYTPU_NODE_RANK" = "7" ]; then exit 3; fi; sleep 60')
+    fail.set_resources(
+        Resources(cloud='local', accelerator='tpu-v5e-64'))
+    jid2 = execution.launch(fail, cluster_name='wide16', detach_run=True,
+                            stream_logs=False)
+    start = time.time()
+    assert _wait_job('wide16', jid2, timeout=60) == 'FAILED'
+    assert time.time() - start < 45

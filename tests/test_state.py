@@ -53,3 +53,62 @@ def test_kv_and_enabled_clouds():
     state.kv_set('x', {'a': 1})
     assert state.kv_get('x') == {'a': 1}
     assert state.kv_get('missing', 42) == 42
+
+
+def test_owner_identity_enforced(monkeypatch):
+    """A cluster created under one cloud identity rejects mutating ops
+    from a second identity; legacy records (no identity list) adopt the
+    active identity instead.  Parity: reference check_owner_identity
+    (sky/backends/backend_utils.py:1421)."""
+    import json
+
+    import pytest
+
+    from skypilot_tpu import backend_utils, exceptions
+    from skypilot_tpu.clouds import local as local_cloud
+    from skypilot_tpu.resources import Resources
+
+    h = FakeHandle('own1')
+    h.launched_resources = Resources(cloud='local')
+    state.add_or_update_cluster('own1', h, requested_resources={'r'},
+                                ready=True, owner=json.dumps(['alice']))
+
+    def set_identity(identity):
+        monkeypatch.setattr(local_cloud.Local, 'get_active_user_identity',
+                            lambda self: identity)
+        # The check memoizes the identity per process (gcloud lookups
+        # are expensive); an account switch needs a fresh cache.
+        backend_utils._active_identity_cached.cache_clear()
+
+    set_identity(['alice', 'ctx'])
+    backend_utils.check_owner_identity('own1')   # same identity: fine
+
+    set_identity(['bob', 'ctx'])
+    with pytest.raises(exceptions.ClusterOwnerIdentityMismatchError,
+                       match='alice'):
+        backend_utils.check_owner_identity('own1')
+    # check_cluster_available (the gate every mutating op goes through)
+    # surfaces the same error before any liveness probing.
+    with pytest.raises(exceptions.ClusterOwnerIdentityMismatchError):
+        backend_utils.check_cluster_available('own1')
+
+    # Context (element 1+) must NOT satisfy the check: same project,
+    # different account is still a mismatch.
+    set_identity(['carol', 'alice'])
+    with pytest.raises(exceptions.ClusterOwnerIdentityMismatchError):
+        backend_utils.check_owner_identity('own1')
+
+    # Legacy record (owner = old user hash, not a JSON list): the check
+    # backfills the active identity rather than rejecting.
+    h2 = FakeHandle('own2')
+    h2.launched_resources = Resources(cloud='local')
+    state.add_or_update_cluster('own2', h2, requested_resources={'r'},
+                                ready=True)
+    set_identity(['dave'])
+    backend_utils.check_owner_identity('own2')
+    assert json.loads(state.get_cluster_from_name('own2')['owner']) == \
+        ['dave']
+    # ...and from then on it IS enforced.
+    set_identity(['eve'])
+    with pytest.raises(exceptions.ClusterOwnerIdentityMismatchError):
+        backend_utils.check_owner_identity('own2')
