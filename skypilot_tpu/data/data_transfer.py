@@ -1,4 +1,5 @@
-"""Cross-cloud bucket ingestion: copy s3:// / r2:// / cos:// into GCS.
+"""Cross-cloud bucket ingestion: copy s3://, r2://, cos:// or azure://
+into GCS.
 
 Parity: sky/data/data_transfer.py:39-193 (GCS Transfer Service + rclone
 fallbacks).  TPU-first stance: the *serving* side of storage stays GCS —
@@ -14,6 +15,8 @@ Tool strategy (first available wins):
   r2://  -> `rclone` (Cloudflare R2 is S3-compatible but needs the
             account endpoint, which only rclone config carries).
   cos:// -> `rclone` (IBM COS, same reasoning).
+  azure:// -> `rclone` (azureblob backend; connection string / SAS in
+            rclone config).
 
 No cloud SDK imports: both tools are external binaries, matching the
 reference's delegation (SURVEY.md §2: rsync/rclone/goofys are processes,
@@ -27,7 +30,7 @@ from skypilot_tpu import exceptions, logsys
 
 logger = logsys.init_logger(__name__)
 
-_SUPPORTED_SCHEMES = ('s3://', 'r2://', 'cos://')
+_SUPPORTED_SCHEMES = ('s3://', 'r2://', 'cos://', 'azure://')
 
 
 def is_external_cloud_uri(uri: str) -> bool:
@@ -55,7 +58,8 @@ def _rclone_remote(scheme: str) -> str:
     """Conventional rclone remote name per scheme; users configure the
     matching remote once (`rclone config`) — same contract as the
     reference's rclone path (sky/data/data_transfer.py:150)."""
-    return {'s3': 's3', 'r2': 'r2', 'cos': 'cos'}[scheme]
+    return {'s3': 's3', 'r2': 'r2', 'cos': 'cos',
+            'azure': 'azure'}[scheme]
 
 
 def transfer_to_gcs(src_uri: str, dst_gcs_uri: str) -> None:

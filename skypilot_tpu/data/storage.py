@@ -3,11 +3,12 @@
 Parity: sky/data/storage.py (Storage :384, stores :1080-3138,
 StorageMode :192) — TPU-first: GCS is the default and the only
 MOUNTable store (gcsfuse on TPU VMs — the checkpoint/resume contract
-for managed jobs); **S3 and R2 are supported as destination stores**
-(`store: s3|r2`, data/stores.py) for task outputs and cross-cloud
-datasets, reached via gsutil/aws/rclone subprocesses.  External-cloud
-*sources* (s3:// / r2:// / cos://) ingest into a GCS bucket at upload
-time (data_transfer) when the destination store is GCS.
+for managed jobs); **s3/r2/azure/cos are destination stores** (`store:
+s3|r2|azure|cos`, data/stores.py — all five reference stores) for task
+outputs and cross-cloud datasets, reached via gsutil/aws/rclone
+subprocesses.  External-cloud *sources* (s3:// / r2:// / cos:// /
+azure://) ingest into a GCS bucket at upload time (data_transfer) when
+the destination store is GCS.
 """
 import enum
 import os
@@ -74,7 +75,7 @@ class Storage:
         self.persistent = persistent
         # Destination store: explicit `store:` wins; a gs:// source
         # implies gcs; everything else defaults to gcs.  Deliberately
-        # NOT inferred from s3://-r2://-cos:// sources: without an
+        # NOT inferred from s3://-r2://-cos://-azure:// sources: without an
         # explicit `store:`, those keep the TPU-first ingestion
         # semantics (copied INTO a GCS bucket at upload; the slice only
         # talks to GCS).  `store: s3` + `source: s3://b` means "use
@@ -98,7 +99,7 @@ class Storage:
                         f'{self.store_name!r}. To use a pre-existing '
                         f'bucket directly, make it the single string '
                         f'source with a matching store.')
-                # s3:// / r2:// / cos://: ingested into the GCS bucket
+                # s3:// / r2:// / cos:// / azure://: ingested into the GCS bucket
                 # at upload time (data_transfer.transfer_to_gcs) — the
                 # TPU slice itself only ever talks to GCS.  Parity:
                 # sky/data/data_transfer.py:39-193.
@@ -141,8 +142,8 @@ class Storage:
 
     def upload(self) -> None:
         """Sync local source(s) into the bucket; external-cloud sources
-        (s3:// / r2:// / cos://) are ingested via data_transfer when the
-        destination store is GCS."""
+        (s3:// / r2:// / cos:// / azure://) are ingested via
+        data_transfer when the destination store is GCS."""
         from skypilot_tpu.data import data_transfer
         self.ensure_bucket()
         if self._is_external_bucket:

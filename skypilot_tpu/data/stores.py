@@ -1,15 +1,16 @@
 """Destination stores: where a Storage object's bucket actually lives.
 
-Parity: sky/data/storage.py's store classes (S3Store :1080, GcsStore
-:1527, R2Store :2561) — reduced to the TPU-relevant contract.  The
-TPU-first stance is unchanged: GCS is the serving-side store (gcsfuse
-MOUNT on TPU VMs); S3 and R2 are DESTINATION stores for task outputs
-and cross-cloud datasets, reached through external tools exactly like
-the reference (gsutil speaks s3:// natively; R2 needs rclone's
-endpoint config) — no cloud SDK imports.
+Parity: sky/data/storage.py's five store classes (S3Store :1080,
+GcsStore :1527, AzureBlobStore :1973, R2Store :2752, IBMCosStore
+:3138) — reduced to the TPU-relevant contract.  The TPU-first stance
+is unchanged: GCS is the serving-side store (gcsfuse MOUNT on TPU
+VMs); s3/r2/azure/cos are DESTINATION stores for task outputs and
+cross-cloud datasets, reached through external tools exactly like the
+reference (gsutil speaks s3:// natively; r2/azure/cos go through a
+configured rclone remote) — no cloud SDK imports.
 
 MOUNT semantics: only GCS mounts on a TPU VM (gcsfuse).  A MOUNT
-request against an S3/R2 store degrades to COPY with a warning, the
+request against any other store degrades to COPY with a warning, the
 same contract as the FUSE-less-host downgrade (storage_mounting).
 """
 import shutil
@@ -153,26 +154,29 @@ class S3Store(Store):
                 f'aws s3 sync {uri} {d})')
 
 
-class R2Store(Store):
-    """Cloudflare R2 destination via rclone (S3-compatible, but the
-    account endpoint only rclone config carries — same contract as the
-    reference's R2 path and data_transfer's ingestion: a configured
-    'r2' remote)."""
+class RcloneStore(Store):
+    """Destinations reached through a configured rclone remote: the
+    remote's config carries what no generic tool can guess (R2 account
+    endpoint, Azure connection string / SAS, COS endpoint) — the same
+    contract as the reference's rclone paths and data_transfer's
+    ingestion.  Subclasses set NAME/SCHEME and the REMOTE name users
+    configure once with `rclone config`."""
 
-    NAME = 'r2'
-    SCHEME = 'r2://'
+    NAME = 'abstract'
+    REMOTE = ''
     MOUNTABLE = False
     MISSING_MARKERS = ('directory not found', "doesn't exist")
 
-    @staticmethod
-    def _remote_path(uri: str) -> str:
-        return 'r2:' + uri[len('r2://'):].rstrip('/')
+    @classmethod
+    def _remote_path(cls, uri: str) -> str:
+        return f'{cls.REMOTE}:' + uri[len(cls.SCHEME):].rstrip('/')
 
     def _tool(self, args: List[str]) -> subprocess.CompletedProcess:
         if not shutil.which('rclone'):
             raise exceptions.StorageError(
-                "rclone not found; r2:// buckets need rclone with an "
-                "'r2' remote configured (rclone config).")
+                f'rclone not found; {self.SCHEME} buckets need rclone '
+                f'with a {self.REMOTE!r} remote configured '
+                '(rclone config).')
         return _run(['rclone'] + args)
 
     def exists(self, uri: str) -> bool:
@@ -202,3 +206,39 @@ class R2Store(Store):
         return (f'mkdir -p {shlex.quote(dst)} && '
                 f'rclone copy --fast-list {self._remote_path(uri)} '
                 f'{shlex.quote(dst)}')
+
+
+class R2Store(RcloneStore):
+    """Cloudflare R2 (S3-compatible, but the account endpoint only
+    rclone config carries).  Parity: reference R2Store
+    (sky/data/storage.py:2752)."""
+
+    NAME = 'r2'
+    SCHEME = 'r2://'
+    REMOTE = 'r2'
+
+
+class AzureBlobStore(RcloneStore):
+    """Azure Blob destination via a configured 'azure' rclone remote
+    (azureblob backend: connection string / SAS / MSI live in rclone
+    config — no Azure SDK import).  Parity: reference AzureBlobStore
+    (sky/data/storage.py:1973), reduced to the TPU-relevant contract:
+    COPY destination for task outputs (blobfuse2 MOUNT is not assumed
+    on TPU images; MOUNT degrades to COPY like s3/r2)."""
+
+    NAME = 'azure'
+    SCHEME = 'azure://'
+    REMOTE = 'azure'
+
+
+class IbmCosStore(RcloneStore):
+    """IBM Cloud Object Storage destination via a configured 'cos'
+    rclone remote (S3-compatible; the endpoint lives in rclone
+    config).  Parity: reference IBMCosStore
+    (sky/data/storage.py:3138); cos:// was previously source-only
+    here (data_transfer ingestion) — this closes the destination
+    direction."""
+
+    NAME = 'cos'
+    SCHEME = 'cos://'
+    REMOTE = 'cos'

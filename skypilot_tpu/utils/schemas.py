@@ -49,7 +49,8 @@ _STORAGE_SCHEMA = {
             'anyOf': [{'type': 'string'},
                       {'type': 'array', 'items': {'type': 'string'}}]
         },
-        'store': {'type': 'string', 'enum': ['gcs', 's3', 'r2']},
+        'store': {'type': 'string',
+                  'enum': ['gcs', 's3', 'r2', 'azure', 'cos']},
         'persistent': {'type': 'boolean'},
         'mode': {'type': 'string', 'enum': ['MOUNT', 'COPY', 'mount', 'copy']},
     },
