@@ -130,8 +130,13 @@ def main() -> None:
     parser.add_argument('--qps', action='append', type=float, default=[])
     parser.add_argument('--requests-per-qps', type=int, default=48,
                         help='num_requests = qps * this')
-    parser.add_argument('--num-slots', type=int, default=48)
-    parser.add_argument('--decode-steps', type=int, default=8)
+    parser.add_argument('--num-slots', type=int, default=None)
+    parser.add_argument('--decode-steps', type=int, default=None)
+    parser.add_argument('--profile', default=None,
+                        choices=['latency', 'throughput'],
+                        help='replica operating point (infer serve '
+                             '--profile); explicit --num-slots/'
+                             '--decode-steps still win')
     parser.add_argument('--max-ttft', type=float, default=None,
                         help='replica admission bound (s); sheds count '
                              'in the sweep rows')
@@ -153,11 +158,17 @@ def main() -> None:
     name = args.service_name
     if endpoint is None:
         state.set_enabled_clouds(['local'])
+        num_slots = args.num_slots if args.num_slots is not None else \
+            (None if args.profile else 48)
         run_cmd = (
             'python -m skypilot_tpu.cli infer serve '
             '--model llama2-7b --weight-dtype int8 --cache-dtype fp8 '
-            f'--num-slots {args.num_slots} '
-            f'--decode-steps {args.decode_steps} --max-cache-len 512 '
+            + (f'--profile {args.profile} ' if args.profile else '')
+            + (f'--num-slots {num_slots} '
+               if num_slots is not None else '')
+            + (f'--decode-steps {args.decode_steps} '
+               if args.decode_steps is not None else '')
+            + '--max-cache-len 512 '
             + (f'--max-ttft {args.max_ttft} '
                if args.max_ttft is not None else '')
             + (f'--max-queue {args.max_queue} '
@@ -205,6 +216,8 @@ def main() -> None:
         n = max(int(qps * args.requests_per_qps), 16)
         print(f'-- qps {qps} ({n} requests)', flush=True)
         row = run_sweep_row(endpoint, qps, n)
+        if args.profile:
+            row['profile'] = args.profile
         print(json.dumps(row), flush=True)
         rows.append(row)
 

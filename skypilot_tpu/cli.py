@@ -845,6 +845,11 @@ _INFER_PROFILES = {
     # (TTFT p50 0.53 s at qps 2; smaller windows LOSE — dispatch
     # latency dominates); throughput widens it to 32 (+20% tok/s,
     # 772 vs 643 offline) at ~3x the TTFT.
+    # adaptive_window deliberately NOT in the latency preset: measured
+    # through the tunneled chip, per-dispatch RTT dominates and short
+    # windows RAISE TPOT (94 ms vs 76 ms at 16 slots / qps 0.5) — the
+    # knob pays only where dispatch latency is small relative to a
+    # decode step (local chips); it stays opt-in (--adaptive-window).
     'latency': {'num_slots': 32, 'decode_steps': 8, 'prefills_per_gap': 2},
     'throughput': {'num_slots': 48, 'decode_steps': 32,
                    'prefills_per_gap': 4},
@@ -939,20 +944,27 @@ def infer():
                    'from. Unset: runtime adapter loading is disabled '
                    '(the API is unauthenticated; an open path would '
                    'let any client probe the filesystem).')
+@click.option('--adaptive-window', is_flag=True, default=False,
+              help='Occupancy-adaptive decode windows: short (2-step) '
+                   'dispatches while <=1/4 of slots are active — '
+                   'smoother SSE + tighter TTFT at low load. The '
+                   'latency profile enables this.')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
                 tensor_parallel, weight_dtype, profile,
                 prefills_per_gap, platform, max_ttft, max_queue,
                 draft_len, ngram_max, max_prefixes, lora_rank,
-                lora_max_adapters, adapter_dir):
+                lora_max_adapters, adapter_dir, adaptive_window):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
         'num_slots': num_slots, 'decode_steps': decode_steps,
-        'prefills_per_gap': prefills_per_gap})
+        'prefills_per_gap': prefills_per_gap,
+        'adaptive_window': adaptive_window})
     num_slots, decode_steps = knobs['num_slots'], knobs['decode_steps']
     prefills_per_gap = knobs['prefills_per_gap']
+    adaptive_window = knobs['adaptive_window']
     click.echo(f'serving {hf_model or model} on {host}:{port}')
     infer_server.run(model=model, host=host, port=port,
                      num_slots=num_slots, max_cache_len=max_cache_len,
@@ -967,7 +979,8 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      ngram_max=ngram_max, max_prefixes=max_prefixes,
                      lora_rank=lora_rank,
                      lora_max_adapters=lora_max_adapters,
-                     adapter_dir=adapter_dir)
+                     adapter_dir=adapter_dir,
+                     adaptive_window=adaptive_window)
 
 
 @infer.command('bench')

@@ -83,6 +83,13 @@ class InferConfig:
     # so in-flight requests keep generating while a burst of new requests
     # prefills instead of stalling behind the whole burst.
     prefills_per_gap: int = 4
+    # Occupancy-adaptive decode window (latency serving): when at most a
+    # quarter of the slots are active, dispatch SHORT (2-step) windows
+    # instead of decode_steps — a new arrival then waits at most 2 steps
+    # for a prefill gap (vs decode_steps) and SSE chunks flow smoother,
+    # while the near-empty batch loses almost no amortization.  One
+    # extra compile (the short window's scan length).
+    adaptive_decode_window: bool = False
     # Prompts prefilled per device dispatch (fixed batched-prefill width;
     # short chunks pad by duplicating a real lane).  Amortizes
     # per-dispatch latency the same way decode_steps does for decode.
@@ -383,6 +390,12 @@ class InferenceEngine:
                 params = self._init_sharded_params(rng, sample)
         elif mesh is not None:
             params = self._shard_given_params(params, rng, sample)
+        else:
+            # A given (possibly host/numpy) tree must live on device
+            # ONCE: leaving numpy leaves would silently re-upload the
+            # whole model on EVERY dispatch (hundreds of MB per decode
+            # window through a tunneled chip).
+            params = jax.tree.map(jnp.asarray, params)
         self.params = params
         b = self.cfg.num_slots
         self.cache = init_cache(model_config, b, self.cfg.max_cache_len,
@@ -564,9 +577,11 @@ class InferenceEngine:
                     new_cache)
 
         def decode(params, cache, tokens, lengths, temps, rng,
-                   adapter_ids):
-            # tokens/lengths/temps: [B]; decode_steps tokens for every
-            # slot in ONE dispatch (lax.scan), returning [K, B] tokens.
+                   adapter_ids, steps):
+            # tokens/lengths/temps: [B]; `steps` (STATIC) tokens for
+            # every slot in ONE dispatch (lax.scan), returning [K, B]
+            # tokens.  steps = decode_steps normally; 2 when the
+            # adaptive window kicks in at low occupancy.
             def one_step(carry, key):
                 cache, tokens, lengths = carry
                 positions = lengths[:, None]
@@ -585,7 +600,7 @@ class InferenceEngine:
                 return (cache, next_tokens, lengths + 1), (
                     next_tokens, lp, t_ids, t_lps)
 
-            keys = jax.random.split(rng, self.cfg.decode_steps)
+            keys = jax.random.split(rng, steps)
             (cache, _, _), (toks, lps, gtoks, glps) = jax.lax.scan(
                 one_step, (cache, tokens, lengths), keys)
             # toks/lps [K, B]; gtoks/glps [K, B, topk]
@@ -683,7 +698,8 @@ class InferenceEngine:
 
         self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,),
                                        static_argnums=(9,))
-        self._decode = jax.jit(decode, donate_argnums=(1,))
+        self._decode = jax.jit(decode, donate_argnums=(1,),
+                               static_argnums=(7,))
         self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
         self._prefill_capture = jax.jit(prefill_capture)
         self._prefix_prefill = jax.jit(prefix_prefill, static_argnums=(2,),
@@ -1117,18 +1133,27 @@ class InferenceEngine:
         self._slot_adapters[i] = -1
         return req, res
 
-    def _decode_step(self) -> None:
+    def _decode_step(self, steps: Optional[int] = None) -> None:
         """One decode dispatch (K scanned steps); appends up to K tokens
         to every active slot, truncating at EOS / max_new (tokens past a
         slot's stop point are speculative overrun and are discarded —
         the cache rows they wrote are dead and get overwritten when the
         slot is recycled)."""
+        if steps is None:
+            steps = self.cfg.decode_steps
+            if (self.cfg.adaptive_decode_window and
+                    sum(s is not None for s in self._slots) <=
+                    max(1, self.cfg.num_slots // 4)):
+                # Low occupancy: a short window loses almost no
+                # amortization (few active slots) and bounds how long a
+                # new arrival waits for the next prefill gap.
+                steps = min(2, steps)
         self._rng, key = jax.random.split(self._rng)
         with self._ctx():           # mesh+rules active at trace time
             toks, lps, gtoks, glps, self.cache = self._decode(
                 self.params, self.cache, jnp.asarray(self._last_tokens),
                 jnp.asarray(self._lengths), jnp.asarray(self._temps), key,
-                jnp.asarray(self._slot_adapters))
+                jnp.asarray(self._slot_adapters), steps)
         toks_np = np.asarray(toks)                           # [K, B]
         lps_np = np.asarray(lps)
         gtoks_np = np.asarray(gtoks)
@@ -1392,6 +1417,18 @@ class InferenceEngine:
             if not moved:
                 time.sleep(idle_sleep)
 
+    def warmup_decode(self, tokens: Sequence[int]) -> None:
+        """Compile every decode-window variant outside the serving /
+        measurement path: with adaptive_decode_window a single warmup
+        request only compiles the SHORT (2-step) window — the full
+        decode_steps variant would then jit mid-serving on the first
+        real burst, stalling the whole data plane for the compile."""
+        self.generate([Request(tokens=list(tokens), max_new_tokens=2)])
+        if self.cfg.adaptive_decode_window and self.cfg.decode_steps > 2:
+            n = min(self.cfg.num_slots, self.cfg.num_slots // 4 + 1)
+            self.generate([Request(tokens=list(tokens), max_new_tokens=2)
+                           for _ in range(n)])
+
     def _warm_spec(self, prompt_len: int) -> None:
         """Compile the speculative verify path outside a benchmark's
         measurement window: a repetitive prompt guarantees drafts, so
@@ -1424,9 +1461,9 @@ class InferenceEngine:
                     max_new_tokens=new_tokens, request_id=str(i))
             for i in range(num_requests)
         ]
-        # Compile both phases outside the measurement.
-        self.generate([Request(tokens=list(reqs[0].tokens),
-                               max_new_tokens=2)])
+        # Compile both phases (and both window variants) outside the
+        # measurement.
+        self.warmup_decode(reqs[0].tokens)
         self._warm_spec(prompt_len)
         results: Dict[str, RequestResult] = {}
         done = threading.Event()
@@ -1500,8 +1537,7 @@ class InferenceEngine:
         ]
         # Warmup/compile with a full-length request so the timed run hits
         # the same prefill bucket (no jit compile inside the measurement).
-        self.generate([Request(tokens=list(reqs[0].tokens),
-                               max_new_tokens=2)])
+        self.warmup_decode(reqs[0].tokens)
         self._warm_spec(prompt_len)
         t0 = time.time()
         results = self.generate(reqs)

@@ -122,9 +122,10 @@ class InferenceServer:
 
     def _run(self) -> None:
         # Compile before declaring ready so the first real request does
-        # not eat the (tens of seconds) jit cost.
-        self.engine.generate([Request(tokens=[1, 2, 3],
-                                      max_new_tokens=2)])
+        # not eat the (tens of seconds) jit cost — including BOTH decode
+        # window variants when the adaptive window is on (a single
+        # warmup request only compiles the short one).
+        self.engine.warmup_decode([1, 2, 3])
         self.ready.set()
         self.engine.generate_stream(self._queue, self._deliver, self._stop)
 
@@ -976,7 +977,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         max_prefixes: int = 16,
         lora_rank: int = 0,
         lora_max_adapters: int = 8,
-        adapter_dir: Optional[str] = None) -> None:
+        adapter_dir: Optional[str] = None,
+        adaptive_window: bool = False) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -1091,7 +1093,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       cache_dtype=resolve_cache_dtype(cache_dtype),
                       draft_len=draft_len, ngram_max=ngram_max,
                       max_prefixes=max_prefixes, lora_rank=lora_rank,
-                      lora_max_adapters=lora_max_adapters)
+                      lora_max_adapters=lora_max_adapters,
+                      adaptive_decode_window=adaptive_window)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -1139,6 +1142,8 @@ def main() -> None:
     parser.add_argument('--adapter-dir', default=None,
                         help='directory POST /load_adapter may read '
                              'from (unset: runtime loading disabled)')
+    parser.add_argument('--adaptive-window', action='store_true',
+                        help='short decode windows at low occupancy')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
@@ -1149,7 +1154,8 @@ def main() -> None:
         draft_len=args.draft_len, ngram_max=args.ngram_max,
         max_prefixes=args.max_prefixes, lora_rank=args.lora_rank,
         lora_max_adapters=args.lora_max_adapters,
-        adapter_dir=args.adapter_dir)
+        adapter_dir=args.adapter_dir,
+        adaptive_window=args.adaptive_window)
 
 
 if __name__ == '__main__':

@@ -1452,3 +1452,40 @@ def test_lm_eval_loglikelihood_client_end_to_end(tiny_config):
     diverged[0] = (diverged[0] + 1) % tiny_config.vocab_size
     _, diverged_flag = client.loglikelihood(endpoint, context, diverged)
     assert not diverged_flag
+
+
+def test_adaptive_decode_window_token_identity(tiny_config):
+    """Occupancy-adaptive windows (2-step dispatches while <=1/4 of
+    slots are active) change only the dispatch schedule, never the
+    tokens: greedy output is identical to the fixed-window engine, and
+    the short window actually engages at low occupancy."""
+    cfg = InferConfig(num_slots=8, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=12, cache_dtype=jnp.float32,
+                      decode_steps=8)
+    fixed = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(3))
+    adaptive = InferenceEngine(
+        tiny_config,
+        InferConfig(**{**cfg.__dict__, 'adaptive_decode_window': True}),
+        params=fixed.params, rng=jax.random.PRNGKey(3))
+    prompt = [7, 8, 9]
+    [want] = fixed.generate([Request(tokens=list(prompt),
+                                     max_new_tokens=12)])
+    calls = []
+    orig = adaptive._decode
+
+    def spy(*args):
+        calls.append(args[-1])          # static `steps`
+        return orig(*args)
+
+    adaptive._decode = spy
+    # One active slot out of 8 -> low occupancy -> short windows.
+    [got] = adaptive.generate([Request(tokens=list(prompt),
+                                       max_new_tokens=12)])
+    assert got.output_tokens == want.output_tokens
+    assert calls and all(k == 2 for k in calls), calls
+    # At high occupancy (all slots busy) the full window is used.
+    calls.clear()
+    reqs = [Request(tokens=[5 + i, 6, 7], max_new_tokens=9)
+            for i in range(8)]
+    adaptive.generate(reqs)
+    assert 8 in calls, calls
