@@ -441,9 +441,21 @@ def _make_handler(server: InferenceServer):
                 stop = [str(s) for s in stop]
                 # Completions `logprobs` is an int (alternatives per
                 # position, OpenAI caps it at 5); tolerate bool True as
-                # 1.  None/False/absent = no logprobs.
+                # 1.  None/False/absent = no logprobs.  Chat uses
+                # `logprobs: true` + `top_logprobs: 0..k` instead.
                 lp_raw = payload.get('logprobs')
-                if lp_raw is None or lp_raw is False:
+                if chat:
+                    if payload.get('top_logprobs') is not None and \
+                            not lp_raw:
+                        # OpenAI rejects this combination loudly.
+                        self._json(400, {'error': {
+                            'message': 'top_logprobs requires logprobs '
+                                       'to be true',
+                            'type': 'invalid_request_error'}})
+                        return None
+                    lp_k = (int(payload.get('top_logprobs', 0))
+                            if lp_raw else None)
+                elif lp_raw is None or lp_raw is False:
                     lp_k = None
                 elif lp_raw is True:
                     lp_k = 1
@@ -459,8 +471,9 @@ def _make_handler(server: InferenceServer):
             if want_lp and not 0 <= lp_k <= max_k:
                 # Never silently return fewer alternatives than asked
                 # (r3: k>1 requests got k=1 without an error).
+                field = 'top_logprobs' if chat else 'logprobs'
                 self._json(400, {'error': {
-                    'message': f'logprobs must be between 0 and {max_k}',
+                    'message': f'{field} must be between 0 and {max_k}',
                     'type': 'invalid_request_error'}})
                 return None
             opts = {'logprobs': want_lp, 'logprob_k': lp_k or 0,
@@ -469,10 +482,10 @@ def _make_handler(server: InferenceServer):
                 # The engine always produces the prefill token; trim it
                 # from the response instead of rejecting the request.
                 max_new = 1
-            if chat and (want_lp or echo):
+            if chat and echo:
                 self._json(400, {'error': {
-                    'message': 'logprobs/echo are supported on '
-                               '/v1/completions only',
+                    'message': 'echo is supported on /v1/completions '
+                               'only',
                     'type': 'invalid_request_error'}})
                 return None
             if payload.get('stream') and (want_lp or echo or
@@ -630,8 +643,32 @@ def _make_handler(server: InferenceServer):
                      n_completion}
             if chat:
                 choice = {'index': 0, 'finish_reason': finish,
+                          'logprobs': None,
                           'message': {'role': 'assistant',
                                       'content': text or ''}}
+                if opts['logprobs']:
+                    # Chat logprobs shape (OpenAI): content = one entry
+                    # per generated token with its logprob + the
+                    # requested top_logprobs alternatives.  Chat always
+                    # has a tokenizer (enforced at parse).
+                    tok = server.tokenizer
+                    k = opts['logprob_k']
+
+                    def entry(tid, lp_val):
+                        s_ = tok.decode([tid])
+                        return {'token': s_, 'logprob': lp_val,
+                                'bytes': list(s_.encode('utf-8'))}
+
+                    content = []
+                    tops_all = list(res.top_logprobs or [])
+                    for i in range(n_completion):
+                        e = entry(out_tokens[i], out_lps[i])
+                        e['top_logprobs'] = [
+                            entry(tid, lp_val)
+                            for tid, lp_val in tops_all[i][:k]
+                        ] if i < len(tops_all) else []
+                        content.append(e)
+                    choice['logprobs'] = {'content': content}
             else:
                 if opts['echo'] and text is not None:
                     text = server.tokenizer.decode(

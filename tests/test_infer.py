@@ -1489,3 +1489,61 @@ def test_adaptive_decode_window_token_identity(tiny_config):
             for i in range(8)]
     adaptive.generate(reqs)
     assert 8 in calls, calls
+
+
+def test_openai_chat_logprobs(tiny_config):
+    """Chat logprobs (OpenAI shape: logprobs=true + top_logprobs=k):
+    one content entry per generated token carrying its exact logprob
+    and k best-first alternatives whose top entry matches the chosen
+    token on a greedy request."""
+    import urllib.error
+    _openai_server(tiny_config, 8179, tokenizer=_Tok())
+    out = _post(8179, '/v1/chat/completions',
+                {'messages': [{'role': 'user', 'content': 'hi'}],
+                 'max_tokens': 5, 'temperature': 0,
+                 'logprobs': True, 'top_logprobs': 3})
+    choice = out['choices'][0]
+    content = choice['logprobs']['content']
+    assert len(content) == 5
+    for e in content:
+        assert isinstance(e['logprob'], float) and e['logprob'] <= 0.0
+        assert e['bytes'] == list(e['token'].encode('utf-8'))
+        assert len(e['top_logprobs']) == 3
+        vals = [t['logprob'] for t in e['top_logprobs']]
+        assert vals == sorted(vals, reverse=True)
+        # Greedy: chosen == argmax alternative (same logprob).
+        assert abs(vals[0] - e['logprob']) < 1e-6
+    # logprobs=true without top_logprobs: entries with no alternatives.
+    out2 = _post(8179, '/v1/chat/completions',
+                 {'messages': [{'role': 'user', 'content': 'yo'}],
+                  'max_tokens': 3, 'temperature': 0, 'logprobs': True})
+    for e in out2['choices'][0]['logprobs']['content']:
+        assert e['top_logprobs'] == []
+    # Over-cap k is a loud 400.
+    try:
+        _post(8179, '/v1/chat/completions',
+              {'messages': [{'role': 'user', 'content': 'x'}],
+               'max_tokens': 2, 'logprobs': True, 'top_logprobs': 9})
+        raise AssertionError('expected 400')
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_openai_chat_top_logprobs_requires_logprobs(tiny_config):
+    """OpenAI contract: top_logprobs without logprobs=true is a loud
+    400, never a silently-degraded 200."""
+    import urllib.error
+    _openai_server(tiny_config, 8178, tokenizer=_Tok())
+    try:
+        _post(8178, '/v1/chat/completions',
+              {'messages': [{'role': 'user', 'content': 'x'}],
+               'max_tokens': 2, 'top_logprobs': 3})
+        raise AssertionError('expected 400')
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # And a plain chat response carries logprobs: null (shape parity
+    # with the completions path).
+    out = _post(8178, '/v1/chat/completions',
+                {'messages': [{'role': 'user', 'content': 'x'}],
+                 'max_tokens': 2, 'temperature': 0})
+    assert out['choices'][0]['logprobs'] is None
