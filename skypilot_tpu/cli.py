@@ -947,15 +947,21 @@ def infer():
 @click.option('--adaptive-window', is_flag=True, default=False,
               help='Occupancy-adaptive decode windows: short (2-step) '
                    'dispatches while <=1/4 of slots are active — '
-                   'smoother SSE + tighter TTFT at low load. The '
-                   'latency profile enables this.')
+                   'smoother SSE + tighter TTFT at low load (pays on '
+                   'low-RTT local chips).')
+@click.option('--auto-prefix', is_flag=True, default=False,
+              help='Automatic prefix caching: a prompt head seen '
+                   'twice registers itself as a resident prefix '
+                   '(bucket-quantized lengths; vLLM-APC analog). '
+                   'Explicit POST /cache_prefix always works.')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
                 tensor_parallel, weight_dtype, profile,
                 prefills_per_gap, platform, max_ttft, max_queue,
                 draft_len, ngram_max, max_prefixes, lora_rank,
-                lora_max_adapters, adapter_dir, adaptive_window):
+                lora_max_adapters, adapter_dir, adaptive_window,
+                auto_prefix):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
@@ -980,7 +986,8 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      lora_rank=lora_rank,
                      lora_max_adapters=lora_max_adapters,
                      adapter_dir=adapter_dir,
-                     adaptive_window=adaptive_window)
+                     adaptive_window=adaptive_window,
+                     auto_prefix=auto_prefix)
 
 
 @infer.command('bench')

@@ -1547,3 +1547,58 @@ def test_openai_chat_top_logprobs_requires_logprobs(tiny_config):
                 {'messages': [{'role': 'user', 'content': 'x'}],
                  'max_tokens': 2, 'temperature': 0})
     assert out['choices'][0]['logprobs'] is None
+
+
+def test_auto_prefix_caching(tiny_config):
+    """--auto-prefix (vLLM-APC analog): the same prompt head seen twice
+    registers itself (bucket-quantized), and later matching prompts
+    prefill suffix-only with token-identical output — no explicit
+    /cache_prefix call anywhere."""
+    import time as time_mod
+
+    from skypilot_tpu.infer import server as srv_mod
+    cfg = InferConfig(num_slots=2, max_cache_len=128,
+                      prefill_buckets=(64, 128), max_new_tokens=4,
+                      cache_dtype=jnp.float32)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(9))
+    srv = srv_mod.InferenceServer(eng, auto_prefix=True)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    head = [7 + (i % 11) for i in range(70)]   # > bucket 64
+
+    def ask(tail):
+        res = srv.submit(Request(tokens=head + tail, max_new_tokens=3))
+        assert res is not None and res.finish_reason != 'error', res
+        return res.output_tokens
+
+    want_a = ask([1, 2])       # sighting 1 (counts the 64-token head)
+    ask([3, 4])                # sighting 2 -> background registration
+    deadline = time_mod.time() + 120
+    while time_mod.time() < deadline and not eng._prefixes:
+        time_mod.sleep(0.5)
+    assert eng._prefixes, 'auto prefix never registered'
+    [(adapter, ptoks)] = list(eng._prefixes)
+    assert adapter is None and list(ptoks) == head[:64]
+    before = eng.prefix_stats['hits']
+    got_a = ask([1, 2])        # sighting 3: suffix-only prefill
+    assert eng.prefix_stats['hits'] > before
+    assert got_a == want_a     # reuse is output-identical
+    srv.stop()
+
+
+def test_auto_prefix_disabled_by_default(tiny_config):
+    from skypilot_tpu.infer import server as srv_mod
+    eng = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=2, max_cache_len=128,
+                    prefill_buckets=(64, 128), max_new_tokens=4,
+                    cache_dtype=jnp.float32),
+        rng=jax.random.PRNGKey(9))
+    srv = srv_mod.InferenceServer(eng)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    head = [5] * 70
+    for tail in ([1], [2], [3]):
+        srv.submit(Request(tokens=head + tail, max_new_tokens=2))
+    assert not eng._prefixes
+    srv.stop()
