@@ -72,6 +72,43 @@ def loglikelihood(endpoint: str, context: Sequence[int],
     return total, is_greedy
 
 
+def loglikelihood_rolling(endpoint: str, tokens: Sequence[int],
+                          max_context: int = 2048,
+                          model: str = None) -> float:
+    """Sum log P(token_t | window) over an arbitrarily long stream —
+    lm-eval's `loglikelihood_rolling` primitive (wikitext-style
+    perplexity).  The stream is scored in non-overlapping windows of
+    `max_context` tokens: each window is one echo+logprobs+max_tokens=0
+    request whose FIRST position is unscored (no context), exactly how
+    the upstream harness rolls windows with disjoint scoring.  Returns
+    the total loglikelihood of tokens[1:] (convert to perplexity via
+    exp(-total / (len(tokens) - 1)))."""
+    tokens = [int(t) for t in tokens]
+    if len(tokens) < 2:
+        raise ValueError('need at least 2 tokens to score')
+    total = 0.0
+    pos = 1                    # next position to score
+    while pos < len(tokens):
+        # Window carries ONE token of left context (position pos-1),
+        # so every token from index 1 is scored exactly once — the
+        # upstream harness's disjoint-window rolling.
+        window = tokens[pos - 1:pos - 1 + max_context]
+        body = {'prompt': window, 'max_tokens': 0, 'echo': True,
+                'logprobs': 1, 'temperature': 0}
+        if model is not None:
+            body['model'] = model
+        req = urllib.request.Request(
+            endpoint.rstrip('/') + '/v1/completions',
+            data=json.dumps(body).encode(),
+            headers={'Content-Type': 'application/json'})
+        out = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        lps = out['choices'][0]['logprobs']['token_logprobs']
+        assert lps[0] is None and len(lps) == len(window)
+        total += float(sum(lps[1:]))
+        pos += len(window) - 1
+    return total
+
+
 def rank_choices(endpoint: str, context: Sequence[int],
                  choices: Sequence[Sequence[int]],
                  model: str = None) -> List[int]:

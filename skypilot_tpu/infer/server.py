@@ -265,7 +265,12 @@ class InferenceServer:
             # evicted-but-hot key would evict another hot one — steady
             # state becomes one device prefill per request (LRU
             # thrash).  Explicit /cache_prefix keeps eviction rights.
-            if len(self.engine._prefixes) >= self.engine.cfg.max_prefixes:
+            # Count IN-FLIGHT registrations as occupied (they land
+            # later, in background threads — without the reservation
+            # two concurrent registrations could overflow the registry
+            # and trigger exactly the eviction this check forbids).
+            if (len(self.engine._prefixes) + len(self._auto_inflight)
+                    >= self.engine.cfg.max_prefixes):
                 return
             self._auto_inflight.add(key)
 
@@ -287,15 +292,18 @@ class InferenceServer:
 
         threading.Thread(target=register, daemon=True).start()
 
-    def submit(self, req: Request,
-               timeout: float = 300.0) -> Optional[RequestResult]:
+    def submit(self, req: Request, timeout: float = 300.0,
+               pre_admitted: bool = False) -> Optional[RequestResult]:
         rid = req.request_id or uuid.uuid4().hex
         req.request_id = rid
         if req.arrival_time is None:   # TTFT counts slot-queue wait
             req.arrival_time = time.time()
         # Admission FIRST: a shed (429) request must neither count
         # toward head-hotness nor spawn device work mid-overload.
-        self._admit(rid)
+        # pre_admitted: the caller already holds this rid's admission
+        # (the n>1 handler admits the whole batch atomically up front).
+        if not pre_admitted:
+            self._admit(rid)
         self._maybe_auto_prefix(req)
         ev = threading.Event()
         self._events[rid] = ev
@@ -539,9 +547,22 @@ def _make_handler(server: InferenceServer):
                 else:
                     lp_k = int(lp_raw)
                 echo = bool(payload.get('echo'))
+                n_raw = payload.get('n')
+                n_choices = 1 if n_raw is None else int(n_raw)
             except (TypeError, ValueError) as e:
                 self._json(400, {'error': {'message': f'bad field: {e}',
                                            'type': 'invalid_request_error'}})
+                return None
+            max_n = max(1, min(8, server.engine.cfg.num_slots))
+            if not 1 <= n_choices <= max_n:
+                self._json(400, {'error': {
+                    'message': f'n must be between 1 and {max_n}',
+                    'type': 'invalid_request_error'}})
+                return None
+            if n_choices > 1 and payload.get('stream'):
+                self._json(400, {'error': {
+                    'message': 'n > 1 is not supported with stream',
+                    'type': 'invalid_request_error'}})
                 return None
             want_lp = lp_k is not None
             max_k = min(5, server.engine.cfg.logprob_topk)
@@ -554,7 +575,8 @@ def _make_handler(server: InferenceServer):
                     'type': 'invalid_request_error'}})
                 return None
             opts = {'logprobs': want_lp, 'logprob_k': lp_k or 0,
-                    'echo': echo, 'zero_max': max_new == 0}
+                    'echo': echo, 'zero_max': max_new == 0,
+                    'n': n_choices}
             if opts['zero_max']:
                 # The engine always produces the prefill token; trim it
                 # from the response instead of rejecting the request.
@@ -676,22 +698,97 @@ def _make_handler(server: InferenceServer):
                 finally:
                     server._drop_admitted(req.request_id)
                 return
-            try:
-                res = server.submit(req)
-            except AdmissionError as e:
-                self._shed(e)
-                return
-            if res is None:
+            # n > 1 (OpenAI `n`): independent engine requests batched
+            # by continuous batching like any concurrent traffic; each
+            # samples its own tokens (identical under temperature 0).
+            # dataclasses.replace copies EVERY field, so future
+            # sampling knobs cannot be silently dropped from clones;
+            # prompt scoring runs once (clones reuse choice 0's scores
+            # — the prompt is identical).
+            import dataclasses as _dc
+            reqs = [req] + [
+                _dc.replace(req, tokens=list(req.tokens),
+                            request_id=uuid.uuid4().hex,
+                            arrival_time=None, stream_cb=None,
+                            want_prompt_logprobs=False)
+                for _ in range(opts['n'] - 1)
+            ]
+            if len(reqs) > 1:
+                # Admit the whole batch ATOMICALLY up front: a partial
+                # shed must 429 immediately (with a fresh Retry-After)
+                # and waste no device work, not join n-1 generations.
+                admitted = []
+                try:
+                    for r in reqs:
+                        server._admit(r.request_id)
+                        admitted.append(r.request_id)
+                except AdmissionError as e:
+                    for a in admitted:
+                        server._drop_admitted(a)
+                    self._shed(e)
+                    return
+            results: list = [None] * len(reqs)
+
+            def one(i):
+                try:
+                    results[i] = server.submit(
+                        reqs[i], pre_admitted=len(reqs) > 1)
+                except AdmissionError as e:
+                    # Only reachable for n == 1 (batch pre-admits).
+                    results[i] = ('shed', e)
+
+            if len(reqs) == 1:
+                one(0)
+                if isinstance(results[0], tuple):
+                    self._shed(results[0][1])
+                    return
+            else:
+                threads = [threading.Thread(target=one, args=(i,),
+                                            daemon=True)
+                           for i in range(len(reqs))]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            if any(r is None for r in results):
                 self._json(504, {'error': {'message': 'timed out',
                                            'type': 'timeout'}})
                 return
-            if res.finish_reason == 'error':
-                code = 500 if res.error_class == 'internal' else 400
+            err = next((r for r in results
+                        if r.finish_reason == 'error'), None)
+            if err is not None:
+                code = 500 if err.error_class == 'internal' else 400
                 self._json(code, {'error': {
-                    'message': res.error or 'bad request',
+                    'message': err.error or 'bad request',
                     'type': 'invalid_request_error'
                     if code == 400 else 'internal_error'}})
                 return
+            res = results[0]
+            # Clones skipped prompt scoring (identical prompt): reuse
+            # choice 0's scores so echo+logprobs choices 1..n-1 carry
+            # them too.
+            for r in results[1:]:
+                r.prompt_logprobs = res.prompt_logprobs
+                r.prompt_top_logprobs = res.prompt_top_logprobs
+            choices = []
+            completion_tokens = 0
+            for index, res_i in enumerate(results):
+                choice, n_completion = self._openai_choice(
+                    res_i, opts, stop, chat, index)
+                choices.append(choice)
+                completion_tokens += n_completion
+            usage = {'prompt_tokens': len(res.prompt_tokens),
+                     'completion_tokens': completion_tokens,
+                     'total_tokens': len(res.prompt_tokens) +
+                     completion_tokens}
+            self._json(200, {'id': rid, 'object': kind,
+                             'created': int(time.time()),
+                             'model': model_name,
+                             'choices': choices, 'usage': usage})
+
+        def _openai_choice(self, res, opts, stop, chat, index):
+            """One result -> one OpenAI choice object; returns
+            (choice, completion_tokens_after_stop_truncation)."""
             finish = self._openai_finish(res.finish_reason)
             out_tokens = list(res.output_tokens)
             out_lps = list(res.logprobs or [])
@@ -714,12 +811,8 @@ def _make_handler(server: InferenceServer):
                                 out_tokens[:i])) >= at:
                             n_completion = i
                             break
-            usage = {'prompt_tokens': len(res.prompt_tokens),
-                     'completion_tokens': n_completion,
-                     'total_tokens': len(res.prompt_tokens) +
-                     n_completion}
             if chat:
-                choice = {'index': 0, 'finish_reason': finish,
+                choice = {'index': index, 'finish_reason': finish,
                           'logprobs': None,
                           'message': {'role': 'assistant',
                                       'content': text or ''}}
@@ -750,7 +843,7 @@ def _make_handler(server: InferenceServer):
                 if opts['echo'] and text is not None:
                     text = server.tokenizer.decode(
                         res.prompt_tokens) + text
-                choice = {'index': 0, 'finish_reason': finish,
+                choice = {'index': index, 'finish_reason': finish,
                           'text': text if text is not None
                           else '', 'logprobs': None}
                 if text is None:    # token-only serving
@@ -792,10 +885,7 @@ def _make_handler(server: InferenceServer):
                         ],
                         'text_offset': offsets,
                     }
-            self._json(200, {'id': rid, 'object': kind,
-                             'created': int(time.time()),
-                             'model': model_name,
-                             'choices': [choice], 'usage': usage})
+            return choice, n_completion
 
         @staticmethod
         def _find_stop(text: str, stop) -> int:

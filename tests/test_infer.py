@@ -1602,3 +1602,74 @@ def test_auto_prefix_disabled_by_default(tiny_config):
         srv.submit(Request(tokens=head + tail, max_new_tokens=2))
     assert not eng._prefixes
     srv.stop()
+
+
+def test_lm_eval_loglikelihood_rolling(tiny_config):
+    """loglikelihood_rolling over HTTP: a long stream scored in
+    windows (1-token left context each) equals the sum of per-window
+    full-forward log-softmax scores, with every token scored exactly
+    once."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        'lm_eval_ll2',
+        os.path.join(os.path.dirname(__file__), '..', 'scripts',
+                     'lm_eval_loglikelihood.py'))
+    client = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(client)
+
+    eng = _openai_server(tiny_config, 8177)
+    rng = np.random.default_rng(4)
+    stream = rng.integers(1, tiny_config.vocab_size, size=40).tolist()
+    max_ctx = 16   # forces 3 windows over 40 tokens
+
+    got = client.loglikelihood_rolling('http://127.0.0.1:8177', stream,
+                                       max_context=max_ctx)
+    # Direct reference with the same windowing.
+    m, params = eng.model, eng.params
+    want = 0.0
+    pos = 1
+    while pos < len(stream):
+        window = stream[pos - 1:pos - 1 + max_ctx]
+        logits = np.asarray(m.apply(params, jnp.asarray([window]))[0])
+        for t in range(1, len(window)):
+            row = logits[t - 1]
+            want += float(row[window[t]] - np.log(np.exp(
+                row - row.max()).sum()) - row.max())
+        pos += len(window) - 1
+    np.testing.assert_allclose(got, want, atol=1e-2)
+
+
+def test_openai_n_choices(tiny_config):
+    """OpenAI `n`: one request returns n indexed choices.  Greedy
+    (temperature 0) choices are identical; sampled ones almost surely
+    diverge; usage sums completion tokens; n>1 + stream is a 400."""
+    import urllib.error
+    _openai_server(tiny_config, 8176)
+    out = _post(8176, '/v1/completions',
+                {'prompt': [5, 6, 7], 'max_tokens': 6, 'temperature': 0,
+                 'n': 3})
+    ch = out['choices']
+    assert [c['index'] for c in ch] == [0, 1, 2]
+    assert ch[0]['tokens'] == ch[1]['tokens'] == ch[2]['tokens']
+    assert out['usage']['completion_tokens'] == 18
+    assert out['usage']['prompt_tokens'] == 3
+    sampled = _post(8176, '/v1/completions',
+                    {'prompt': [5, 6, 7], 'max_tokens': 24, 'n': 4})
+    toks = [tuple(c['tokens']) for c in sampled['choices']]
+    assert len(set(toks)) > 1          # independent samples
+    for bad in ({'n': 0}, {'n': 99}, {'n': 2, 'stream': True}):
+        try:
+            _post(8176, '/v1/completions',
+                  {'prompt': [5, 6], 'max_tokens': 2, **bad})
+            raise AssertionError(f'expected 400 for {bad}')
+        except urllib.error.HTTPError as e:
+            assert e.code == 400, bad
+    # echo+logprobs with n: prompt scoring runs once (clones skip it)
+    # but every choice carries the identical prompt scores.
+    out = _post(8176, '/v1/completions',
+                {'prompt': [5, 6, 7, 8], 'max_tokens': 0, 'echo': True,
+                 'logprobs': 1, 'n': 2})
+    lp0 = out['choices'][0]['logprobs']['token_logprobs']
+    lp1 = out['choices'][1]['logprobs']['token_logprobs']
+    assert lp0 == lp1 and lp0[0] is None and len(lp0) == 4
