@@ -413,6 +413,13 @@ class InferenceEngine:
                  jax.device_put(v, cache_sharding)) for k, v in self.cache
             ]
         self._slots: List[Optional[_Slot]] = [None] * b
+        # Request ids cancelled while still PENDING (not yet slotted):
+        # generate_stream drops them at dequeue/prefill time.  In-slot
+        # cancels free the slot directly (cancel()).  id -> mark time:
+        # marks expire (_CANCEL_MARK_TTL_S) so a cancel that raced a
+        # natural finish cannot leak forever or poison a later request
+        # reusing the same client-supplied id.
+        self._cancelled: Dict[str, float] = {}
         # Host mirrors of per-slot decode state (pushed to device each
         # step as small arrays).
         self._lengths = np.zeros((b,), np.int32)
@@ -1131,6 +1138,8 @@ class InferenceEngine:
         self._lengths[i] = 0
         self._temps[i] = 0.0
         self._slot_adapters[i] = -1
+        if req.request_id is not None:
+            self._cancelled.pop(req.request_id, None)   # stale mark
         return req, res
 
     def _decode_step(self, steps: Optional[int] = None) -> None:
@@ -1278,6 +1287,33 @@ class InferenceEngine:
             rate = dispatch_accepted / dispatch_drafted
             self._accept_ema = 0.9 * self._accept_ema + 0.1 * rate
 
+    _CANCEL_MARK_TTL_S = 600.0
+
+    def cancel(self, request_id: str) -> bool:
+        """Stop generating for an in-flight request and free its slot
+        NOW (client disconnected mid-stream / server-side stop-string
+        hit): without this, an abandoned request burns its decode slot
+        to max_new_tokens.  A still-pending request is dropped at
+        dequeue time instead.  Returns True when the id was found
+        in a slot (its RequestResult is NOT delivered — the caller
+        initiated the cancel and owns the consequence); False marks it
+        for pending-drop."""
+        with self._lock:
+            self._prune_cancel_marks()
+            for i, s in enumerate(self._slots):
+                if s is not None and s.request.request_id == request_id:
+                    self._finish_slot(i, 'cancelled')
+                    return True
+            self._cancelled[request_id] = time.time()
+            return False
+
+    def _prune_cancel_marks(self) -> None:
+        now = time.time()
+        stale = [rid for rid, ts in self._cancelled.items()
+                 if now - ts > self._CANCEL_MARK_TTL_S]
+        for rid in stale:
+            del self._cancelled[rid]
+
     def _step(self) -> None:
         """One decode dispatch: speculative verify when drafting is
         enabled, else the windowed (lax.scan) decode."""
@@ -1367,6 +1403,17 @@ class InferenceEngine:
                     req = request_queue.get_nowait()
                 except queue.Empty:
                     break
+                if (req.request_id is not None and
+                        req.request_id in self._cancelled):
+                    # Cancelled while queued: never prefill it.
+                    self._cancelled.pop(req.request_id, None)
+                    result_cb(RequestResult(
+                        request_id=req.request_id,
+                        prompt_tokens=list(req.tokens),
+                        output_tokens=[], ttft_s=0.0, latency_s=0.0,
+                        finish_reason='cancelled'))
+                    moved = True
+                    continue
                 try:
                     to_start.append((req, slot,
                                      req.arrival_time or time.time(),
@@ -1381,7 +1428,29 @@ class InferenceEngine:
             if to_start:
                 try:
                     with self._lock:
-                        self._start_batch(to_start)
+                        # Re-check cancel marks UNDER the lock: a
+                        # cancel() racing the (unlocked) dequeue above
+                        # sees the request neither queued nor slotted
+                        # and leaves only a pending mark — honoring it
+                        # here closes the window where a cancelled
+                        # request would still prefill and decode.
+                        dropped = [
+                            it for it in to_start
+                            if it[0].request_id is not None and
+                            it[0].request_id in self._cancelled
+                        ]
+                        to_start = [it for it in to_start
+                                    if it not in dropped]
+                        for it in dropped:
+                            self._cancelled.pop(it[0].request_id, None)
+                        if to_start:
+                            self._start_batch(to_start)
+                    for it in dropped:
+                        result_cb(RequestResult(
+                            request_id=it[0].request_id,
+                            prompt_tokens=list(it[0].tokens),
+                            output_tokens=[], ttft_s=0.0,
+                            latency_s=0.0, finish_reason='cancelled'))
                 except Exception as e:  # pylint: disable=broad-except
                     # ANY failure must not kill the serving loop (the
                     # thread is the whole data plane); report every

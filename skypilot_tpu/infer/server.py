@@ -314,9 +314,14 @@ class InferenceServer:
         # drops it (no leak).
         self._events.pop(rid, None)
         res = self._results.pop(rid, None)
-        if res is not None and res.finish_reason != 'error':
+        if res is not None and res.finish_reason not in ('error',
+                                                         'cancelled'):
             self._note_first_token(rid, res.ttft_s)
         else:
+            # Errors, timeouts AND cancels leave the backlog without
+            # feeding the admission TTFT window — a cancelled result's
+            # fabricated 0.0 TTFT would suppress shedding exactly when
+            # cancels spike (overloaded clients giving up).
             self._drop_admitted(rid)
         return res
 
@@ -351,6 +356,7 @@ class InferenceServer:
         req.stream_cb = lambda toks: chunks.put(('tokens', toks))
         self._stream_queues[rid] = chunks
         self._queue.put(req)
+        finished = False
         try:
             while True:
                 try:
@@ -364,12 +370,31 @@ class InferenceServer:
                         rid, time.time() - req.arrival_time)
                 elif item[0] == 'done':
                     # Prefill-only/error finishes never streamed a chunk.
+                    finished = True
                     self._drop_admitted(rid)
                 yield item
                 if item[0] == 'done':
                     return
         finally:
             self._stream_queues.pop(rid, None)
+            if not finished:
+                # Drain first: the generation may have finished
+                # naturally with its 'done' sentinel unread (client
+                # vanished at the end) — cancelling then would leave a
+                # stale pending mark that could poison a retry reusing
+                # the same client-supplied request_id.
+                try:
+                    while True:
+                        if chunks.get_nowait()[0] == 'done':
+                            finished = True
+                except queue.Empty:
+                    pass
+            if not finished:
+                # The consumer stopped early — client disconnected
+                # mid-stream, stop string satisfied, or timeout.  Free
+                # the decode slot NOW instead of generating to
+                # max_new_tokens for nobody.
+                self.engine.cancel(rid)
             # Generator closed without a first token (client disconnect
             # before any chunk, GeneratorExit): the request leaves the
             # admission backlog — no-op when a first token already
@@ -948,9 +973,11 @@ def _make_handler(server: InferenceServer):
                         text = server.tokenizer.decode(streamed)
                         hit = self._find_stop(text, stop)
                         if hit >= 0:
-                            # Truncate at the stop string; closing the
-                            # generator lets the engine finish solo
-                            # (same contract as a disconnect).
+                            # Truncate at the stop string; returning
+                            # closes the generator, which CANCELS the
+                            # engine request — the decode slot frees
+                            # immediately (same contract as a client
+                            # disconnect).
                             delta = text[:hit][emitted:]
                             if delta:
                                 emit(chunk(delta, first=first))

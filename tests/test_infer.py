@@ -1673,3 +1673,51 @@ def test_openai_n_choices(tiny_config):
     lp0 = out['choices'][0]['logprobs']['token_logprobs']
     lp1 = out['choices'][1]['logprobs']['token_logprobs']
     assert lp0 == lp1 and lp0[0] is None and len(lp0) == 4
+
+
+def test_cancel_frees_slot_midstream(tiny_config):
+    """engine.cancel(rid): an abandoned stream's slot frees
+    immediately instead of decoding to max_new_tokens — and a stream
+    consumer breaking early (stop string / disconnect) triggers it
+    through submit_stream's close path."""
+    import time as time_mod
+
+    from skypilot_tpu.infer import server as srv_mod
+    eng = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=1, max_cache_len=128,
+                    prefill_buckets=(8,), max_new_tokens=120,
+                    cache_dtype=jnp.float32, decode_steps=2),
+        rng=jax.random.PRNGKey(6))
+    srv = srv_mod.InferenceServer(eng)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    # Start a LONG stream on the ONLY slot and abandon it after the
+    # first chunk (~2 of 120 tokens).
+    gen = srv.submit_stream(Request(tokens=[4, 5, 6],
+                                    max_new_tokens=120,
+                                    request_id='victim'))
+    kind, value = next(gen)
+    assert kind == 'tokens' and value
+    gen.close()                      # disconnect -> cancel -> slot free
+    # cancel() ran SYNCHRONOUSLY inside close (the generator's finally
+    # acquires the engine lock): the victim must be gone NOW, ~198
+    # tokens early (~118) — under the old behavior it would still be decoding
+    # solo to max_new_tokens right here.
+    s0 = eng._slots[0]
+    assert s0 is None or s0.request.request_id != 'victim', (
+        f'victim still decoding after close '
+        f'({len(s0.generated)} tokens)')
+    res = srv.submit(Request(tokens=[7, 8, 9], max_new_tokens=2),
+                     timeout=60)
+    assert res is not None and res.finish_reason != 'error'
+    del time_mod
+    # Pending-cancel path (deterministic: mark BEFORE the request ever
+    # reaches the engine loop): a cancelled-while-queued id is dropped
+    # at dequeue with finish_reason 'cancelled', never prefilled.
+    assert eng.cancel('queued') is False   # not slotted -> pending mark
+    res2 = srv.submit(Request(tokens=[9, 9], max_new_tokens=5,
+                              request_id='queued'), timeout=60)
+    assert res2 is not None and res2.finish_reason == 'cancelled'
+    assert res2.output_tokens == []
+    srv.stop()
