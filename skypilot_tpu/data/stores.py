@@ -229,6 +229,14 @@ class AzureBlobStore(RcloneStore):
     NAME = 'azure'
     SCHEME = 'azure://'
     REMOTE = 'azure'
+    # The base markers were tuned on rclone's S3-compatible backends;
+    # azureblob phrases a missing container differently (the service
+    # error code ContainerNotFound and rclone's own wording).  Without
+    # these, deleting an already-gone azure:// bucket loses its
+    # idempotency and surfaces as a hard StorageError.
+    MISSING_MARKERS = RcloneStore.MISSING_MARKERS + (
+        'container not found', 'ContainerNotFound',
+        'container does not exist')
 
 
 class IbmCosStore(RcloneStore):

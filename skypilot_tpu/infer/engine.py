@@ -1307,6 +1307,15 @@ class InferenceEngine:
             self._cancelled[request_id] = time.time()
             return False
 
+    def uncancel(self, request_id: str) -> None:
+        """Drop a pending-cancel mark.  For the caller who learns —
+        after cancel() returned False — that the request had already
+        finished naturally (cancel raced the finish): without this the
+        stale mark silently drops a retry reusing the same
+        client-supplied request_id for up to _CANCEL_MARK_TTL_S."""
+        with self._lock:
+            self._cancelled.pop(request_id, None)
+
     def _prune_cancel_marks(self) -> None:
         now = time.time()
         stale = [rid for rid, ts in self._cancelled.items()
@@ -1407,11 +1416,19 @@ class InferenceEngine:
                         req.request_id in self._cancelled):
                     # Cancelled while queued: never prefill it.
                     self._cancelled.pop(req.request_id, None)
-                    result_cb(RequestResult(
-                        request_id=req.request_id,
-                        prompt_tokens=list(req.tokens),
-                        output_tokens=[], ttft_s=0.0, latency_s=0.0,
-                        finish_reason='cancelled'))
+                    # Terminal results are delivered UNDER the lock —
+                    # here and at every other result_cb site in this
+                    # loop.  cancel() also takes the lock, so a caller
+                    # who sees cancel() return False can rely on any
+                    # prior finish's sentinel being already enqueued
+                    # (submit_stream's stale-mark re-drain needs this
+                    # on the error/cancel paths, not just harvest).
+                    with self._lock:
+                        result_cb(RequestResult(
+                            request_id=req.request_id,
+                            prompt_tokens=list(req.tokens),
+                            output_tokens=[], ttft_s=0.0, latency_s=0.0,
+                            finish_reason='cancelled'))
                     moved = True
                     continue
                 try:
@@ -1419,11 +1436,14 @@ class InferenceEngine:
                                      req.arrival_time or time.time(),
                                      *self._validate_request(req)))
                 except ValueError as e:
-                    result_cb(RequestResult(
-                        request_id=req.request_id,
-                        prompt_tokens=list(req.tokens), output_tokens=[],
-                        ttft_s=0.0, latency_s=0.0, finish_reason='error',
-                        error=str(e), error_class='client'))
+                    with self._lock:
+                        result_cb(RequestResult(
+                            request_id=req.request_id,
+                            prompt_tokens=list(req.tokens),
+                            output_tokens=[],
+                            ttft_s=0.0, latency_s=0.0,
+                            finish_reason='error',
+                            error=str(e), error_class='client'))
                 moved = True
             if to_start:
                 try:
@@ -1445,12 +1465,12 @@ class InferenceEngine:
                             self._cancelled.pop(it[0].request_id, None)
                         if to_start:
                             self._start_batch(to_start)
-                    for it in dropped:
-                        result_cb(RequestResult(
-                            request_id=it[0].request_id,
-                            prompt_tokens=list(it[0].tokens),
-                            output_tokens=[], ttft_s=0.0,
-                            latency_s=0.0, finish_reason='cancelled'))
+                        for it in dropped:
+                            result_cb(RequestResult(
+                                request_id=it[0].request_id,
+                                prompt_tokens=list(it[0].tokens),
+                                output_tokens=[], ttft_s=0.0,
+                                latency_s=0.0, finish_reason='cancelled'))
                 except Exception as e:  # pylint: disable=broad-except
                     # ANY failure must not kill the serving loop (the
                     # thread is the whole data plane); report every
@@ -1466,13 +1486,14 @@ class InferenceEngine:
                                 self._slots[slot] = None
                                 self._lengths[slot] = 0
                                 self._temps[slot] = 0.0
-                    for req, slot, *_ in to_start:
-                        result_cb(RequestResult(
-                            request_id=req.request_id,
-                            prompt_tokens=list(req.tokens),
-                            output_tokens=[], ttft_s=0.0, latency_s=0.0,
-                            finish_reason='error', error=str(e),
-                            error_class='internal'))
+                        for req, slot, *_ in to_start:
+                            result_cb(RequestResult(
+                                request_id=req.request_id,
+                                prompt_tokens=list(req.tokens),
+                                output_tokens=[], ttft_s=0.0,
+                                latency_s=0.0,
+                                finish_reason='error', error=str(e),
+                                error_class='internal'))
             with self._lock:
                 self._flush_streams()            # prefill first tokens
                 for _, res in self._harvest():   # prefill-only finishes
@@ -1494,9 +1515,22 @@ class InferenceEngine:
         real burst, stalling the whole data plane for the compile."""
         self.generate([Request(tokens=list(tokens), max_new_tokens=2)])
         if self.cfg.adaptive_decode_window and self.cfg.decode_steps > 2:
-            n = min(self.cfg.num_slots, self.cfg.num_slots // 4 + 1)
-            self.generate([Request(tokens=list(tokens), max_new_tokens=2)
-                           for _ in range(n)])
+            n = self._warmup_decode_fanout(self.cfg.num_slots)
+            if n:
+                self.generate([Request(tokens=list(tokens),
+                                       max_new_tokens=2)
+                               for _ in range(n)])
+
+    @staticmethod
+    def _warmup_decode_fanout(num_slots: int) -> int:
+        """How many concurrent warmup requests force the FULL decode
+        window under the adaptive policy (occupancy must EXCEED
+        max(1, num_slots // 4) — see _decode_step).  num_slots == 1 can
+        never exceed that threshold, so the full variant is unreachable
+        in serving too and needs no compile: return 0."""
+        if num_slots <= 1:
+            return 0
+        return min(num_slots, max(2, num_slots // 4 + 1))
 
     def _warm_spec(self, prompt_len: int) -> None:
         """Compile the speculative verify path outside a benchmark's

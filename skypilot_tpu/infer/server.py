@@ -293,7 +293,8 @@ class InferenceServer:
         threading.Thread(target=register, daemon=True).start()
 
     def submit(self, req: Request, timeout: float = 300.0,
-               pre_admitted: bool = False) -> Optional[RequestResult]:
+               pre_admitted: bool = False,
+               count_prefix: bool = True) -> Optional[RequestResult]:
         rid = req.request_id or uuid.uuid4().hex
         req.request_id = rid
         if req.arrival_time is None:   # TTFT counts slot-queue wait
@@ -304,7 +305,12 @@ class InferenceServer:
         # (the n>1 handler admits the whole batch atomically up front).
         if not pre_admitted:
             self._admit(rid)
-        self._maybe_auto_prefix(req)
+        # count_prefix=False: an OpenAI `n` clone — the prompt head is
+        # one HTTP request's, so hotness counts it once (choice 0),
+        # else a single n>=2 request self-certifies as 'seen twice' and
+        # burns a prefix slot + a capture forward on a one-off prompt.
+        if count_prefix:
+            self._maybe_auto_prefix(req)
         ev = threading.Event()
         self._events[rid] = ev
         self._queue.put(req)
@@ -376,7 +382,11 @@ class InferenceServer:
                 if item[0] == 'done':
                     return
         finally:
-            self._stream_queues.pop(rid, None)
+            # The stream queue stays registered through the cancel
+            # below: popping it first would route a racing natural
+            # finish into _results (abandoned-drop) instead of the
+            # chunks queue, making the finish invisible to the
+            # stale-mark re-drain.
             if not finished:
                 # Drain first: the generation may have finished
                 # naturally with its 'done' sentinel unread (client
@@ -394,7 +404,22 @@ class InferenceServer:
                 # mid-stream, stop string satisfied, or timeout.  Free
                 # the decode slot NOW instead of generating to
                 # max_new_tokens for nobody.
-                self.engine.cancel(rid)
+                if not self.engine.cancel(rid):
+                    # Not in a slot: still queued (the mark drops it at
+                    # dequeue — correct), OR it finished in the window
+                    # between the drain above and cancel().  The engine
+                    # delivers under its lock and cancel() takes that
+                    # lock, so a finish that won the race has its
+                    # 'done' sentinel enqueued by now: finding it means
+                    # the pending mark is stale and must be cleared.
+                    try:
+                        while True:
+                            if chunks.get_nowait()[0] == 'done':
+                                self.engine.uncancel(rid)
+                                break
+                    except queue.Empty:
+                        pass
+            self._stream_queues.pop(rid, None)
             # Generator closed without a first token (client disconnect
             # before any chunk, GeneratorExit): the request leaves the
             # admission backlog — no-op when a first token already
@@ -757,7 +782,8 @@ def _make_handler(server: InferenceServer):
             def one(i):
                 try:
                     results[i] = server.submit(
-                        reqs[i], pre_admitted=len(reqs) > 1)
+                        reqs[i], pre_admitted=len(reqs) > 1,
+                        count_prefix=i == 0)
                 except AdmissionError as e:
                     # Only reachable for n == 1 (batch pre-admits).
                     results[i] = ('shed', e)

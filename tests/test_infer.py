@@ -1721,3 +1721,120 @@ def test_cancel_frees_slot_midstream(tiny_config):
     assert res2 is not None and res2.finish_reason == 'cancelled'
     assert res2.output_tokens == []
     srv.stop()
+
+
+def test_warmup_decode_fanout_contract(tiny_config):
+    """ADVICE r4: the adaptive-window second warmup must EXCEED the
+    short-window occupancy threshold (max(1, num_slots // 4) — see
+    _decode_step) for every slot count where the full window is
+    reachable, else the full variant jits mid-serving on the first real
+    burst.  num_slots == 1 can never exceed the threshold: the full
+    window is unreachable in serving too, so the warmup skips it."""
+    f = InferenceEngine._warmup_decode_fanout
+    assert f(1) == 0
+    for ns in range(2, 65):
+        n = f(ns)
+        assert 2 <= n <= ns, ns
+        assert n > max(1, ns // 4), ns   # full window actually taken
+    # A 1-slot adaptive engine still warms up cleanly (and serves).
+    eng = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=1, max_cache_len=64, prefill_buckets=(8,),
+                    max_new_tokens=4, cache_dtype=jnp.float32,
+                    decode_steps=8, adaptive_decode_window=True),
+        rng=jax.random.PRNGKey(3))
+    eng.warmup_decode([1, 2, 3])
+    res = eng.generate([Request(tokens=[4, 5, 6], max_new_tokens=3)])[0]
+    assert len(res.output_tokens) == 3
+
+
+def test_auto_prefix_counts_n_clones_once(tiny_config):
+    """ADVICE r4: one n=3 request counts its prompt head ONCE toward
+    auto-prefix hotness — clones must not self-certify a one-off
+    prompt as 'seen twice' (burning a prefix slot plus a device
+    capture forward)."""
+    from http.server import ThreadingHTTPServer
+
+    from skypilot_tpu.infer import server as srv_mod
+    cfg = InferConfig(num_slots=4, max_cache_len=128,
+                      prefill_buckets=(64, 128), max_new_tokens=4,
+                      cache_dtype=jnp.float32)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(11))
+    srv = srv_mod.InferenceServer(eng, auto_prefix=True)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    httpd = ThreadingHTTPServer(('127.0.0.1', 8175),
+                                srv_mod._make_handler(srv))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        head = [3 + (i % 13) for i in range(70)]   # > bucket 64
+        out = _post(8175, '/v1/completions',
+                    {'prompt': head, 'max_tokens': 2, 'n': 3})
+        assert len(out['choices']) == 3
+        [(key, count)] = list(srv._auto_counts.items())
+        assert count == 1            # one HTTP request = one sighting
+        assert not eng._prefixes and not srv._auto_inflight
+        # Direct clone-style submit is a counting no-op too.
+        srv.submit(Request(tokens=head + [9], max_new_tokens=2),
+                   count_prefix=False)
+        assert srv._auto_counts[key] == 1
+    finally:
+        httpd.shutdown()
+        srv.stop()
+
+
+def test_cancel_after_natural_finish_leaves_no_stale_mark(tiny_config):
+    """ADVICE r4: a natural finish racing submit_stream's close-path
+    drain must not leave a pending-cancel mark — the mark would
+    silently drop a retry reusing the same client request_id for up to
+    600 s.  The interleaving is forced deterministically: the finish's
+    'done' sentinel is withheld past the first drain and injected just
+    before cancel() inspects the slots (exactly what happens when the
+    finish wins the engine-lock race)."""
+    import time as time_mod
+
+    from skypilot_tpu.infer import server as srv_mod
+    eng = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=1, max_cache_len=64, prefill_buckets=(8,),
+                    max_new_tokens=2, cache_dtype=jnp.float32),
+        rng=jax.random.PRNGKey(5))
+    srv = srv_mod.InferenceServer(eng)
+    real_deliver = srv._deliver
+    held = {}
+
+    def holding_deliver(res):
+        if res.request_id == 'racer' and 'res' not in held:
+            held['res'] = res        # finished; sentinel withheld
+            return
+        real_deliver(res)
+
+    srv._deliver = holding_deliver   # bound before start(): loop uses it
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    real_cancel = eng.cancel
+
+    def racing_cancel(rid):
+        # The finish wins the engine-lock race: its sentinel is
+        # enqueued before cancel() sees the (already freed) slots.
+        if 'res' in held:
+            real_deliver(held['res'])
+        return real_cancel(rid)
+
+    eng.cancel = racing_cancel
+    gen = srv.submit_stream(Request(tokens=[4, 5, 6], max_new_tokens=2,
+                                    request_id='racer'))
+    kind, value = next(gen)
+    assert kind == 'tokens'
+    deadline = time_mod.time() + 60
+    while time_mod.time() < deadline and 'res' not in held:
+        time_mod.sleep(0.05)         # engine finishes; sentinel held
+    assert 'res' in held
+    gen.close()                      # client vanished without the done
+    assert 'racer' not in eng._cancelled, 'stale pending-cancel mark'
+    # A retry reusing the client-supplied id is served, not dropped.
+    res = srv.submit(Request(tokens=[7, 8], max_new_tokens=2,
+                             request_id='racer'), timeout=60)
+    assert res is not None and res.finish_reason not in ('cancelled',
+                                                         'error')
+    srv.stop()

@@ -271,3 +271,37 @@ def test_azure_source_ingested_via_rclone(monkeypatch):
     data_transfer.transfer_to_gcs('azure://cont/path', 'gs://dst')
     assert calls == [['rclone', 'copy', '--fast-list', 'azure:cont/path',
                       'gcs:dst']]
+
+
+def test_azure_delete_idempotent_on_missing_container(monkeypatch,
+                                                      tmp_path):
+    """ADVICE r4: rclone's azureblob backend phrases a missing
+    container differently from the S3-compatible backends
+    (ContainerNotFound / 'container not found') — deleting an
+    already-gone azure:// bucket must stay idempotent, and a real
+    failure must still raise."""
+    from skypilot_tpu.data import stores
+    src = tmp_path / 'out'
+    src.mkdir()
+    monkeypatch.setattr(stores.shutil, 'which', lambda t: t == 'rclone')
+
+    def run_with_stderr(stderr):
+        def fake(cmd):
+            rc = 1 if cmd[1] == 'purge' else 0
+            return subprocess.CompletedProcess(cmd, rc, stdout='',
+                                               stderr=stderr)
+        return fake
+
+    for phrasing in (
+            'ERROR : error deleting container: '
+            'ContainerNotFound: The specified container does not exist.',
+            'Failed to purge: container not found'):
+        monkeypatch.setattr(stores, '_run', run_with_stderr(phrasing))
+        st = storage.Storage(name='gone', source=str(src), store='azure')
+        st.delete()                      # no raise: already-gone is OK
+    # A non-missing failure is still loud.
+    monkeypatch.setattr(stores, '_run',
+                        run_with_stderr('AuthorizationFailure'))
+    st = storage.Storage(name='locked', source=str(src), store='azure')
+    with pytest.raises(exceptions.StorageBucketDeleteError):
+        st.delete()
