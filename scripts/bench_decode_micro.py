@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""Decode-dispatch microbenchmark: where does TPOT actually go?
+
+Separates, on the real chip, the three components of the serving
+engine's inter-token latency (VERDICT r3 weak #2: TPOT p50 ~80-100 ms
+through the plane vs the reference anchor's 18.9 ms on 8x v6e):
+
+  1. pure device time per decode step  — chain M dispatches, sync once;
+  2. production dispatch time          — per-dispatch host transfer of
+     the [K, B] token block, exactly what _decode_step does;
+  3. prefill dispatch time per bucket  — the TTFT device component.
+
+(2) - (1) is the host<->device round-trip tax (on a tunneled chip this
+is the dominant suspect).  Fitting time(K) = F + K*s over K in
+{1,2,4,8,16} gives the fixed-overhead F and marginal per-step cost s:
+TPOT at window K is (F + K*s)/K = s + F/K, which says exactly how much
+window amortization the tunnel forces.
+
+Usage (on the TPU host):
+  python scripts/bench_decode_micro.py [--model llama2-7b]
+      [--num-slots 16] [--max-cache-len 512] [--reps 20]
+"""
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+sys.path.insert(0, '.')
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--model', default='llama2-7b')
+    ap.add_argument('--num-slots', type=int, default=16)
+    ap.add_argument('--max-cache-len', type=int, default=512)
+    ap.add_argument('--weight-dtype', default='int8')
+    ap.add_argument('--cache-dtype', default='fp8')
+    ap.add_argument('--prompt-len', type=int, default=219)
+    ap.add_argument('--reps', type=int, default=20)
+    ap.add_argument('--windows', type=int, nargs='+',
+                    default=[1, 2, 4, 8, 16])
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import InferConfig, InferenceEngine
+    from skypilot_tpu.infer.engine import resolve_cache_dtype
+    from skypilot_tpu.models import get_model_config
+
+    model_config = get_model_config(args.model)
+    if args.weight_dtype == 'int8':
+        model_config = dataclasses.replace(model_config,
+                                           weight_dtype='int8')
+    cfg = InferConfig(model=args.model, num_slots=args.num_slots,
+                      max_cache_len=args.max_cache_len,
+                      prefill_buckets=(256,),
+                      cache_dtype=resolve_cache_dtype(args.cache_dtype),
+                      decode_steps=max(args.windows))
+    print(f'devices: {jax.devices()}', flush=True)
+    t0 = time.time()
+    eng = InferenceEngine(model_config, cfg)
+    print(f'engine built in {time.time() - t0:.1f}s', flush=True)
+
+    b = args.num_slots
+    tokens = jnp.ones((b,), jnp.int32)
+    lengths = jnp.full((b,), args.prompt_len, jnp.int32)
+    temps = jnp.zeros((b,), jnp.float32)
+    adapters = jnp.full((b,), -1, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    cache = eng.cache
+
+    def dispatch(cache, k):
+        out = eng._decode(eng.params, cache, tokens, lengths, temps,
+                          key, adapters, k)
+        return out[0], out[1]     # packed head [K, B, 2+2k], new cache
+
+    results = {}
+    for k in args.windows:
+        toks, cache = dispatch(cache, k)        # compile
+        _ = float(toks[0, 0, 0])                   # sync (host transfer)
+        # -- production shape: per-dispatch host transfer
+        t0 = time.time()
+        for _ in range(args.reps):
+            toks, cache = dispatch(cache, k)
+            _ = float(toks[0, 0, 0])
+        prod = (time.time() - t0) / args.reps
+        # -- pure device: chain dispatches, sync once at the end
+        t0 = time.time()
+        for _ in range(args.reps):
+            toks, cache = dispatch(cache, k)
+        _ = float(toks[0, 0, 0])
+        pure = (time.time() - t0) / args.reps
+        results[k] = {'dispatch_s': prod, 'chained_s': pure,
+                      'tpot_ms': prod / k * 1e3,
+                      'chained_per_step_ms': pure / k * 1e3}
+        print(f'K={k:3d}: dispatch {prod * 1e3:7.1f} ms '
+              f'(TPOT {prod / k * 1e3:6.1f} ms/tok) | chained '
+              f'{pure * 1e3:7.1f} ms ({pure / k * 1e3:6.1f} ms/tok)',
+              flush=True)
+
+    # Linear fit over the production dispatch times: t(K) = F + K*s.
+    ks = sorted(results)
+    ts = [results[k]['dispatch_s'] for k in ks]
+    n = len(ks)
+    mk = sum(ks) / n
+    mt = sum(ts) / n
+    s = (sum((k - mk) * (t - mt) for k, t in zip(ks, ts)) /
+         sum((k - mk) ** 2 for k in ks))
+    f = mt - s * mk
+    print(f'\nfit: dispatch(K) = {f * 1e3:.1f} ms + K * {s * 1e3:.1f} ms'
+          f'  ->  TPOT(K) = {s * 1e3:.1f} + {f * 1e3:.1f}/K ms',
+          flush=True)
+
+    # Prefill component of TTFT at the bucket size.
+    pre = jnp.ones((1, 256), jnp.int32)
+    true_lens = jnp.asarray([args.prompt_len], jnp.int32)
+    from skypilot_tpu.models.llama import init_cache
+    slots = jnp.asarray([0], jnp.int32)
+    pcache = init_cache(model_config, 1, 256, cfg.cache_dtype)
+    out = eng._prefill_insert(eng.params, pre, true_lens, pcache,
+                              cache, slots, temps[:1], key,
+                              adapters[:1], False)
+    _ = float(out[0][0, 0])
+    # pcache is NOT donated (donate_argnums=(4,) is the engine cache):
+    # reuse one allocation so the timed loop isolates the dispatch —
+    # a per-rep init_cache would round-trip allocations on the tunnel
+    # and overstate the prefill component.
+    t0 = time.time()
+    reps = max(5, args.reps // 2)
+    for _ in range(reps):
+        out = eng._prefill_insert(eng.params, pre, true_lens, pcache,
+                                  out[2], slots, temps[:1], key,
+                                  adapters[:1], False)
+        _ = float(out[0][0, 0])
+    prefill_ms = (time.time() - t0) / reps * 1e3
+    print(f'prefill bucket=256 P=1: {prefill_ms:.1f} ms', flush=True)
+
+    print(json.dumps({'model': args.model, 'num_slots': b,
+                      'max_cache_len': args.max_cache_len,
+                      'windows': {str(k): results[k] for k in results},
+                      'fit_fixed_ms': f * 1e3,
+                      'fit_per_step_ms': s * 1e3}))
+
+
+if __name__ == '__main__':
+    main()

@@ -841,16 +841,19 @@ def bench_delete(benchmark, yes):
 
 _INFER_PROFILES = {
     # Measured operating points for a 7B-class model on one v5e chip
-    # (docs/performance.md).  latency keeps the 8-step decode window
-    # (TTFT p50 0.53 s at qps 2; smaller windows LOSE — dispatch
-    # latency dominates); throughput widens it to 32 (+20% tok/s,
-    # 772 vs 643 offline) at ~3x the TTFT.
-    # adaptive_window deliberately NOT in the latency preset: measured
-    # through the tunneled chip, per-dispatch RTT dominates and short
-    # windows RAISE TPOT (94 ms vs 76 ms at 16 slots / qps 0.5) — the
-    # knob pays only where dispatch latency is small relative to a
-    # decode step (local chips); it stays opt-in (--adaptive-window).
-    'latency': {'num_slots': 32, 'decode_steps': 8, 'prefills_per_gap': 2},
+    # (docs/performance.md).  TPOT at decode window K is s + F/K with
+    # F the per-dispatch fixed cost (~108 ms through the tunnel) and s
+    # the marginal step (~16 ms) — scripts/bench_decode_micro.py — so
+    # the latency preset runs a 16-step window PLUS the queue-aware
+    # adaptive window (full K while nothing waits; K=2 only when an
+    # arrival is queued with a free slot).  Same-chip A/B at 32 slots:
+    # single-stream TPOT 53 -> 33 ms, qps-1.0 TPOT p50 104 -> 45 ms,
+    # TTFT p50 1.4 -> 0.52 s, 143 -> 184 tok/s.  (r4's occupancy-based
+    # adaptive window LOST on the tunnel — short windows whenever few
+    # slots were busy — and was left opt-in; the queue-aware policy
+    # replaced it.)  throughput keeps the widest window and batch.
+    'latency': {'num_slots': 32, 'decode_steps': 16,
+                'prefills_per_gap': 2, 'adaptive_window': True},
     'throughput': {'num_slots': 48, 'decode_steps': 32,
                    'prefills_per_gap': 4},
 }
@@ -944,11 +947,14 @@ def infer():
                    'from. Unset: runtime adapter loading is disabled '
                    '(the API is unauthenticated; an open path would '
                    'let any client probe the filesystem).')
-@click.option('--adaptive-window', is_flag=True, default=False,
-              help='Occupancy-adaptive decode windows: short (2-step) '
-                   'dispatches while <=1/4 of slots are active — '
-                   'smoother SSE + tighter TTFT at low load (pays on '
-                   'low-RTT local chips).')
+@click.option('--adaptive-window/--no-adaptive-window', default=False,
+              help='Queue-aware decode windows: full decode_steps '
+                   'while nothing is waiting (TPOT-optimal — the '
+                   'per-dispatch fixed cost amortizes over the whole '
+                   'window), short 2-step dispatches only while an '
+                   'arrival is queued with a free slot (TTFT-optimal).'
+                   '  On by default under --profile latency; '
+                   '--no-adaptive-window turns it off explicitly.')
 @click.option('--auto-prefix', is_flag=True, default=False,
               help='Automatic prefix caching: a prompt head seen '
                    'twice registers itself as a resident prefix '

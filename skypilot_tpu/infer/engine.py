@@ -83,12 +83,15 @@ class InferConfig:
     # so in-flight requests keep generating while a burst of new requests
     # prefills instead of stalling behind the whole burst.
     prefills_per_gap: int = 4
-    # Occupancy-adaptive decode window (latency serving): when at most a
-    # quarter of the slots are active, dispatch SHORT (2-step) windows
-    # instead of decode_steps — a new arrival then waits at most 2 steps
-    # for a prefill gap (vs decode_steps) and SSE chunks flow smoother,
-    # while the near-empty batch loses almost no amortization.  One
-    # extra compile (the short window's scan length).
+    # Queue-aware adaptive decode window (latency serving): full
+    # decode_steps windows while nothing is waiting (per-dispatch fixed
+    # cost amortizes over the whole window — TPOT = s + F/K), SHORT
+    # (2-step) windows only while an arrival is queued with a free slot
+    # to take it (it then waits at most 2 steps for a prefill gap).
+    # One extra compile (the short window's scan length).  See
+    # _select_window; policy history in docs/performance.md (the r4
+    # occupancy-based variant shortened windows for lone streams and
+    # lost on high-RTT chips).
     adaptive_decode_window: bool = False
     # Prompts prefilled per device dispatch (fixed batched-prefill width;
     # short chunks pad by duplicating a real lane).  Amortizes
@@ -222,6 +225,18 @@ def _pairs(ids_row, lps_row) -> List[Tuple[int, float]]:
     """[k] ids + [k] logprobs -> [(id, lp), ...] best-first (the
     host-side shape of one position's top_logprobs entry)."""
     return [(int(i), float(l)) for i, l in zip(ids_row, lps_row)]
+
+
+def _unpack_head(buf: np.ndarray, topk: int):
+    """Host inverse of the jitted pack_head: one transferred f32 block
+    [..., 2+2k] -> (tokens i32, logprobs f32, top-k ids i32, top-k lps
+    f32).  The id columns were bitcast on device; a same-itemsize view
+    restores them losslessly (the transfer is a byte copy)."""
+    toks = np.ascontiguousarray(buf[..., 0]).view(np.int32)
+    lps = buf[..., 1]
+    tids = np.ascontiguousarray(buf[..., 2:2 + topk]).view(np.int32)
+    tlps = buf[..., 2 + topk:]
+    return toks, lps, tids, tlps
 
 
 class _Slot:
@@ -420,6 +435,11 @@ class InferenceEngine:
         # natural finish cannot leak forever or poison a later request
         # reusing the same client-supplied id.
         self._cancelled: Dict[str, float] = {}
+        # Arrivals snapshot for the window policy (_select_window):
+        # generate_stream records the request-queue depth just before
+        # each step; 0 outside the serving loop, so offline generate()
+        # always runs full windows.
+        self._arrivals_hint = 0
         # Host mirrors of per-slot decode state (pushed to device each
         # step as small arrays).
         self._lengths = np.zeros((b,), np.int32)
@@ -531,6 +551,21 @@ class InferenceEngine:
             vals, ids = jax.lax.top_k(logits, topk)
             return ids.astype(jnp.int32), vals - logz[..., None]
 
+        def pack_head(chosen, chosen_lp, top_ids, top_lps):
+            """Bitcast-pack sampled tokens + logprobs + top-k
+            alternatives into ONE f32 block [..., 2 + 2*topk].  The
+            host then reads a single device->host transfer per
+            dispatch instead of four: on a tunneled chip every
+            transfer is a full round trip (~100 ms measured,
+            scripts/bench_decode_micro.py) and the extra three
+            dominated TPOT.  Unpacked by _unpack_head."""
+            f32 = jnp.float32
+            return jnp.concatenate([
+                jax.lax.bitcast_convert_type(chosen, f32)[..., None],
+                chosen_lp[..., None].astype(f32),
+                jax.lax.bitcast_convert_type(top_ids, f32),
+                top_lps.astype(f32)], axis=-1)
+
         def prefill_insert(params, tokens, true_lens, pcache, cache,
                            slots, temps, rng, adapter_ids, want_plp):
             """Fused batched prefill: P prompts forward + first-token
@@ -559,11 +594,16 @@ class InferenceEngine:
                 prompt_lps = chosen_logprob(logits[:, :-1],
                                             tokens[:, 1:])  # [P, S-1]
                 prompt_tops = topk_lp(logits[:, :-1])    # [P, S-1, k]
+                # [P, S-1, 1+2k]: lp + bitcast ids + lps, one block.
+                prompt_packed = jnp.concatenate([
+                    prompt_lps[..., None],
+                    jax.lax.bitcast_convert_type(prompt_tops[0],
+                                                 jnp.float32),
+                    prompt_tops[1].astype(jnp.float32)], axis=-1)
             else:
                 p_ = tokens.shape[0]
-                prompt_lps = jnp.zeros((p_, 0), jnp.float32)
-                prompt_tops = (jnp.zeros((p_, 0, topk), jnp.int32),
-                               jnp.zeros((p_, 0, topk), jnp.float32))
+                prompt_packed = jnp.zeros((p_, 0, 1 + 2 * topk),
+                                          jnp.float32)
 
             new_cache = []
             for (k, v), (pk, pv) in zip(cache, pc):
@@ -580,8 +620,8 @@ class InferenceEngine:
 
                 kk, vv = jax.lax.fori_loop(0, p, write, (k, v))
                 new_cache.append((kk, vv))
-            return (first, first_lp, first_top, prompt_lps, prompt_tops,
-                    new_cache)
+            return (pack_head(first, first_lp, *first_top),
+                    prompt_packed, new_cache)
 
         def decode(params, cache, tokens, lengths, temps, rng,
                    adapter_ids, steps):
@@ -610,8 +650,8 @@ class InferenceEngine:
             keys = jax.random.split(rng, steps)
             (cache, _, _), (toks, lps, gtoks, glps) = jax.lax.scan(
                 one_step, (cache, tokens, lengths), keys)
-            # toks/lps [K, B]; gtoks/glps [K, B, topk]
-            return toks, lps, gtoks, glps, cache
+            # One packed [K, B, 2+2*topk] block: single host transfer.
+            return pack_head(toks, lps, gtoks, glps), cache
 
         def spec_verify(params, cache, tokens, lengths, temps, rng,
                         adapter_ids):
@@ -635,7 +675,7 @@ class InferenceEngine:
                               greedy).astype(jnp.int32)
             preds_lp = chosen_logprob(logits, preds)         # [B, K]
             t_ids, t_lps = topk_lp(logits)                   # [B, K, k]
-            return preds, preds_lp, t_ids, t_lps, cache
+            return pack_head(preds, preds_lp, t_ids, t_lps), cache
 
         cache_dtype = self.cfg.cache_dtype
 
@@ -701,7 +741,7 @@ class InferenceEngine:
 
                 kk, vv = jax.lax.fori_loop(0, p, write, (k, v))
                 new_cache.append((kk, vv))
-            return first, first_lp, first_top, new_cache
+            return pack_head(first, first_lp, *first_top), new_cache
 
         self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,),
                                        static_argnums=(9,))
@@ -960,15 +1000,15 @@ class InferenceEngine:
                 f'{slots=} p={p}')
             self._rng, rkey = jax.random.split(self._rng)
             with self._ctx():
-                first, first_lp, first_top, self.cache = \
+                head, self.cache = \
                     self._prefix_prefill(
                         self.params, jnp.asarray(tokens), start,
                         jnp.asarray(true_lens), kv, self.cache,
                         jnp.asarray(slots), jnp.asarray(temps), rkey,
                         jnp.full((width,), aid, jnp.int32))
-            first_np = np.asarray(first)
-            first_lp_np = np.asarray(first_lp)
-            top_np = (np.asarray(first_top[0]), np.asarray(first_top[1]))
+            first_np, first_lp_np, tids, tlps = _unpack_head(
+                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+            top_np = (tids, tlps)
             now = time.time()
             for i, (req, slot, submit_time, n, _, max_new) in \
                     enumerate(chunk):
@@ -1059,20 +1099,22 @@ class InferenceEngine:
                                for it in chunk)
                 self._rng, key = jax.random.split(self._rng)
                 with self._ctx():   # mesh+rules active at trace time
-                    (first, first_lp, first_top, prompt_lps,
-                     prompt_tops, self.cache) = self._prefill_insert(
+                    (head, prompt_packed,
+                     self.cache) = self._prefill_insert(
                          self.params, jnp.asarray(tokens),
                          jnp.asarray(true_lens), pcache, self.cache,
                          jnp.asarray(slots), jnp.asarray(temps), key,
                          jnp.asarray(aids), want_plp)
-                first_np = np.asarray(first)
-                first_lp_np = np.asarray(first_lp)
-                top_np = (np.asarray(first_top[0]),
-                          np.asarray(first_top[1]))
+                topk = self.cfg.logprob_topk
+                first_np, first_lp_np, tids, tlps = _unpack_head(
+                    np.asarray(head), topk)              # ONE transfer
+                top_np = (tids, tlps)
                 if want_plp:
-                    plp_np = np.asarray(prompt_lps)
-                    ptop_np = (np.asarray(prompt_tops[0]),
-                               np.asarray(prompt_tops[1]))
+                    pbuf = np.asarray(prompt_packed)     # [P, S-1, 1+2k]
+                    plp_np = pbuf[..., 0]
+                    ptop_np = (np.ascontiguousarray(
+                                   pbuf[..., 1:1 + topk]).view(np.int32),
+                               pbuf[..., 1 + topk:])
                 now = time.time()
                 for i, (req, slot, submit_time, n, _, max_new) in \
                         enumerate(chunk):
@@ -1142,6 +1184,23 @@ class InferenceEngine:
             self._cancelled.pop(req.request_id, None)   # stale mark
         return req, res
 
+    def _select_window(self) -> int:
+        """Decode-window policy (adaptive_decode_window): QUEUE-aware,
+        not occupancy-based.  TPOT at window K is s + F/K where F is the
+        per-dispatch fixed cost and s the marginal per-step cost —
+        measured on the tunneled v5e, F ~= 112 ms vs s ~= 16 ms
+        (scripts/bench_decode_micro.py), so short windows are only ever
+        worth their TPOT tax while an arrival is actually WAITING for
+        the next prefill gap with a slot free to take it.  An earlier
+        occupancy heuristic (short window whenever few slots are busy)
+        gave an interactive user streaming alone the WORST inter-token
+        latency — precisely the case a latency profile cares about."""
+        steps = self.cfg.decode_steps
+        if (self.cfg.adaptive_decode_window and self._arrivals_hint > 0
+                and any(s is None for s in self._slots)):
+            return min(2, steps)
+        return steps
+
     def _decode_step(self, steps: Optional[int] = None) -> None:
         """One decode dispatch (K scanned steps); appends up to K tokens
         to every active slot, truncating at EOS / max_new (tokens past a
@@ -1149,24 +1208,16 @@ class InferenceEngine:
         the cache rows they wrote are dead and get overwritten when the
         slot is recycled)."""
         if steps is None:
-            steps = self.cfg.decode_steps
-            if (self.cfg.adaptive_decode_window and
-                    sum(s is not None for s in self._slots) <=
-                    max(1, self.cfg.num_slots // 4)):
-                # Low occupancy: a short window loses almost no
-                # amortization (few active slots) and bounds how long a
-                # new arrival waits for the next prefill gap.
-                steps = min(2, steps)
+            steps = self._select_window()
         self._rng, key = jax.random.split(self._rng)
         with self._ctx():           # mesh+rules active at trace time
-            toks, lps, gtoks, glps, self.cache = self._decode(
+            packed, self.cache = self._decode(
                 self.params, self.cache, jnp.asarray(self._last_tokens),
                 jnp.asarray(self._lengths), jnp.asarray(self._temps), key,
                 jnp.asarray(self._slot_adapters), steps)
-        toks_np = np.asarray(toks)                           # [K, B]
-        lps_np = np.asarray(lps)
-        gtoks_np = np.asarray(gtoks)
-        glps_np = np.asarray(glps)
+        # ONE device->host transfer for the whole window (pack_head).
+        toks_np, lps_np, gtoks_np, glps_np = _unpack_head(
+            np.asarray(packed), self.cfg.logprob_topk)       # [K, B...]
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -1244,14 +1295,12 @@ class InferenceEngine:
         self._spec_skips = 0
         self._rng, key = jax.random.split(self._rng)
         with self._ctx():
-            preds, preds_lp, g_np_, g_lp_, self.cache = self._spec_verify(
+            packed, self.cache = self._spec_verify(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.asarray(self._lengths), jnp.asarray(self._temps), key,
                 jnp.asarray(self._slot_adapters))
-        preds_np = np.asarray(preds)                         # [B, K]
-        preds_lp_np = np.asarray(preds_lp)
-        g_toks_np = np.asarray(g_np_)
-        g_lps_np = np.asarray(g_lp_)
+        preds_np, preds_lp_np, g_toks_np, g_lps_np = _unpack_head(
+            np.asarray(packed), self.cfg.logprob_topk)       # [B, K...]
         self.spec_stats['dispatches'] += 1
         accepted_before = self.spec_stats['accepted']
         for i, s in enumerate(self._slots):
@@ -1349,7 +1398,9 @@ class InferenceEngine:
 
     def generate(self, requests: List[Request]) -> List[RequestResult]:
         """Offline batch generation with continuous batching: slots are
-        refilled from the pending list as requests finish."""
+        refilled from the pending list as requests finish.  Runs full
+        decode windows (no backlog exists here) — warmup_decode sets
+        the hint deliberately to compile the short variant."""
         with self._lock:
             pending = list(requests)
             finished: List[Tuple[Request, RequestResult]] = []
@@ -1398,6 +1449,18 @@ class InferenceEngine:
                         idle_sleep: float = 0.005) -> None:
         """Server loop: pull requests from a queue, run continuous
         batching forever, deliver RequestResults via result_cb."""
+        try:
+            self._serve_loop(request_queue, result_cb, stop_event,
+                             idle_sleep)
+        finally:
+            # A loop stopped with a non-empty queue must not leave a
+            # stale positive hint that would force short windows on
+            # later offline generate() calls (the init invariant:
+            # hint is 0 outside the serving loop).
+            self._arrivals_hint = 0
+
+    def _serve_loop(self, request_queue, result_cb, stop_event,
+                    idle_sleep) -> None:
         while not stop_event.is_set():
             moved = False
             to_start = []
@@ -1499,6 +1562,11 @@ class InferenceEngine:
                 for _, res in self._harvest():   # prefill-only finishes
                     result_cb(res)
                 if any(s is not None for s in self._slots):
+                    # Snapshot the backlog for the window policy: only
+                    # requests still queued at step time are waiting on
+                    # the next prefill gap (the cap/slot-exhaustion
+                    # leftovers from the dequeue phase above).
+                    self._arrivals_hint = request_queue.qsize()
                     self._step()
                     self._flush_streams()
                     for _, res in self._harvest():
@@ -1509,28 +1577,22 @@ class InferenceEngine:
 
     def warmup_decode(self, tokens: Sequence[int]) -> None:
         """Compile every decode-window variant outside the serving /
-        measurement path: with adaptive_decode_window a single warmup
-        request only compiles the SHORT (2-step) window — the full
-        decode_steps variant would then jit mid-serving on the first
-        real burst, stalling the whole data plane for the compile."""
+        measurement path: a plain warmup request compiles only the FULL
+        decode_steps window (the queue-aware policy runs full windows
+        whenever nothing is waiting) — the short variant would then jit
+        mid-serving on the first real burst, stalling the whole data
+        plane for the compile.  num_slots == 1 skips it: the short
+        window requires a free slot while another decodes, unreachable
+        with one slot (in serving too, so no compile is needed)."""
         self.generate([Request(tokens=list(tokens), max_new_tokens=2)])
-        if self.cfg.adaptive_decode_window and self.cfg.decode_steps > 2:
-            n = self._warmup_decode_fanout(self.cfg.num_slots)
-            if n:
+        if (self.cfg.adaptive_decode_window and self.cfg.decode_steps > 2
+                and self.cfg.num_slots >= 2):
+            self._arrivals_hint = 1      # force the short-window variant
+            try:
                 self.generate([Request(tokens=list(tokens),
-                                       max_new_tokens=2)
-                               for _ in range(n)])
-
-    @staticmethod
-    def _warmup_decode_fanout(num_slots: int) -> int:
-        """How many concurrent warmup requests force the FULL decode
-        window under the adaptive policy (occupancy must EXCEED
-        max(1, num_slots // 4) — see _decode_step).  num_slots == 1 can
-        never exceed that threshold, so the full variant is unreachable
-        in serving too and needs no compile: return 0."""
-        if num_slots <= 1:
-            return 0
-        return min(num_slots, max(2, num_slots // 4 + 1))
+                                       max_new_tokens=2)])
+            finally:
+                self._arrivals_hint = 0
 
     def _warm_spec(self, prompt_len: int) -> None:
         """Compile the speculative verify path outside a benchmark's

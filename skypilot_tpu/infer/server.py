@@ -1403,7 +1403,8 @@ def main() -> None:
                         help='directory POST /load_adapter may read '
                              'from (unset: runtime loading disabled)')
     parser.add_argument('--adaptive-window', action='store_true',
-                        help='short decode windows at low occupancy')
+                        help='queue-aware decode windows: short '
+                             'dispatches only while arrivals wait')
     parser.add_argument('--auto-prefix', action='store_true',
                         help='automatic prefix caching: a prompt head '
                              'seen twice registers itself (bucket-'

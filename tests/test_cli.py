@@ -180,7 +180,8 @@ def test_infer_profile_presets(runner, monkeypatch):
                                 '--num-slots', '12'])
     assert r.exit_code == 0, r.output
     assert captured['num_slots'] == 12          # explicit wins
-    assert captured['decode_steps'] == 8        # preset fills the rest
+    assert captured['decode_steps'] == 16       # preset fills the rest
+    assert captured['adaptive_window'] is True  # queue-aware window on
 
 
 def test_infer_serve_lora_flags(runner, monkeypatch):

@@ -1455,10 +1455,11 @@ def test_lm_eval_loglikelihood_client_end_to_end(tiny_config):
 
 
 def test_adaptive_decode_window_token_identity(tiny_config):
-    """Occupancy-adaptive windows (2-step dispatches while <=1/4 of
-    slots are active) change only the dispatch schedule, never the
-    tokens: greedy output is identical to the fixed-window engine, and
-    the short window actually engages at low occupancy."""
+    """Queue-aware adaptive windows (2-step dispatches while an arrival
+    waits with a free slot — _select_window) change only the dispatch
+    schedule, never the tokens: greedy output is identical to the
+    fixed-window engine in both regimes, and each regime engages
+    exactly when its condition holds."""
     cfg = InferConfig(num_slots=8, max_cache_len=64, prefill_buckets=(8,),
                       max_new_tokens=12, cache_dtype=jnp.float32,
                       decode_steps=8)
@@ -1478,17 +1479,21 @@ def test_adaptive_decode_window_token_identity(tiny_config):
         return orig(*args)
 
     adaptive._decode = spy
-    # One active slot out of 8 -> low occupancy -> short windows.
+    # Backlog signalled + 7 free slots -> short windows throughout.
+    adaptive._arrivals_hint = 1
     [got] = adaptive.generate([Request(tokens=list(prompt),
                                        max_new_tokens=12)])
     assert got.output_tokens == want.output_tokens
     assert calls and all(k == 2 for k in calls), calls
-    # At high occupancy (all slots busy) the full window is used.
+    # No backlog (offline generate): FULL windows even at occupancy 1
+    # — the r4 occupancy policy shortened here and lost (TPOT = s +
+    # F/K; docs/performance.md r5 section).
+    adaptive._arrivals_hint = 0
     calls.clear()
-    reqs = [Request(tokens=[5 + i, 6, 7], max_new_tokens=9)
-            for i in range(8)]
-    adaptive.generate(reqs)
-    assert 8 in calls, calls
+    [got2] = adaptive.generate([Request(tokens=list(prompt),
+                                        max_new_tokens=12)])
+    assert got2.output_tokens == want.output_tokens
+    assert calls and all(k == 8 for k in calls), calls
 
 
 def test_openai_chat_logprobs(tiny_config):
@@ -1723,29 +1728,78 @@ def test_cancel_frees_slot_midstream(tiny_config):
     srv.stop()
 
 
-def test_warmup_decode_fanout_contract(tiny_config):
-    """ADVICE r4: the adaptive-window second warmup must EXCEED the
-    short-window occupancy threshold (max(1, num_slots // 4) — see
-    _decode_step) for every slot count where the full window is
-    reachable, else the full variant jits mid-serving on the first real
-    burst.  num_slots == 1 can never exceed the threshold: the full
-    window is unreachable in serving too, so the warmup skips it."""
-    f = InferenceEngine._warmup_decode_fanout
-    assert f(1) == 0
-    for ns in range(2, 65):
-        n = f(ns)
-        assert 2 <= n <= ns, ns
-        assert n > max(1, ns // 4), ns   # full window actually taken
-    # A 1-slot adaptive engine still warms up cleanly (and serves).
+def test_adaptive_window_is_queue_aware(tiny_config):
+    """The adaptive decode window is QUEUE-aware: full decode_steps
+    whenever nothing is waiting (TPOT = s + F/K — per-dispatch fixed
+    cost F dominates short windows, scripts/bench_decode_micro.py), and
+    the short window ONLY while an arrival is queued with a free slot
+    to take it.  The earlier occupancy heuristic gave a user streaming
+    alone the worst TPOT; this pins the policy so it cannot regress."""
     eng = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=4, max_cache_len=64, prefill_buckets=(8,),
+                    max_new_tokens=8, cache_dtype=jnp.float32,
+                    decode_steps=8, adaptive_decode_window=True),
+        rng=jax.random.PRNGKey(3))
+
+    class _Busy:                      # stand-in slot marker
+        pass
+
+    # Streaming alone (no backlog): FULL window, whatever occupancy.
+    eng._slots[0] = _Busy()
+    eng._arrivals_hint = 0
+    assert eng._select_window() == 8
+    # Backlog + a free slot: short window bounds the arrival's wait.
+    eng._arrivals_hint = 2
+    assert eng._select_window() == 2
+    # Backlog but NO free slot: the arrival cannot prefill anyway —
+    # keep the full window's amortization.
+    eng._slots = [_Busy()] * 4
+    assert eng._select_window() == 8
+    # Policy off: always full.
+    eng._slots = [_Busy(), None, None, None]
+    eng.cfg.adaptive_decode_window = False
+    assert eng._select_window() == 8
+    # A 1-slot adaptive engine warms up cleanly (short variant skipped:
+    # unreachable in serving) and generates full windows.
+    eng1 = InferenceEngine(
         tiny_config,
         InferConfig(num_slots=1, max_cache_len=64, prefill_buckets=(8,),
                     max_new_tokens=4, cache_dtype=jnp.float32,
                     decode_steps=8, adaptive_decode_window=True),
-        rng=jax.random.PRNGKey(3))
-    eng.warmup_decode([1, 2, 3])
-    res = eng.generate([Request(tokens=[4, 5, 6], max_new_tokens=3)])[0]
+        rng=jax.random.PRNGKey(4))
+    eng1.warmup_decode([1, 2, 3])
+    res = eng1.generate([Request(tokens=[4, 5, 6], max_new_tokens=3)])[0]
     assert len(res.output_tokens) == 3
+
+
+def test_adaptive_window_full_for_lone_stream(tiny_config):
+    """End-to-end: a single client streaming with the adaptive window
+    on receives FULL decode_steps-sized chunks (under the old
+    occupancy policy the lone stream got 2-token chunks — the worst
+    inter-token latency exactly when serving one interactive user)."""
+    from skypilot_tpu.infer import server as srv_mod
+    eng = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=4, max_cache_len=64, prefill_buckets=(8,),
+                    max_new_tokens=24, cache_dtype=jnp.float32,
+                    decode_steps=6, adaptive_decode_window=True),
+        rng=jax.random.PRNGKey(8))
+    srv = srv_mod.InferenceServer(eng)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    sizes = []
+    for kind, value in srv.submit_stream(
+            Request(tokens=[4, 5, 6], max_new_tokens=24)):
+        if kind == 'tokens':
+            sizes.append(len(value))
+        elif kind == 'done':
+            break
+    srv.stop()
+    # First chunk: prefill token (1) possibly merged with a decode
+    # window flush; later chunks must be full 6-step windows.
+    assert sum(sizes) == 24
+    assert max(sizes) == 6, sizes     # full window, not the short 2
 
 
 def test_auto_prefix_counts_n_clones_once(tiny_config):
