@@ -74,7 +74,7 @@ def main():
     def dispatch(cache, k):
         out = eng._decode(eng.params, cache, tokens, lengths, temps,
                           key, adapters, k)
-        return out[0], out[1]     # packed head [K, B, 2+2k], new cache
+        return out[0], out[3]     # packed head [K, B, 2+2k], new cache
 
     results = {}
     for k in args.windows:

@@ -853,7 +853,8 @@ _INFER_PROFILES = {
     # slots were busy — and was left opt-in; the queue-aware policy
     # replaced it.)  throughput keeps the widest window and batch.
     'latency': {'num_slots': 32, 'decode_steps': 16,
-                'prefills_per_gap': 2, 'adaptive_window': True},
+                'prefills_per_gap': 2, 'adaptive_window': True,
+                'decode_lookahead': True},
     'throughput': {'num_slots': 48, 'decode_steps': 32,
                    'prefills_per_gap': 4},
 }
@@ -955,6 +956,13 @@ def infer():
                    'arrival is queued with a free slot (TTFT-optimal).'
                    '  On by default under --profile latency; '
                    '--no-adaptive-window turns it off explicitly.')
+@click.option('--decode-lookahead/--no-decode-lookahead', default=False,
+              help='Dispatch the next decode window from device-side '
+                   'state before reading the current one: steady-state '
+                   'decode pays max(round-trip, compute) per window '
+                   'instead of their sum.  Skipped automatically while '
+                   'arrivals wait (TTFT) and under --draft-len.  On by '
+                   'default under --profile latency.')
 @click.option('--auto-prefix', is_flag=True, default=False,
               help='Automatic prefix caching: a prompt head seen '
                    'twice registers itself as a resident prefix '
@@ -967,16 +975,18 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 prefills_per_gap, platform, max_ttft, max_queue,
                 draft_len, ngram_max, max_prefixes, lora_rank,
                 lora_max_adapters, adapter_dir, adaptive_window,
-                auto_prefix):
+                decode_lookahead, auto_prefix):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
         'num_slots': num_slots, 'decode_steps': decode_steps,
         'prefills_per_gap': prefills_per_gap,
-        'adaptive_window': adaptive_window})
+        'adaptive_window': adaptive_window,
+        'decode_lookahead': decode_lookahead})
     num_slots, decode_steps = knobs['num_slots'], knobs['decode_steps']
     prefills_per_gap = knobs['prefills_per_gap']
     adaptive_window = knobs['adaptive_window']
+    decode_lookahead = knobs['decode_lookahead']
     click.echo(f'serving {hf_model or model} on {host}:{port}')
     infer_server.run(model=model, host=host, port=port,
                      num_slots=num_slots, max_cache_len=max_cache_len,
@@ -993,6 +1003,7 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      lora_max_adapters=lora_max_adapters,
                      adapter_dir=adapter_dir,
                      adaptive_window=adaptive_window,
+                     decode_lookahead=decode_lookahead,
                      auto_prefix=auto_prefix)
 
 
@@ -1032,11 +1043,15 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                    'line gains spec_* acceptance counters.')
 @click.option('--ngram-max', type=int, default=4,
               help='Longest n-gram tried when drafting (--draft-len).')
+@click.option('--adaptive-window/--no-adaptive-window', default=False,
+              help='Queue-aware decode windows (see infer serve).')
+@click.option('--decode-lookahead/--no-decode-lookahead', default=False,
+              help='RTT-hiding lookahead dispatch (see infer serve).')
 @click.pass_context
 def infer_bench(ctx, model, num_requests, prompt_len, new_tokens,
                 num_slots, max_cache_len, decode_steps, cache_dtype,
                 weight_dtype, serving, qps, prefills_per_gap, profile,
-                draft_len, ngram_max):
+                draft_len, ngram_max, adaptive_window, decode_lookahead):
     """Benchmark the engine (req/s, tok/s, TTFT) with synthetic prompts."""
     import dataclasses as _dc
     import json as json_lib
@@ -1046,7 +1061,9 @@ def infer_bench(ctx, model, num_requests, prompt_len, new_tokens,
     from skypilot_tpu.models import get_model_config
     knobs = _apply_infer_profile(ctx, profile, {
         'num_slots': num_slots, 'decode_steps': decode_steps,
-        'prefills_per_gap': prefills_per_gap})
+        'prefills_per_gap': prefills_per_gap,
+        'adaptive_window': adaptive_window,
+        'decode_lookahead': decode_lookahead})
     num_slots = knobs['num_slots']
     decode_steps = knobs['decode_steps']
     prefills_per_gap = knobs['prefills_per_gap']
@@ -1055,7 +1072,9 @@ def infer_bench(ctx, model, num_requests, prompt_len, new_tokens,
                       decode_steps=decode_steps,
                       prefills_per_gap=prefills_per_gap,
                       cache_dtype=resolve_cache_dtype(cache_dtype),
-                      draft_len=draft_len, ngram_max=ngram_max)
+                      draft_len=draft_len, ngram_max=ngram_max,
+                      adaptive_decode_window=knobs['adaptive_window'],
+                      decode_lookahead=knobs['decode_lookahead'])
     model_config = get_model_config(model)
     if weight_dtype != 'bf16':
         from skypilot_tpu.models.llama import LlamaConfig

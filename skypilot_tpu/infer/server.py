@@ -1238,6 +1238,7 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         lora_max_adapters: int = 8,
         adapter_dir: Optional[str] = None,
         adaptive_window: bool = False,
+        decode_lookahead: bool = False,
         auto_prefix: bool = False) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
@@ -1354,7 +1355,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       draft_len=draft_len, ngram_max=ngram_max,
                       max_prefixes=max_prefixes, lora_rank=lora_rank,
                       lora_max_adapters=lora_max_adapters,
-                      adaptive_decode_window=adaptive_window)
+                      adaptive_decode_window=adaptive_window,
+                      decode_lookahead=decode_lookahead)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -1405,6 +1407,10 @@ def main() -> None:
     parser.add_argument('--adaptive-window', action='store_true',
                         help='queue-aware decode windows: short '
                              'dispatches only while arrivals wait')
+    parser.add_argument('--decode-lookahead', action='store_true',
+                        help='dispatch the next decode window before '
+                             'reading the current one (hides the '
+                             'host round trip from TPOT)')
     parser.add_argument('--auto-prefix', action='store_true',
                         help='automatic prefix caching: a prompt head '
                              'seen twice registers itself (bucket-'
@@ -1421,6 +1427,7 @@ def main() -> None:
         lora_max_adapters=args.lora_max_adapters,
         adapter_dir=args.adapter_dir,
         adaptive_window=args.adaptive_window,
+        decode_lookahead=args.decode_lookahead,
         auto_prefix=args.auto_prefix)
 
 

@@ -182,6 +182,7 @@ def test_infer_profile_presets(runner, monkeypatch):
     assert captured['num_slots'] == 12          # explicit wins
     assert captured['decode_steps'] == 16       # preset fills the rest
     assert captured['adaptive_window'] is True  # queue-aware window on
+    assert captured['decode_lookahead'] is True  # RTT-hiding dispatch
 
 
 def test_infer_serve_lora_flags(runner, monkeypatch):
@@ -203,3 +204,30 @@ def test_infer_serve_lora_flags(runner, monkeypatch):
     assert captured['lora_rank'] == 8
     assert captured['lora_max_adapters'] == 4
     assert captured['adapter_dir'] == '/adapters'
+
+
+def test_infer_bench_profile_carries_window_knobs(runner, monkeypatch):
+    """`infer bench --profile latency` must benchmark the SAME operating
+    point `infer serve --profile latency` runs: the preset's
+    adaptive_window and decode_lookahead knobs reach the InferConfig
+    (previously they were silently dropped, so bench measured ~53 ms
+    TPOT where serve delivered ~27-38 ms)."""
+    import skypilot_tpu.cli as cli_mod
+    captured = {}
+
+    class FakeEngine:
+        def __init__(self, model_config, cfg, **kw):
+            captured['cfg'] = cfg
+
+        def benchmark(self, **kw):
+            return {}
+
+    import skypilot_tpu.infer as infer_mod
+    monkeypatch.setattr(infer_mod, 'InferenceEngine', FakeEngine)
+    r = runner.invoke(cli_mod.cli, ['infer', 'bench', '--model',
+                                    'llama-debug', '--profile', 'latency'])
+    assert r.exit_code == 0, r.output
+    cfg = captured['cfg']
+    assert cfg.decode_steps == 16
+    assert cfg.adaptive_decode_window is True
+    assert cfg.decode_lookahead is True

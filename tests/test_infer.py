@@ -1892,3 +1892,97 @@ def test_cancel_after_natural_finish_leaves_no_stale_mark(tiny_config):
     assert res is not None and res.finish_reason not in ('cancelled',
                                                          'error')
     srv.stop()
+
+
+def test_decode_lookahead_token_identity(tiny_config):
+    """Decode lookahead (dispatch window N+1 from device-side state
+    before reading window N) changes only the dispatch schedule, never
+    the tokens: a lone greedy stream through the serving loop matches
+    offline generate() exactly, and sequential requests — each
+    recycling the other's slot via prefill, forcing the
+    consume-before-prefill path — stay token-identical too."""
+    from skypilot_tpu.infer import server as srv_mod
+    cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=24, cache_dtype=jnp.float32,
+                      decode_steps=4, decode_lookahead=True)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(21))
+    plain = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+                    max_new_tokens=24, cache_dtype=jnp.float32,
+                    decode_steps=4),
+        params=eng.params, rng=jax.random.PRNGKey(21))
+    prompts = [[4, 5, 6], [7, 8], [5, 5, 5, 5], [9, 3, 1]]
+    want = [plain.generate([Request(tokens=list(p),
+                                    max_new_tokens=24)])[0].output_tokens
+            for p in prompts]
+    srv = srv_mod.InferenceServer(eng)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    dispatches = {'n': 0}
+    orig = eng._decode
+
+    def spy(*args):
+        dispatches['n'] += 1
+        return orig(*args)
+
+    eng._decode = spy
+    for p, w in zip(prompts, want):
+        res = srv.submit(Request(tokens=list(p), max_new_tokens=24),
+                         timeout=120)
+        assert res is not None and res.finish_reason == 'length', res
+        assert res.output_tokens == w, (p, res.output_tokens, w)
+    srv.stop()
+    # Lookahead actually engaged: a lone 24-token stream at window 4
+    # needs ~6 windows consumed, and every consumed window (except
+    # per-request tails) was pre-dispatched — so dispatch count must
+    # exceed the no-lookahead minimum (one per consumed window) by the
+    # speculative extras.
+    assert dispatches['n'] > len(prompts) * (24 // 4), dispatches
+
+
+def test_decode_lookahead_prefill_during_flight(tiny_config):
+    """A request arriving while another stream's lookahead window is in
+    flight prefills WITHOUT waiting for it: the snapshot keeps the
+    recycled slot from consuming a stale column and the epoch bump
+    keeps the chain from being extended — both streams' outputs stay
+    token-identical to offline generate()."""
+    import time as time_mod
+
+    from skypilot_tpu.infer import server as srv_mod
+    cfg = InferConfig(num_slots=2, max_cache_len=96, prefill_buckets=(8,),
+                      max_new_tokens=48, cache_dtype=jnp.float32,
+                      decode_steps=4, decode_lookahead=True)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(31))
+    plain = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=2, max_cache_len=96, prefill_buckets=(8,),
+                    max_new_tokens=48, cache_dtype=jnp.float32,
+                    decode_steps=4),
+        params=eng.params, rng=jax.random.PRNGKey(31))
+    pa, pb = [4, 5, 6], [9, 8, 7, 6]
+    want_a = plain.generate([Request(tokens=list(pa),
+                                     max_new_tokens=48)])[0].output_tokens
+    want_b = plain.generate([Request(tokens=list(pb),
+                                     max_new_tokens=48)])[0].output_tokens
+    srv = srv_mod.InferenceServer(eng)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    results = {}
+
+    def run_a():
+        results['a'] = srv.submit(Request(tokens=list(pa),
+                                          max_new_tokens=48), timeout=120)
+
+    ta = threading.Thread(target=run_a)
+    ta.start()
+    # Let A start decoding (its lookahead window in flight), then land B
+    # mid-stream — B's prefill recycles the free slot under an active
+    # chain.  Repeat the overlap a few times to hit different phases.
+    time_mod.sleep(0.8)
+    results['b'] = srv.submit(Request(tokens=list(pb),
+                                      max_new_tokens=48), timeout=120)
+    ta.join(timeout=120)
+    srv.stop()
+    assert results['a'].output_tokens == want_a
+    assert results['b'].output_tokens == want_b
