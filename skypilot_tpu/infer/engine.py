@@ -648,7 +648,7 @@ class InferenceEngine:
             # tokens/lengths/temps: [B]; `steps` (STATIC) tokens for
             # every slot in ONE dispatch (lax.scan), returning [K, B]
             # tokens.  steps = decode_steps normally; 2 when the
-            # adaptive window kicks in at low occupancy.
+            # queue-aware adaptive window engages (_select_window).
             def one_step(carry, key):
                 cache, tokens, lengths = carry
                 positions = lengths[:, None]
