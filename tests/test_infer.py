@@ -1986,3 +1986,60 @@ def test_decode_lookahead_prefill_during_flight(tiny_config):
     srv.stop()
     assert results['a'].output_tokens == want_a
     assert results['b'].output_tokens == want_b
+
+
+def test_decode_lookahead_stress_randomized(tiny_config):
+    """Randomized interleaving stress for the lookahead state machine:
+    24 greedy requests with random lengths and random arrival gaps
+    through a 4-slot serving loop — every mid-flight finish, recycle,
+    idle gap, and short/full window switch it produces must leave each
+    output identical to the solo offline result."""
+    import random as random_mod
+    import time as time_mod
+
+    from skypilot_tpu.infer import server as srv_mod
+    cfg = InferConfig(num_slots=4, max_cache_len=64, prefill_buckets=(8,),
+                      max_new_tokens=16, cache_dtype=jnp.float32,
+                      decode_steps=4, adaptive_decode_window=True,
+                      decode_lookahead=True)
+    eng = InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(41))
+    plain = InferenceEngine(
+        tiny_config,
+        InferConfig(num_slots=4, max_cache_len=64, prefill_buckets=(8,),
+                    max_new_tokens=16, cache_dtype=jnp.float32,
+                    decode_steps=4),
+        params=eng.params, rng=jax.random.PRNGKey(41))
+    r = random_mod.Random(7)
+    jobs = [(([r.randrange(1, 100) for _ in range(r.randrange(1, 7))]),
+             r.randrange(1, 16)) for _ in range(24)]
+    want = {}
+    for i, (toks, n) in enumerate(jobs):
+        want[i] = plain.generate([Request(tokens=list(toks),
+                                          max_new_tokens=n)
+                                  ])[0].output_tokens
+    srv = srv_mod.InferenceServer(eng)
+    srv.start()
+    assert srv.ready.wait(timeout=300)
+    got = {}
+    lock = threading.Lock()
+
+    def one(i, toks, n):
+        res = srv.submit(Request(tokens=list(toks), max_new_tokens=n),
+                         timeout=300)
+        with lock:
+            got[i] = res
+
+    threads = []
+    for i, (toks, n) in enumerate(jobs):
+        time_mod.sleep(r.random() * 0.15)   # random arrival phase
+        t = threading.Thread(target=one, args=(i, toks, n), daemon=True)
+        t.start()
+        threads.append(t)
+    for i, t in enumerate(threads):
+        t.join(timeout=300)
+        assert not t.is_alive(), f'request {i} ({jobs[i]}) hung'
+    srv.stop()
+    for i in range(len(jobs)):
+        assert got.get(i) is not None and \
+            got[i].finish_reason == 'length', (i, got.get(i))
+        assert got[i].output_tokens == want[i], (i, jobs[i])
