@@ -40,6 +40,10 @@ def main():
     ap.add_argument('--reps', type=int, default=20)
     ap.add_argument('--windows', type=int, nargs='+',
                     default=[1, 2, 4, 8, 16])
+    ap.add_argument('--prefill-chunk', type=int, default=64,
+                    help='chunk size for the worst-case decode-stall '
+                         'comparison (0 skips it); must divide '
+                         '--max-cache-len')
     args = ap.parse_args()
 
     import jax
@@ -137,11 +141,69 @@ def main():
     prefill_ms = (time.time() - t0) / reps * 1e3
     print(f'prefill bucket=256 P=1: {prefill_ms:.1f} ms', flush=True)
 
+    # Worst-case decode stall during a long-prompt prefill: monolithic
+    # prefill stalls every active slot for the whole bucket dispatch;
+    # chunked prefill (engine.py _chunk_round) stalls them for ONE
+    # [B, C] chunk dispatch per gap — the stall-bound model of
+    # docs/performance.md (TBT <= chunk_ms + window_ms).
+    chunk_stall = None
+    if args.prefill_chunk:
+        c = args.prefill_chunk
+        cache = out[2]        # the live cache (prior one was donated)
+        # Monolithic stall at the PRODUCTION dispatch shape: _start_batch
+        # always dispatches prefill_lanes wide (pad lanes duplicate the
+        # last real row), so even a lone long-prompt arrival stalls
+        # active slots for a [lanes, bucket] forward.
+        lanes = cfg.prefill_lanes
+        mtok = jnp.ones((lanes, 256), jnp.int32)
+        mlens = jnp.full((lanes,), args.prompt_len, jnp.int32)
+        mslots = jnp.zeros((lanes,), jnp.int32)
+        mcache = init_cache(model_config, lanes, 256, cfg.cache_dtype)
+        mtemps = jnp.zeros((lanes,), jnp.float32)
+        maids = jnp.full((lanes,), -1, jnp.int32)
+        out = eng._prefill_insert(eng.params, mtok, mlens, mcache, cache,
+                                  mslots, mtemps, key, maids, False)
+        _ = float(out[0][0, 0])                  # compile + sync
+        t0 = time.time()
+        for _ in range(reps):
+            out = eng._prefill_insert(eng.params, mtok, mlens, mcache,
+                                      out[2], mslots, mtemps, key,
+                                      maids, False)
+            _ = float(out[0][0, 0])
+        mono_ms = (time.time() - t0) / reps * 1e3
+        # Chunked stall: ONE full-width [B, C] chunk dispatch
+        # (_chunk_round advances every chunking slot per serving gap).
+        ctokens = jnp.ones((b, c), jnp.int32)
+        cstarts = jnp.zeros((b,), jnp.int32)
+        ctrue = jnp.full((b,), c - 1, jnp.int32)
+        out = eng._chunk_prefill(eng.params, ctokens, cstarts, ctrue,
+                                 out[2], temps, key, adapters)
+        _ = float(out[0][0, 0])                  # compile + sync
+        t0 = time.time()
+        for _ in range(reps):
+            out = eng._chunk_prefill(eng.params, ctokens, cstarts,
+                                     ctrue, out[1], temps, key,
+                                     adapters)
+            _ = float(out[0][0, 0])
+        chunk_ms = (time.time() - t0) / reps * 1e3
+        chunk_stall = {
+            'prefill_chunk': c,
+            'prefill_lanes': lanes,
+            'worst_case_stall_ms_monolithic': round(mono_ms, 2),
+            'worst_case_stall_ms_chunked': round(chunk_ms, 2),
+            'stall_reduction': round(mono_ms / chunk_ms, 2),
+        }
+        print(f'worst-case decode stall: monolithic [{lanes}, 256] '
+              f'{mono_ms:.1f} ms vs one [{b}, {c}] chunk {chunk_ms:.1f} '
+              f'ms ({mono_ms / chunk_ms:.1f}x)', flush=True)
+
     print(json.dumps({'model': args.model, 'num_slots': b,
                       'max_cache_len': args.max_cache_len,
                       'windows': {str(k): results[k] for k in results},
                       'fit_fixed_ms': f * 1e3,
-                      'fit_per_step_ms': s * 1e3}))
+                      'fit_per_step_ms': s * 1e3,
+                      'prefill_bucket256_p1_ms': round(prefill_ms, 2),
+                      'chunk_stall': chunk_stall}))
 
 
 if __name__ == '__main__':

@@ -29,7 +29,7 @@ sys.path.insert(0, '.')
 
 
 def _engine(draft_len=0, num_slots=16, max_cache_len=512,
-            prefill_lanes=4):
+            prefill_lanes=4, prefill_chunk=0):
     """7B int8 + fp8-KV engine sized for the 16 GB chip: at Hkv=32,
     D=128 a 7B cache row costs ~0.26 MB/token-layer-slot, so slots x
     cache_len is the HBM budget knob (48x512 = the serve-bench shape)."""
@@ -44,7 +44,8 @@ def _engine(draft_len=0, num_slots=16, max_cache_len=512,
     cfg = InferConfig(model='llama2-7b', num_slots=num_slots,
                       max_cache_len=max_cache_len, decode_steps=8,
                       cache_dtype=jnp.float8_e4m3fn, draft_len=draft_len,
-                      prefill_lanes=prefill_lanes)
+                      prefill_lanes=prefill_lanes,
+                      prefill_chunk=prefill_chunk)
     return InferenceEngine(cfg_m, cfg)
 
 
@@ -85,6 +86,50 @@ def bench_prefix(reps: int = 5):
         'prefill_ms_prefix_reuse': round(hot, 1),
         'ttft_reduction': round(1.0 - hot / cold, 3),
         'prefix_hits': hits,
+    }
+
+
+def bench_chunked_prefill(prefill_chunk: int = 64, reps: int = 3):
+    """Chunked-prefill cost/benefit at the long-prompt shape: offline
+    TTFT for a prompt no bucket holds (chunked engine) vs the same
+    prompt through the monolithic auto-appended bucket — the chunked
+    path trades a little lone-stream TTFT (per-chunk dispatch overhead)
+    for a bounded decode stall (BENCH_MICRO chunk_stall measures that
+    side)."""
+    import numpy as np
+
+    from skypilot_tpu.infer import Request
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 32000, size=1100).tolist()
+
+    def ttft_ms(eng):
+        eng.generate([Request(tokens=list(prompt), max_new_tokens=1)])
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            [res] = eng.generate([Request(tokens=list(prompt),
+                                          max_new_tokens=1)])
+            times.append((time.time() - t0) * 1000.0)
+            assert res.finish_reason == 'length'
+        return statistics.median(times)
+
+    eng = _engine(num_slots=4, max_cache_len=1152, prefill_lanes=1,
+                  prefill_chunk=prefill_chunk)
+    chunked = ttft_ms(eng)
+    stats = dict(eng.chunk_stats)
+    del eng
+    gc.collect()
+    eng = _engine(num_slots=4, max_cache_len=1152, prefill_lanes=1)
+    mono = ttft_ms(eng)
+    del eng
+    gc.collect()
+    return {
+        'prefill_chunk': prefill_chunk,
+        'prompt_len': len(prompt),
+        'ttft_ms_chunked': round(chunked, 1),
+        'ttft_ms_monolithic': round(mono, 1),
+        'ttft_overhead': round(chunked / mono - 1.0, 3),
+        'chunk_stats': stats,
     }
 
 
@@ -200,6 +245,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--out', default=None)
     ap.add_argument('--reps', type=int, default=5)
+    ap.add_argument('--prefill-chunk', type=int, default=64,
+                    help='chunk size for the chunked-prefill TTFT '
+                         'comparison (0 skips it)')
     args = ap.parse_args()
     result = {
         'description':
@@ -216,6 +264,10 @@ def main():
     print(json.dumps(result['prefix_cache']))
     result['speculative'] = bench_spec()
     print(json.dumps(result['speculative']))
+    if args.prefill_chunk:
+        result['chunked_prefill'] = bench_chunked_prefill(
+            prefill_chunk=args.prefill_chunk, reps=max(3, args.reps // 2))
+        print(json.dumps(result['chunked_prefill']))
     if args.out:
         with open(args.out, 'w') as f:
             json.dump(result, f, indent=2)

@@ -107,6 +107,21 @@ class InferConfig:
     # short chunks pad by duplicating a real lane).  Amortizes
     # per-dispatch latency the same way decode_steps does for decode.
     prefill_lanes: int = 4
+    # Chunked prefill: 0 = monolithic (today's behavior).  > 0 splits a
+    # long prompt into prefill_chunk-sized pieces forwarded one per
+    # serving gap over the slot's already-written KV rows, so active
+    # slots stall for ONE chunk instead of the whole prefill: worst-case
+    # time-between-tokens drops from full_prefill_ms to chunk_ms +
+    # window_ms (docs/performance.md).  Also lifts the largest-bucket
+    # prompt cap: prompts beyond the largest configured bucket are
+    # accepted (up to max_cache_len - max_new) and always chunked, so
+    # the auto-appended max_cache_len bucket — and its compile — go
+    # away.  Must divide max_cache_len (chunk writes start at multiples
+    # of the chunk and must never clamp at the cache end).  Requests
+    # wanting prompt_logprobs bypass chunking (non-final chunk logits
+    # are discarded).  Serving only for in-bucket prompts: offline
+    # generate() chunks only prompts no bucket can hold.
+    prefill_chunk: int = 0
     # Speculative decoding via prompt-lookup (n-gram) drafting: 0
     # disables (windowed decode).  With draft_len=D, every decode
     # dispatch feeds [last_token, d1..dD] — D draft tokens proposed by
@@ -270,6 +285,84 @@ class _Slot:
         self.prompt_tops: Optional[list] = None
 
 
+class _ChunkJob:
+    """A prompt mid-chunked-prefill.  Owns its slot (excluded from
+    _free_slot) but has no _Slot yet: rows [0, done) of the slot hold
+    its prompt KV; the host length mirror tracks `done` so interleaved
+    decode's dead-row writes for this lane land at the frontier, past
+    the already-written prompt rows.  The slot activates (becomes a
+    _Slot, first token sampled) on the final chunk."""
+    __slots__ = ('req', 'slot', 'submit_time', 'n', 'max_new', 'done',
+                 'aid')
+
+    def __init__(self, req: Request, slot: int, submit_time: float,
+                 n: int, max_new: int, aid: int):
+        self.req = req
+        self.slot = slot
+        self.submit_time = submit_time
+        self.n = n                         # total prompt tokens
+        self.max_new = max_new
+        self.done = 0                      # prompt rows already written
+        self.aid = aid
+
+
+# Backends whose int32<->f32 bitcast pack/unpack path has been verified
+# bit-exact this process, keyed by (backend, topk).  See
+# _check_bitcast_roundtrip.
+_BITCAST_CHECKED: set = set()
+
+
+def _check_bitcast_roundtrip(topk: int) -> None:
+    """Startup self-check for the packed-transfer path (ADVICE r5): the
+    engine bitcasts int32 token ids into an f32 block on device
+    (pack_head) and restores them host-side via a same-itemsize numpy
+    view (_unpack_head).  That is bit-exact on XLA CPU/TPU/GPU today,
+    but any backend or transfer layer that canonicalizes NaNs, flushes
+    subnormals, or converts instead of byte-copying would silently
+    corrupt token ids everywhere.  Round-trip id patterns that alias
+    the dangerous f32 encodings (quiet/signaling NaN, infinity,
+    subnormals, -0.0) through a jitted pack once per (backend, topk)
+    and fail loudly on mismatch."""
+    key = (jax.default_backend(), topk)
+    if key in _BITCAST_CHECKED:
+        return
+    ids = np.array([0, 1, -1,
+                    2**31 - 1,             # largest NaN bit pattern
+                    -2**31,                # -0.0
+                    0x7fc00000,            # f32 quiet NaN bit pattern
+                    0x7f800001,            # signaling NaN
+                    0xffc00000 - 2**32,    # -NaN (sign-bit set)
+                    0x7f800000,            # +inf
+                    0x00400000,            # subnormal
+                    101, 31999], np.int32)
+    b = ids.size
+    # Bit-pattern-diverse top-k ids without int32 overflow: XOR shifts.
+    tids = ids[:, None] ^ np.arange(topk, dtype=np.int32)[None]
+    f32 = jnp.float32
+
+    def pack(chosen, lp, top_ids, top_lps):
+        # Mirrors pack_head exactly (same concat layout, same bitcasts).
+        return jnp.concatenate([
+            jax.lax.bitcast_convert_type(chosen, f32)[..., None],
+            lp[..., None].astype(f32),
+            jax.lax.bitcast_convert_type(top_ids, f32),
+            top_lps.astype(f32)], axis=-1)
+
+    buf = np.asarray(jax.jit(pack)(
+        jnp.asarray(ids), jnp.linspace(-2.0, 0.0, b, dtype=jnp.float32),
+        jnp.asarray(tids), jnp.zeros((b, topk), jnp.float32)))
+    toks, _, rtids, _ = _unpack_head(buf, topk)
+    if not (np.array_equal(toks, ids) and np.array_equal(rtids, tids)):
+        raise RuntimeError(
+            f'int32<->f32 bitcast pack/unpack round-trip is not '
+            f'bit-exact on backend {jax.default_backend()!r}: token ids '
+            'would be silently corrupted in every dispatch (NaN '
+            'canonicalization / subnormal flush / non-byte-copy '
+            'transfer).  Serve on a backend with exact bitcast '
+            'transfers.')
+    _BITCAST_CHECKED.add(key)
+
+
 class InferenceEngine:
     """Single-process engine over the local device(s).
 
@@ -319,6 +412,19 @@ class InferenceEngine:
         if self.cfg.prefill_lanes < 1:
             raise ValueError(f'prefill_lanes must be >= 1 '
                              f'(got {self.cfg.prefill_lanes})')
+        if self.cfg.prefill_chunk < 0:
+            raise ValueError(f'prefill_chunk must be >= 0 '
+                             f'(got {self.cfg.prefill_chunk})')
+        if self.cfg.prefill_chunk and \
+                self.cfg.max_cache_len % self.cfg.prefill_chunk:
+            # Chunk writes are C-wide dynamic_update_slices starting at
+            # multiples of C: divisibility guarantees start + C <=
+            # max_cache_len, so the write is NEVER clamped — a clamped
+            # start (> M - C) would silently rewrite the slot's own
+            # earlier, still-live prompt rows with wrong-position K/V.
+            raise ValueError(
+                f'max_cache_len ({self.cfg.max_cache_len}) must be a '
+                f'multiple of prefill_chunk ({self.cfg.prefill_chunk})')
         if self.cfg.draft_len < 0:
             raise ValueError(f'draft_len must be >= 0 '
                              f'(got {self.cfg.draft_len})')
@@ -389,9 +495,12 @@ class InferenceEngine:
             self._init_fn = self.model.init
         buckets = tuple(b for b in self.cfg.prefill_buckets
                         if b <= self.cfg.max_cache_len)
-        if not buckets or buckets[-1] < self.cfg.max_cache_len:
+        if not buckets or (buckets[-1] < self.cfg.max_cache_len
+                           and not self.cfg.prefill_chunk):
             # Cover the (largest-bucket, cache-len] gap so any prompt the
-            # cache can hold has a bucket.
+            # cache can hold has a bucket.  With chunked prefill the gap
+            # is served by chunking instead — the max_cache_len bucket
+            # (and its compile) is dropped from the set.
             buckets += (self.cfg.max_cache_len,)
         self.cfg.prefill_buckets = buckets
         rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -460,6 +569,19 @@ class InferenceEngine:
         self._ahead = None
         self._serving = False
         self._prefill_epoch = 0
+        # Chunked prefill state: slot -> _ChunkJob for prompts whose KV
+        # rows are being written one prefill_chunk per serving gap
+        # (_chunk_round).  A chunking slot is reserved (not free) but
+        # has no _Slot yet.
+        self._chunking: Dict[int, _ChunkJob] = {}
+        self.chunk_stats = {'rounds': 0, 'chunks': 0, 'requests': 0}
+        # Phantom-arrival decay (ADVICE r5): consecutive serve-loop
+        # dequeue passes that yielded ONLY cancelled requests.  The
+        # queue depth then mostly counts tombstones, so the arrivals
+        # hint — which forces short windows and disables lookahead — is
+        # right-shifted by the streak (_serve_loop) instead of taking
+        # qsize() at face value.
+        self._cancel_only_streak = 0
         # Host mirrors of per-slot decode state (pushed to device each
         # step as small arrays).
         self._lengths = np.zeros((b,), np.int32)
@@ -469,6 +591,9 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._jit_fns()   # lazy wrappers; tracing happens (under _ctx)
                           # at the _start_batch/_decode_step call sites
+        # Every dispatch's token ids ride the bitcast-packed transfer:
+        # verify it is bit-exact on this backend before serving anything.
+        _check_bitcast_roundtrip(self.cfg.logprob_topk)
 
     # ---------------------------------------------------------- sharding
 
@@ -766,8 +891,43 @@ class InferenceEngine:
                 new_cache.append((kk, vv))
             return pack_head(first, first_lp, *first_top), new_cache
 
+        def chunk_prefill(params, tokens, starts, true_pos, cache,
+                          temps, rng, adapter_ids):
+            """One chunked-prefill dispatch, full slot width, directly
+            over the live engine cache (the generalization of
+            prefix_prefill's "suffix over preloaded rows" with DYNAMIC
+            per-lane starts — one compile total instead of one per
+            offset).  tokens [B, C]: lane i's next C prompt tokens
+            (zero-padded past the prompt); starts [B]: each lane's
+            write offset — a chunking lane's frontier (its rows
+            [0, start) already hold this prompt's KV from earlier
+            chunks), an active lane's length (dead-row writes past its
+            live rows, the invariant windowed decode already relies
+            on), 0 for idle lanes.  true_pos [B]: index WITHIN the
+            chunk of the last real token — only final chunks read the
+            sampled head.  The caller guarantees start + C <=
+            max_cache_len for every lane (config divisibility + the
+            _chunk_round clamp guard), so no write is ever clamped
+            onto live rows.  One dispatch advances EVERY in-progress
+            chunk job."""
+            c = tokens.shape[1]
+            positions = starts[:, None] + jnp.arange(c)[None]
+            logits, cache = model.apply(params, tokens, positions, cache,
+                                        **akw(adapter_ids))
+            last = jnp.take_along_axis(
+                logits, true_pos[:, None, None], axis=1)[:, 0]  # [B, V]
+            greedy = jnp.argmax(last, axis=-1)
+            sampled = jax.random.categorical(
+                rng, last / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
+            first = jnp.where(temps > 0, sampled,
+                              greedy).astype(jnp.int32)
+            first_lp = chosen_logprob(last, first)
+            first_top = topk_lp(last)                    # [B, k] x2
+            return pack_head(first, first_lp, *first_top), cache
+
         self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,),
                                        static_argnums=(9,))
+        self._chunk_prefill = jax.jit(chunk_prefill, donate_argnums=(4,))
         self._decode = jax.jit(decode, donate_argnums=(1,),
                                static_argnums=(7,))
         self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
@@ -787,23 +947,29 @@ class InferenceEngine:
 
     def _free_slot(self, exclude=()) -> Optional[int]:
         for i, s in enumerate(self._slots):
-            if s is None and i not in exclude:
+            if s is None and i not in exclude and i not in self._chunking:
                 return i
         return None
 
     def has_free_slot(self) -> bool:
         """Lock-free saturation peek for admission control: a free slot
         means arrivals are NOT queueing (benign race — a stale answer
-        only shifts one admission decision by one loop gap)."""
-        return any(s is None for s in self._slots)
+        only shifts one admission decision by one loop gap).  A slot
+        mid-chunked-prefill is occupied, not free."""
+        return any(s is None and i not in self._chunking
+                   for i, s in enumerate(self._slots))
 
     def _max_new(self, req: Request) -> int:
         return self.cfg.max_new_tokens if req.max_new_tokens is None \
             else req.max_new_tokens
 
-    def _validate_request(self, req: Request) -> Tuple[int, int, int]:
+    def _validate_request(self,
+                          req: Request) -> Tuple[int, Optional[int], int]:
         """Returns (prompt_len, bucket, max_new); raises ValueError on a
-        bad request."""
+        bad request.  bucket is None when no configured bucket holds the
+        prompt but chunked prefill (cfg.prefill_chunk) can: such prompts
+        are accepted up to max_cache_len - max_new and always take the
+        chunked path."""
         n = len(req.tokens)
         max_new = self._max_new(req)
         if n < 1:
@@ -821,12 +987,44 @@ class InferenceEngine:
             raise ValueError(
                 f'max_new_tokens must be >= 1 (got {max_new}); generation '
                 'always produces at least the prefill token')
-        bucket = self._bucket(n)
+        try:
+            bucket: Optional[int] = self._bucket(n)
+        except ValueError:
+            if not self.cfg.prefill_chunk:
+                raise
+            if req.want_prompt_logprobs:
+                # Prompt scoring needs EVERY prompt position's logits in
+                # one forward; chunked prefill discards non-final chunk
+                # logits.
+                raise ValueError(
+                    f'prompt_logprobs requires the prompt ({n}) to fit '
+                    f'the largest prefill bucket '
+                    f'({self.cfg.prefill_buckets[-1]})')
+            bucket = None
         if n + max_new > self.cfg.max_cache_len:
             raise ValueError(
                 f'prompt ({n}) + max_new_tokens ({max_new}) exceeds cache '
                 f'({self.cfg.max_cache_len})')
         return n, bucket, max_new
+
+    def _should_chunk(self, req: Request, n: int,
+                      bucket: Optional[int]) -> bool:
+        """Chunked-prefill policy.  A prompt no bucket holds MUST chunk
+        (that is how it got admitted).  In-bucket prompts chunk only in
+        the SERVING loop, only when longer than one chunk, and only when
+        someone would actually stall behind a monolithic prefill (an
+        active slot, or another prompt already chunking) — offline
+        batch throughput wants the one-dispatch prefill, and so does a
+        prompt arriving to an idle engine (chunking it would only slow
+        its own TTFT)."""
+        c = self.cfg.prefill_chunk
+        if not c or req.want_prompt_logprobs:
+            return False
+        if bucket is None:
+            return True
+        return (self._serving and n > c and
+                (any(s is not None for s in self._slots) or
+                 bool(self._chunking)))
 
     # --------------------------------------------------------- multi-LoRA
 
@@ -1092,6 +1290,26 @@ class InferenceEngine:
             for (key, start, sb), group in groups.items():
                 self._start_prefixed_group(group, start, sb, key)
             items = rest
+        if self.cfg.prefill_chunk:
+            rest = []
+            for it in items:
+                req, slot, submit_time, n, bucket, max_new = it
+                if not self._should_chunk(req, n, bucket):
+                    rest.append(it)
+                    continue
+                # Reserve the slot without activating it: chunks are
+                # written one per serving gap (_chunk_round); decode
+                # windows in between write dead rows at the frontier
+                # (the length mirror), which later chunks overwrite
+                # before any query position reaches them.
+                self._chunking[slot] = _ChunkJob(
+                    req, slot, submit_time, n, max_new,
+                    self._adapter_id(req))
+                self._lengths[slot] = 0
+                self._temps[slot] = 0.0
+                self._slot_adapters[slot] = -1
+                self.chunk_stats['requests'] += 1
+            items = rest
         lanes = self.cfg.prefill_lanes
         by_bucket: Dict[int, list] = {}
         for it in items:
@@ -1167,6 +1385,94 @@ class InferenceEngine:
                     self._temps[slot] = req.temperature
                     self._slot_adapters[slot] = self._adapter_id(req)
 
+    def _chunk_round(self) -> bool:
+        """Advance EVERY in-progress chunked prefill by one chunk in a
+        single full-width dispatch; activate slots whose final chunk
+        landed.  Returns True when a dispatch happened (the serving
+        loop's `moved`).  Called between decode windows, so an active
+        slot's worst-case inter-token stall is one chunk forward
+        instead of a whole prefill (TBT <= chunk_ms + window_ms,
+        docs/performance.md).
+
+        Two skip guards keep the cache-write invariants intact:
+
+        - an active slot within C of the cache end would get the
+          full-width dispatch's C-wide frontier write CLAMPED
+          (dynamic_update_slice start > M - C) onto its live rows —
+          the same hazard _spec_step guards.  Such slots finish within
+          ~C tokens (harvest at length+1 >= M), so skipping this gap
+          cannot deadlock: decode keeps running in between.
+        - an in-flight lookahead window's dead-row writes land AFTER a
+          chunk dispatched now, garbling the chunk's prompt rows at the
+          frontier; wait for the next decode step to consume it
+          (_maybe_dispatch_ahead is gated off while chunking, so at
+          most one window of delay).  With no active slot left to
+          consume the pending window, drop it instead — its snapshot
+          has no survivors, exactly what _decode_step would do.
+        """
+        if not self._chunking:
+            return False
+        c = self.cfg.prefill_chunk
+        m = self.cfg.max_cache_len
+        if self._ahead is not None:
+            if any(s is not None for s in self._slots):
+                return False
+            self._ahead = None
+        if any(s is not None and s.length + c > m
+               for s in self._slots):
+            return False
+        # An in-flight chain must never be extended across these writes
+        # (and a final chunk is a slot recycle, like any prefill).
+        self._prefill_epoch += 1
+        b = self.cfg.num_slots
+        tokens = np.zeros((b, c), np.int32)
+        starts = self._lengths.astype(np.int32, copy=True)
+        true_pos = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        aids = self._slot_adapters.astype(np.int32, copy=True)
+        finals = []
+        for slot, job in self._chunking.items():
+            real = min(c, job.n - job.done)
+            tokens[slot, :real] = job.req.tokens[job.done:job.done + real]
+            starts[slot] = job.done
+            true_pos[slot] = real - 1
+            aids[slot] = job.aid
+            if job.done + real >= job.n:
+                temps[slot] = job.req.temperature
+                finals.append((slot, job))
+            job.done += real
+            # Host mirror tracks the frontier: interleaved decode's
+            # dead-row writes for this lane land past the prompt rows
+            # already written.
+            self._lengths[slot] = job.done
+            self.chunk_stats['chunks'] += 1
+        self.chunk_stats['rounds'] += 1
+        self._rng, key = jax.random.split(self._rng)
+        with self._ctx():
+            head, self.cache = self._chunk_prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(starts),
+                jnp.asarray(true_pos), self.cache, jnp.asarray(temps),
+                key, jnp.asarray(aids))
+        if finals:
+            first_np, first_lp_np, tids, tlps = _unpack_head(
+                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+            now = time.time()
+            for slot, job in finals:
+                del self._chunking[slot]
+                s = _Slot(job.req, length=job.n,
+                          submit_time=job.submit_time,
+                          max_new=job.max_new)
+                s.first_token_time = now
+                s.generated.append(int(first_np[slot]))
+                s.lps.append(float(first_lp_np[slot]))
+                s.tops.append(_pairs(tids[slot], tlps[slot]))
+                self._slots[slot] = s
+                self._lengths[slot] = job.n
+                self._last_tokens[slot] = s.generated[0]
+                self._temps[slot] = job.req.temperature
+                self._slot_adapters[slot] = job.aid
+        return True
+
     def _flush_streams(self) -> None:
         """Deliver newly generated tokens of every active streaming slot.
         Callback errors are swallowed: a broken consumer must not kill
@@ -1227,8 +1533,15 @@ class InferenceEngine:
         gave an interactive user streaming alone the WORST inter-token
         latency — precisely the case a latency profile cares about."""
         steps = self.cfg.decode_steps
-        if (self.cfg.adaptive_decode_window and self._arrivals_hint > 0
-                and any(s is None for s in self._slots)):
+        if self.cfg.adaptive_decode_window and (
+                # A part-prefilled prompt is a pending arrival: its next
+                # chunk rides the gap after this window, so the short
+                # window bounds BOTH its time-to-first-token and the
+                # active slots' stall the same way a queued arrival does.
+                self._chunking or
+                (self._arrivals_hint > 0
+                 and any(s is None and i not in self._chunking
+                         for i, s in enumerate(self._slots)))):
             return min(2, steps)
         return steps
 
@@ -1257,13 +1570,16 @@ class InferenceEngine:
                     packed = None
             if packed is not None:
                 if chain is not None:
-                    self._maybe_dispatch_ahead(chain, snap)
+                    # The pending window (cfg.decode_steps long — ahead
+                    # windows are always full) is the in-flight budget.
+                    self._maybe_dispatch_ahead(chain, snap,
+                                               self.cfg.decode_steps)
                 self._consume_window(packed, snap)
                 return
         if steps is None:
             steps = self._select_window()
         packed, chain = self._dispatch_decode(steps)
-        self._maybe_dispatch_ahead(chain, list(self._slots))
+        self._maybe_dispatch_ahead(chain, list(self._slots), steps)
         self._consume_window(packed)
 
     def _dispatch_decode(self, steps: int):
@@ -1278,7 +1594,8 @@ class InferenceEngine:
                 jnp.asarray(self._slot_adapters), steps)
         return packed, (last, lens)
 
-    def _maybe_dispatch_ahead(self, chain, snap) -> None:
+    def _maybe_dispatch_ahead(self, chain, snap,
+                              in_flight_steps: int = 0) -> None:
         """Decode lookahead: dispatch the NEXT full window now, feeding
         the previous dispatch's DEVICE-side final tokens/lengths, so it
         never waits for the current window's host round trip — steady
@@ -1296,12 +1613,29 @@ class InferenceEngine:
           (one serial device stream), the snapshot keeps the new
           request from ever consuming a stale column, and the epoch
           bump keeps further lookahead off the stale chain;
-        - while arrivals wait (hint > 0) nothing speculates — the
-          in-flight window would push their prefill back in the device
-          queue (TTFT)."""
+        - while arrivals wait (hint > 0) or a prompt is mid-chunked-
+          prefill nothing speculates — the in-flight window would push
+          the prefill/chunk back in the device queue (TTFT), and a
+          chunk must never be dispatched under an in-flight window's
+          frontier writes (_chunk_round waits for _ahead to drain);
+        - a window that cannot produce a single deliverable token is
+          not dispatched (ADVICE r5): when every surviving snapshot
+          slot is guaranteed to finish inside the `in_flight_steps`
+          already dispatched but unconsumed (remaining budget - in-
+          flight <= 0), the ahead window's tokens would all be
+          discarded — pure dispatch waste at every stream tail."""
         if (not self.cfg.decode_lookahead or self.cfg.draft_len > 0 or
-                not self._serving or self._arrivals_hint > 0):
+                not self._serving or self._arrivals_hint > 0 or
+                self._chunking):
             return
+        live = [s for i, s in enumerate(snap)
+                if s is not None and self._slots[i] is s]
+        if not live:
+            return          # nobody left to deliver the window to
+        if all(min(s.max_new - len(s.generated),
+                   self.cfg.max_cache_len - 1 - s.length)
+               <= in_flight_steps for s in live):
+            return          # every survivor finishes in flight
         self._rng, key = jax.random.split(self._rng)
         with self._ctx():
             packed, last, lens, self.cache = self._decode(
@@ -1350,8 +1684,12 @@ class InferenceEngine:
         # write CLAMPED by dynamic_update_slice (start > M-k), silently
         # rewriting earlier, still-live rows.  Those slots finish within
         # a few tokens anyway: run exact windowed decode until they do.
-        if any(s is not None and s.length > cache_len - k
-               for s in self._slots):
+        # A chunking slot's frontier is the same hazard (its prompt rows
+        # below the frontier are live).
+        if (any(s is not None and s.length > cache_len - k
+                for s in self._slots) or
+                any(job.done > cache_len - k
+                    for job in self._chunking.values())):
             self._decode_step()
             return
         b = self.cfg.num_slots
@@ -1455,6 +1793,15 @@ class InferenceEngine:
                 if s is not None and s.request.request_id == request_id:
                     self._finish_slot(i, 'cancelled')
                     return True
+            for slot, job in list(self._chunking.items()):
+                if job.req.request_id == request_id:
+                    # Mid-chunked-prefill: free the reserved slot; the
+                    # partially written prompt rows are dead (the next
+                    # occupant's prefill/decode overwrites every row
+                    # before reading it).
+                    del self._chunking[slot]
+                    self._lengths[slot] = 0
+                    return True
             self._cancelled[request_id] = time.time()
             return False
 
@@ -1507,7 +1854,8 @@ class InferenceEngine:
             pending = list(requests)
             finished: List[Tuple[Request, RequestResult]] = []
             t0 = time.time()
-            while pending or any(s is not None for s in self._slots):
+            while (pending or self._chunking or
+                   any(s is not None for s in self._slots)):
                 # Offline batch: fill ALL free slots before decoding —
                 # total throughput wants the widest decode batch, and
                 # measured on v5e, capping prefills here costs ~20%
@@ -1534,6 +1882,11 @@ class InferenceEngine:
                             error_class='client')))
                 if to_start:
                     self._start_batch(to_start)
+                if self._chunking:
+                    # Offline, only prompts no bucket can hold chunk
+                    # (_should_chunk): one chunk per loop iteration,
+                    # interleaved with the decode windows below.
+                    self._chunk_round()
                 # Harvest between prefill and decode: the prefill already
                 # produced one token, which may satisfy max_new_tokens=1
                 # or be the EOS.
@@ -1570,6 +1923,7 @@ class InferenceEngine:
         while not stop_event.is_set():
             moved = False
             to_start = []
+            dequeued = cancelled_deq = 0
             while True:
                 if len(to_start) >= self.cfg.prefills_per_gap and any(
                         s is not None for s in self._slots):
@@ -1599,7 +1953,10 @@ class InferenceEngine:
                             output_tokens=[], ttft_s=0.0, latency_s=0.0,
                             finish_reason='cancelled'))
                     moved = True
+                    dequeued += 1
+                    cancelled_deq += 1
                     continue
+                dequeued += 1
                 try:
                     to_start.append((req, slot,
                                      req.arrival_time or time.time(),
@@ -1614,6 +1971,20 @@ class InferenceEngine:
                             finish_reason='error',
                             error=str(e), error_class='client'))
                 moved = True
+            if dequeued:
+                # Phantom-arrival decay (ADVICE r5): a burst of
+                # cancelled-while-queued requests leaves qsize() high
+                # for a while even though nothing will ever prefill —
+                # without decay that forces 2-step windows and disables
+                # lookahead.  Each consecutive cancel-only drain halves
+                # the hint's view of the backlog; any real dequeue
+                # (including validation errors, which DID occupy the
+                # queue legitimately) resets it.
+                if dequeued == cancelled_deq:
+                    self._cancel_only_streak = min(
+                        self._cancel_only_streak + 1, 16)
+                else:
+                    self._cancel_only_streak = 0
             if to_start:
                 try:
                     with self._lock:
@@ -1664,6 +2035,12 @@ class InferenceEngine:
                                 finish_reason='error', error=str(e),
                                 error_class='internal'))
             with self._lock:
+                if self._chunking:
+                    # At most ONE chunk between decode windows: the
+                    # stall any active slot sees from a long-prompt
+                    # arrival is bounded by chunk_ms + window_ms
+                    # instead of the full prefill duration.
+                    moved = self._chunk_round() or moved
                 self._flush_streams()            # prefill first tokens
                 for _, res in self._harvest():   # prefill-only finishes
                     result_cb(res)
@@ -1671,8 +2048,10 @@ class InferenceEngine:
                     # Snapshot the backlog for the window policy: only
                     # requests still queued at step time are waiting on
                     # the next prefill gap (the cap/slot-exhaustion
-                    # leftovers from the dequeue phase above).
-                    self._arrivals_hint = request_queue.qsize()
+                    # leftovers from the dequeue phase above).  A
+                    # cancel-only streak decays the hint (see above).
+                    self._arrivals_hint = (
+                        request_queue.qsize() >> self._cancel_only_streak)
                     self._step()
                     self._flush_streams()
                     for _, res in self._harvest():
@@ -1699,6 +2078,15 @@ class InferenceEngine:
                                        max_new_tokens=2)])
             finally:
                 self._arrivals_hint = 0
+        if self.cfg.prefill_chunk:
+            # Compile the chunk kernel too: one [B, C] dispatch shape
+            # covers every chunk round, so a single over-bucket warmup
+            # prompt (bucket=None -> _should_chunk) compiles it.
+            n = min(max(self.cfg.prefill_buckets) + 1,
+                    self.cfg.max_cache_len - 1)
+            base = list(tokens) or [1]
+            rep = (base * (n // len(base) + 1))[:n]
+            self.generate([Request(tokens=rep, max_new_tokens=1)])
 
     def _warm_spec(self, prompt_len: int) -> None:
         """Compile the speculative verify path outside a benchmark's

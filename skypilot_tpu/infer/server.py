@@ -549,6 +549,9 @@ def _make_handler(server: InferenceServer):
                     'prefix': dict(eng.prefix_stats),
                     'resident_prefixes': len(eng._prefixes),
                     'adapters': sorted(eng.adapters),
+                    'prefill_chunk': eng.cfg.prefill_chunk,
+                    'chunking_slots': len(eng._chunking),
+                    'chunk': dict(eng.chunk_stats),
                 })
             else:
                 self._json(404, {'error': 'not found'})
@@ -1239,7 +1242,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         adapter_dir: Optional[str] = None,
         adaptive_window: bool = False,
         decode_lookahead: bool = False,
-        auto_prefix: bool = False) -> None:
+        auto_prefix: bool = False,
+        prefill_chunk: int = 0) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -1356,7 +1360,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       max_prefixes=max_prefixes, lora_rank=lora_rank,
                       lora_max_adapters=lora_max_adapters,
                       adaptive_decode_window=adaptive_window,
-                      decode_lookahead=decode_lookahead)
+                      decode_lookahead=decode_lookahead,
+                      prefill_chunk=prefill_chunk)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -1415,6 +1420,13 @@ def main() -> None:
                         help='automatic prefix caching: a prompt head '
                              'seen twice registers itself (bucket-'
                              'quantized); vLLM-APC analog')
+    parser.add_argument('--prefill-chunk', type=int, default=0,
+                        help='chunked prefill: split prompts into '
+                             'N-token pieces interleaved between decode '
+                             'windows, bounding the decode stall to one '
+                             'chunk and lifting the largest-bucket '
+                             'prompt cap (0 = monolithic prefill; must '
+                             'divide --max-cache-len)')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
@@ -1428,7 +1440,8 @@ def main() -> None:
         adapter_dir=args.adapter_dir,
         adaptive_window=args.adaptive_window,
         decode_lookahead=args.decode_lookahead,
-        auto_prefix=args.auto_prefix)
+        auto_prefix=args.auto_prefix,
+        prefill_chunk=args.prefill_chunk)
 
 
 if __name__ == '__main__':
