@@ -19,6 +19,18 @@ window amortization the tunnel forces.
 Usage (on the TPU host):
   python scripts/bench_decode_micro.py [--model llama2-7b]
       [--num-slots 16] [--max-cache-len 512] [--reps 20]
+
+--paged mode (CPU-dryrun safe): the block-paged KV cache's bandwidth
+and capacity story instead of the dispatch-cost fit.  Per decode step a
+dense slot streams max_cache_len KV rows regardless of fill; a paged
+slot streams ceil(len/block)*block rows (power-of-two-bucketed table
+widths round that up at most 2x, still length-proportional).  Reports,
+at the target model's geometry: the analytic bytes/FLOPs-per-step sweep
+over filled lengths, the max-concurrent-slot capacity model at a fixed
+HBM budget, and a MEASURED tiny-model dense-vs-paged decode dispatch
+sweep (CPU: direction-of-effect anchor; on chip: real TPOT).
+
+  python scripts/bench_decode_micro.py --paged --out BENCH_MICRO_r07.json
 """
 import argparse
 import dataclasses
@@ -27,6 +39,185 @@ import sys
 import time
 
 sys.path.insert(0, '.')
+
+
+def _pow2_bucket(n: int, cap: int) -> int:
+    nb = 1
+    while nb < n and nb < cap:
+        nb *= 2
+    return min(nb, cap)
+
+
+def paged_report(args):
+    """--paged mode: analytic sweep + capacity model + tiny measured
+    sweep.  Runs without building the target model (geometry only), so
+    it works on the 1-CPU dryrun container at 7B scale."""
+    import numpy as np
+
+    from skypilot_tpu.infer.engine import resolve_cache_dtype
+    from skypilot_tpu.models import get_model_config
+
+    mc = get_model_config(args.model)
+    m = args.max_cache_len
+    bs = args.block_size
+    dt = np.dtype(resolve_cache_dtype(args.cache_dtype))
+    # One token's K+V across all layers.
+    row_bytes = 2 * mc.num_kv_heads * mc.head_dim_ * dt.itemsize * \
+        mc.num_layers
+    hq = mc.num_heads
+    fills = [f for f in args.fill_sweep if f < m] + [m - 1]
+    sweep = []
+    for fill in fills:
+        blocks = -(-(fill + 1) // bs)
+        nb = _pow2_bucket(blocks, m // bs)
+        # Per decode step, per slot: KV rows streamed by the attention
+        # (the HBM-bound term) and the score/value FLOPs over them.
+        row = {
+            'filled_len': fill,
+            'dense_rows_per_step': m,
+            'paged_rows_exact': blocks * bs,
+            'paged_rows_bucketed': nb * bs,
+            'dense_kv_bytes_per_step': m * row_bytes,
+            'paged_kv_bytes_per_step': nb * bs * row_bytes,
+            'kv_read_reduction': round(m / (nb * bs), 2),
+            # 2 matmuls (scores + values), 2 flops/MAC, all q heads.
+            'dense_attn_flops_per_step':
+                2 * 2 * hq * mc.head_dim_ * m * mc.num_layers,
+            'paged_attn_flops_per_step':
+                2 * 2 * hq * mc.head_dim_ * nb * bs * mc.num_layers,
+        }
+        sweep.append(row)
+        print(f'fill={fill:4d}: dense reads {m:4d} rows/step, paged '
+              f'{nb * bs:4d} ({row["kv_read_reduction"]:.2f}x less)',
+              flush=True)
+    # Capacity model: max concurrent slots at a fixed KV HBM budget.
+    # Dense reserves max_cache_len rows per slot up front; paged holds
+    # ceil(len/block) blocks per slot, so capacity depends on the
+    # lengths actually resident.  typical_len: the steady-state resident
+    # length (prompt + half the generation budget is the serve-bench
+    # expectation).
+    kv_budget = int((args.hbm_gb - args.weights_gb) * (1 << 30))
+    dense_slots = kv_budget // (m * row_bytes)
+    pool_blocks = kv_budget // (bs * row_bytes)
+    typical = args.typical_len
+    blocks_per_slot = -(-typical // bs)
+    paged_slots = pool_blocks // blocks_per_slot
+    capacity = {
+        'hbm_budget_gb': args.hbm_gb,
+        'weights_gb': args.weights_gb,
+        'kv_budget_bytes': kv_budget,
+        'kv_row_bytes': row_bytes,
+        'block_size': bs,
+        'typical_resident_len': typical,
+        'max_slots_dense': int(dense_slots),
+        'max_slots_paged': int(paged_slots),
+        'capacity_gain': round(paged_slots / max(dense_slots, 1), 2),
+    }
+    print(f'capacity @ {args.hbm_gb:.0f} GB HBM ({args.weights_gb:.0f} '
+          f'GB weights): dense {dense_slots} slots, paged {paged_slots} '
+          f'({capacity["capacity_gain"]:.2f}x) at typical resident len '
+          f'{typical}', flush=True)
+
+    measured = None
+    if not args.no_measure:
+        measured = _measure_tiny_sweep(args, fills)
+    out = {
+        'description':
+            f'paged-KV decode bandwidth/capacity model at {args.model} '
+            f'geometry (Hkv={mc.num_kv_heads}, D={mc.head_dim_}, '
+            f'layers={mc.num_layers}, {dt.name} cache). Analytic '
+            'bytes/FLOPs per decode step per slot: dense streams '
+            'max_cache_len rows regardless of fill; paged streams the '
+            'power-of-two-bucketed ceil(len/block)*block rows. '
+            'measured_tiny_sweep times REAL dense vs paged decode '
+            'dispatches on a 2-layer toy model on the current backend '
+            '(CPU dryrun: direction-of-effect, not chip TPOT).',
+        'model': args.model,
+        'max_cache_len': m,
+        'block_size': bs,
+        'filled_len_sweep': sweep,
+        'capacity_model': capacity,
+        'measured_tiny_sweep': measured,
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(out, f, indent=2)
+        print(f'wrote {args.out}')
+
+
+def _measure_tiny_sweep(args, fills, steps=4, reps=5):
+    """Dense vs paged decode dispatch wall time on a tiny llama at each
+    filled length — the measured counterpart of the analytic sweep.
+    Uses the engine's own jitted paths (same code serving runs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import InferConfig, InferenceEngine
+    from skypilot_tpu.models.llama import LlamaConfig
+
+    m = args.max_cache_len
+    bs = args.block_size
+    b = 8
+    cfg_m = LlamaConfig(name='paged-micro', vocab_size=256,
+                        hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_seq_len=m, tie_embeddings=True,
+                        dtype='float32')
+    common = dict(num_slots=b, max_cache_len=m, prefill_buckets=(64,),
+                  decode_steps=steps, cache_dtype=jnp.float32)
+    dense = InferenceEngine(cfg_m, InferConfig(**common))
+    paged = InferenceEngine(cfg_m, InferConfig(kv_block_size=bs,
+                                               **common),
+                            params=dense.params)
+    tokens = jnp.ones((b,), jnp.int32)
+    temps = jnp.zeros((b,), jnp.float32)
+    adapters = jnp.full((b,), -1, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    rows = []
+    for fill in fills:
+        lengths = jnp.full((b,), fill, jnp.int32)
+
+        def timed(dispatch):
+            toks, cache = dispatch()
+            _ = float(toks[0, 0, 0])             # compile + sync
+            t0 = time.time()
+            for _ in range(reps):
+                toks, cache = dispatch()
+                _ = float(toks[0, 0, 0])
+            return (time.time() - t0) / reps * 1e3
+
+        def d_dense():
+            out = dense._decode(dense.params, dense.cache, tokens,
+                                lengths, temps, key, adapters, steps)
+            dense.cache = out[3]
+            return out[0], out[3]
+
+        for i in range(b):
+            paged._ensure_blocks(i, min(fill + steps, m))
+        nb = paged._nb_bucket(-(-(fill + steps) // bs))
+        tables = paged._lane_tables(range(b), nb)
+
+        def d_paged():
+            out = paged._paged_decode(paged.params, paged.cache, tokens,
+                                      lengths, temps, key, adapters,
+                                      tables, steps)
+            paged.cache = out[3]
+            return out[0], out[3]
+
+        dms = timed(d_dense)
+        pms = timed(d_paged)
+        for i in range(b):
+            paged._free_slot_blocks(i)
+        rows.append({'filled_len': fill, 'table_blocks': int(nb),
+                     'dense_dispatch_ms': round(dms, 2),
+                     'paged_dispatch_ms': round(pms, 2),
+                     'dense_tpot_ms': round(dms / steps, 3),
+                     'paged_tpot_ms': round(pms / steps, 3)})
+        print(f'measured fill={fill:4d}: dense {dms:7.2f} ms, paged '
+              f'{pms:7.2f} ms ({nb} blocks gathered)', flush=True)
+    return {'batch': b, 'decode_steps': steps,
+            'model': 'tiny 2-layer llama (float32)', 'rows': rows}
 
 
 def main():
@@ -44,7 +235,29 @@ def main():
                     help='chunk size for the worst-case decode-stall '
                          'comparison (0 skips it); must divide '
                          '--max-cache-len')
+    ap.add_argument('--paged', action='store_true',
+                    help='block-paged KV bandwidth/capacity report '
+                         'instead of the dispatch-cost fit (CPU-safe)')
+    ap.add_argument('--block-size', type=int, default=16)
+    ap.add_argument('--fill-sweep', type=int, nargs='+',
+                    default=[32, 64, 128, 256, 384])
+    ap.add_argument('--typical-len', type=int, default=256,
+                    help='steady-state resident rows/slot for the '
+                         'capacity model (prompt + half the generation '
+                         'budget at the serve-bench shape)')
+    ap.add_argument('--hbm-gb', type=float, default=16.0,
+                    help='HBM budget for the capacity model (v5e chip)')
+    ap.add_argument('--weights-gb', type=float, default=7.0,
+                    help='weight HBM at the target model (7B int8)')
+    ap.add_argument('--no-measure', action='store_true',
+                    help='skip the tiny-model measured sweep')
+    ap.add_argument('--out', default=None,
+                    help='write the --paged report JSON here')
     args = ap.parse_args()
+
+    if args.paged:
+        paged_report(args)
+        return
 
     import jax
     import jax.numpy as jnp

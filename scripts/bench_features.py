@@ -29,7 +29,8 @@ sys.path.insert(0, '.')
 
 
 def _engine(draft_len=0, num_slots=16, max_cache_len=512,
-            prefill_lanes=4, prefill_chunk=0):
+            prefill_lanes=4, prefill_chunk=0, kv_block_size=0,
+            kv_blocks=None, max_prefixes=16):
     """7B int8 + fp8-KV engine sized for the 16 GB chip: at Hkv=32,
     D=128 a 7B cache row costs ~0.26 MB/token-layer-slot, so slots x
     cache_len is the HBM budget knob (48x512 = the serve-bench shape)."""
@@ -45,7 +46,9 @@ def _engine(draft_len=0, num_slots=16, max_cache_len=512,
                       max_cache_len=max_cache_len, decode_steps=8,
                       cache_dtype=jnp.float8_e4m3fn, draft_len=draft_len,
                       prefill_lanes=prefill_lanes,
-                      prefill_chunk=prefill_chunk)
+                      prefill_chunk=prefill_chunk,
+                      kv_block_size=kv_block_size, kv_blocks=kv_blocks,
+                      max_prefixes=max_prefixes)
     return InferenceEngine(cfg_m, cfg)
 
 
@@ -241,6 +244,42 @@ def bench_dispatch_cost(eng, prompt_len, iters: int = 20):
     }
 
 
+def bench_kv_occupancy(block_size: int = 16):
+    """Paged KV pool occupancy through one serving episode (stats()):
+    after a 1024-token prefix registers, mid-flight with every slot
+    decoding a prefix-sharing prompt (shared blocks carry one copy for
+    N readers), and after the batch drains (everything back on the free
+    list).  The numbers /stats serves — this prints them next to the
+    perf sections so a regression in the accounting shows up in the
+    bench artifact."""
+    import numpy as np
+
+    from skypilot_tpu.infer import Request
+    eng = _engine(num_slots=4, max_cache_len=1152, prefill_lanes=1,
+                  kv_block_size=block_size)
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, 32000, size=1024).tolist()
+    out = {'idle': eng.stats()}
+    eng.register_prefix(prefix)
+    out['prefix_registered'] = eng.stats()
+    # Host-side start only (like bench_dispatch_cost): every slot takes
+    # a prefix-sharing prompt, then snapshot mid-flight occupancy.
+    items = []
+    for slot in range(eng.cfg.num_slots):
+        req = Request(tokens=prefix + rng.integers(
+            0, 32000, size=32).tolist(), max_new_tokens=64)
+        items.append((req, slot, 0.0, *eng._validate_request(req)))
+    eng._start_batch(items)
+    eng._decode_step()
+    out['mid_flight_4_slots_sharing'] = eng.stats()
+    for i in range(eng.cfg.num_slots):
+        eng._finish_slot(i, 'cancelled')
+    out['drained'] = eng.stats()
+    del eng
+    gc.collect()
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--out', default=None)
@@ -268,6 +307,8 @@ def main():
         result['chunked_prefill'] = bench_chunked_prefill(
             prefill_chunk=args.prefill_chunk, reps=max(3, args.reps // 2))
         print(json.dumps(result['chunked_prefill']))
+    result['kv_occupancy'] = bench_kv_occupancy()
+    print(json.dumps(result['kv_occupancy']))
     if args.out:
         with open(args.out, 'w') as f:
             json.dump(result, f, indent=2)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Tier-1 duration guard: parse a pytest log run with --durations=N,
+print the slowest tests, and FAIL when the recorded suite time pushes
+past the tier-1 timeout budget.
+
+The tier-1 wrapper (scripts/run_tier1.sh) runs the suite with
+`--durations=15 | tee <log>` and then this checker over the log.  The
+point is catching the failure mode where a PR's *new tests* quietly eat
+the fixed 870 s CI window — every added second displaces tail-of-suite
+tests from the window, which then read as "skipped" rather than as the
+regression they are.  The checker reports:
+
+- the suite wall time (pytest's trailing `in NNN.NNs` summary), judged
+  against the budget with a headroom margin (default 10%: a suite at
+  95% of the window WILL time out on a noisy runner);
+- the slowest-test table so the offender is named in the failure.
+
+Usage:
+    python scripts/check_tier1_budget.py /tmp/_t1.log \
+        [--budget 870] [--margin 0.10] [--top 15]
+
+Exit codes: 0 within budget, 1 over budget (or the run itself timed
+out, which a missing summary line implies), 2 unreadable log.
+"""
+import argparse
+import re
+import sys
+
+# `1.23s call tests/test_x.py::test_y` rows from --durations=N.
+_DURATION_ROW = re.compile(
+    r'^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)')
+# Trailing summary: `==== 12 passed, 3 failed in 512.34s ====` (pytest
+# prints `in 512.34s (0:08:32)` past the hour; match the seconds form).
+_SUMMARY = re.compile(r'\bin (\d+(?:\.\d+)?)s\b')
+
+
+def parse_log(text: str):
+    """Returns (wall_seconds or None, [(seconds, phase, test), ...])."""
+    durations = []
+    wall = None
+    for line in text.splitlines():
+        m = _DURATION_ROW.match(line)
+        if m:
+            durations.append((float(m.group(1)), m.group(2), m.group(3)))
+        m = _SUMMARY.search(line)
+        if m:
+            wall = float(m.group(1))   # keep the LAST summary line
+    durations.sort(reverse=True)
+    return wall, durations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('log', help='pytest output (run with --durations=15)')
+    ap.add_argument('--budget', type=float, default=870.0,
+                    help='tier-1 wall-clock timeout in seconds')
+    ap.add_argument('--margin', type=float, default=0.10,
+                    help='headroom fraction: fail past '
+                         'budget*(1-margin), not just past the cliff')
+    ap.add_argument('--top', type=int, default=15,
+                    help='slowest tests to print')
+    args = ap.parse_args(argv)
+    try:
+        with open(args.log, encoding='utf-8', errors='replace') as f:
+            text = f.read()
+    except OSError as e:
+        print(f'check_tier1_budget: cannot read {args.log}: {e}')
+        return 2
+    wall, durations = parse_log(text)
+    if durations:
+        print(f'slowest {min(args.top, len(durations))} test phases:')
+        for secs, phase, test in durations[:args.top]:
+            print(f'  {secs:8.2f}s  {phase:<8}  {test}')
+    else:
+        print('no --durations rows in the log (run pytest with '
+              '--durations=15)')
+    if wall is None:
+        # No `in NNNs` summary: pytest never finished — the timeout
+        # already fired.  That IS the over-budget condition.
+        print(f'FAIL: no pytest summary line in {args.log} — the suite '
+              f'did not finish inside the {args.budget:.0f}s budget')
+        return 1
+    limit = args.budget * (1.0 - args.margin)
+    verdict = 'OK' if wall <= limit else 'FAIL'
+    print(f'{verdict}: suite took {wall:.1f}s; budget {args.budget:.0f}s '
+          f'(fail threshold {limit:.0f}s = {args.margin:.0%} headroom)')
+    return 0 if wall <= limit else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

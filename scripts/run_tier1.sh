@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Tier-1 wrapper: the ROADMAP.md verify command plus --durations=15 and
+# the duration-budget guard (scripts/check_tier1_budget.py).  The guard
+# prints the slowest tests and fails the run when the suite eats into
+# the 870 s tier-1 window's headroom — so a PR that adds slow tests is
+# caught by name, before the window itself starts truncating the suite.
+#
+# Usage: bash scripts/run_tier1.sh [budget_seconds]
+set -o pipefail
+BUDGET="${1:-870}"
+LOG=/tmp/_t1.log
+rm -f "$LOG"
+timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly --durations=15 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" || rc=1
+exit "$rc"

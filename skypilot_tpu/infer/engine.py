@@ -2,10 +2,31 @@
 
 Architecture (TPU-first, JetStream-shaped):
 
-- **Slotted KV cache**: one [num_slots, Hkv, max_cache_len, D] pair per
-  layer, allocated once.  A request occupies a slot from prefill until
-  EOS/max-tokens, then the slot is recycled — decode batch shape never
-  changes, so the decode step compiles exactly once.
+- **KV cache, two layouts**:
+  * **Dense slotted** (default): one [num_slots, Hkv, max_cache_len, D]
+    pair per layer, allocated once.  A request occupies a slot from
+    prefill until EOS/max-tokens, then the slot is recycled — decode
+    batch shape never changes, so the decode step compiles exactly once.
+    Every slot reserves max_cache_len rows up front and every decode
+    step streams the full cache width.
+  * **Block-paged** (kv_block_size > 0): one [kv_blocks, Hkv,
+    kv_block_size, D] pool per layer plus a host-side block allocator
+    with per-slot block tables.  Decode gathers only a slot's allocated
+    blocks (ceil(len/block) blocks, padded to a small set of
+    block-count buckets so compiles stay O(#buckets)) — HBM read
+    traffic is proportional to tokens actually held, and slot capacity
+    becomes a shared pool instead of num_slots * max_cache_len rows.
+    kv_block_size must divide max_cache_len, every prefill bucket, and
+    prefill_chunk.  Block 0 is a reserved dump block absorbing idle-
+    lane and overrun writes.  Admission rule: a request is started only
+    when free blocks cover its worst-case demand,
+    ceil(min(prompt + max_new - 1, max_cache_len) / block), beyond what
+    already-running slots may still allocate — otherwise it waits
+    (serving: deferred FIFO; offline: left pending), so a running slot
+    can never hit pool exhaustion mid-flight.  Registered prefixes
+    live in pool blocks and are SHARED copy-free: a prefix hit appends
+    refcounted block ids to the slot's table instead of copying rows
+    (only a partial tail block is privatized by one block copy).
 - **Bucketed prefill**: prompts are right-padded to a small set of bucket
   lengths, so there are O(#buckets) prefill compilations.  Prefill runs
   the full forward through the same cached-attention path and its KV rows
@@ -38,7 +59,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from skypilot_tpu.models.llama import Llama, LlamaConfig, init_cache
+from skypilot_tpu.models.llama import (Llama, LlamaConfig, init_cache,
+                                       init_paged_cache)
 
 DEFAULT_PREFILL_BUCKETS = (64, 128, 256, 512, 1024, 2048)
 
@@ -155,6 +177,22 @@ class InferConfig:
     # and a [B, K] transfer per step.  Entry 0 is always the argmax
     # (is_greedy for eval harnesses).
     logprob_topk: int = 5
+    # Block-paged KV cache: 0 = dense slotted layout (one
+    # [num_slots, Hkv, max_cache_len, D] pair per layer).  > 0 pages
+    # the cache into kv_block_size-row blocks drawn from a shared pool:
+    # decode streams ceil(len/block)*block rows per step instead of
+    # max_cache_len, and prefix reuse shares blocks copy-free.  Must
+    # divide max_cache_len, every prefill bucket, and prefill_chunk
+    # (so no block-spanning write ever straddles an unallocated
+    # boundary).  Llama-family models only.
+    kv_block_size: int = 0
+    # Pool size in blocks (including the reserved dump block 0).  None
+    # = full provisioning: num_slots * (max_cache_len / block) + 1, so
+    # admission never defers — size it smaller to oversubscribe slots
+    # against typical (shorter-than-max) request lengths, or larger to
+    # leave headroom for registered prefixes (their blocks are pool-
+    # resident too).  See the admission rule in the module docstring.
+    kv_blocks: Optional[int] = None
     # Prefix KV caching: registered prefixes (system prompts) keep
     # their per-layer KV rows resident on device; a request whose
     # prompt starts with a registered prefix prefills ONLY its suffix —
@@ -425,6 +463,24 @@ class InferenceEngine:
             raise ValueError(
                 f'max_cache_len ({self.cfg.max_cache_len}) must be a '
                 f'multiple of prefill_chunk ({self.cfg.prefill_chunk})')
+        self._paged = self.cfg.kv_block_size > 0
+        if self.cfg.kv_block_size < 0:
+            raise ValueError(f'kv_block_size must be >= 0 '
+                             f'(got {self.cfg.kv_block_size})')
+        if self._paged:
+            bs_ = self.cfg.kv_block_size
+            if not isinstance(model_config, LlamaConfig):
+                raise TypeError(
+                    'the block-paged KV cache supports the llama '
+                    f'family; got {type(model_config).__name__}')
+            if self.cfg.max_cache_len % bs_:
+                raise ValueError(
+                    f'max_cache_len ({self.cfg.max_cache_len}) must be '
+                    f'a multiple of kv_block_size ({bs_})')
+            if self.cfg.prefill_chunk and self.cfg.prefill_chunk % bs_:
+                raise ValueError(
+                    f'prefill_chunk ({self.cfg.prefill_chunk}) must be '
+                    f'a multiple of kv_block_size ({bs_})')
         if self.cfg.draft_len < 0:
             raise ValueError(f'draft_len must be >= 0 '
                              f'(got {self.cfg.draft_len})')
@@ -503,6 +559,13 @@ class InferenceEngine:
             # (and its compile) is dropped from the set.
             buckets += (self.cfg.max_cache_len,)
         self.cfg.prefill_buckets = buckets
+        if self._paged:
+            bs_ = self.cfg.kv_block_size
+            bad = [b for b in buckets if b % bs_]
+            if bad:
+                raise ValueError(
+                    f'every prefill bucket must be a multiple of '
+                    f'kv_block_size ({bs_}); got {bad}')
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._rng = rng
         sample = jnp.zeros((1, 8), jnp.int32)
@@ -532,13 +595,49 @@ class InferenceEngine:
             params = jax.tree.map(jnp.asarray, params)
         self.params = params
         b = self.cfg.num_slots
-        self.cache = init_cache(model_config, b, self.cfg.max_cache_len,
-                                self.cfg.cache_dtype)
+        if self._paged:
+            bs_ = self.cfg.kv_block_size
+            self._max_blocks = self.cfg.max_cache_len // bs_
+            n_blocks = self.cfg.kv_blocks
+            if n_blocks is None:
+                # Full provisioning (+1 dump block): admission never
+                # defers, so dense and paged engines schedule
+                # identically — the capacity win comes from RAISING
+                # num_slots over a fixed pool instead.
+                n_blocks = b * self._max_blocks + 1
+            if n_blocks < self._max_blocks + 1:
+                raise ValueError(
+                    f'kv_blocks ({n_blocks}) must be >= max_cache_len/'
+                    f'kv_block_size + 1 ({self._max_blocks + 1}): one '
+                    'full-length request must fit the pool')
+            self._num_blocks = n_blocks
+            self.cache = init_paged_cache(model_config, n_blocks, bs_,
+                                          self.cfg.cache_dtype)
+            # Host-side allocator: refcounts per block (dump block 0 is
+            # permanently held), a free list, and per-slot block tables
+            # (+ allocated counts).  Shared prefix blocks simply carry
+            # refcount > 1; freeing a slot decrefs every table entry.
+            self._block_refs = np.zeros((n_blocks,), np.int32)
+            self._block_refs[0] = 1
+            self._free_blocks = list(range(n_blocks - 1, 0, -1))
+            self._tables_np = np.zeros((b, self._max_blocks), np.int32)
+            self._slot_nblocks = np.zeros((b,), np.int32)
+            self.paged_stats = {'deferred': 0, 'prefix_block_hits': 0}
+        else:
+            self.cache = init_cache(model_config, b,
+                                    self.cfg.max_cache_len,
+                                    self.cfg.cache_dtype)
+        # Requests dequeued but not admissible yet (paged admission
+        # control); always present so the serving loop can poll it
+        # without caring about the layout.
+        self._deferred: List[Request] = []
         if mesh is not None:
-            # Cache [B, Hkv, S, D]: kv heads shard like the weights'
-            # 'kv_heads' logical axis (the per-shard K/V the sharded
-            # projections produce) — resolved through the same rules as
-            # every other sharding, not a hand-named mesh axis.
+            # Cache [B, Hkv, S, D] (paged: [N, Hkv, bs, D]): kv heads
+            # shard like the weights' 'kv_heads' logical axis (the
+            # per-shard K/V the sharded projections produce) — resolved
+            # through the same rules as every other sharding, not a
+            # hand-named mesh axis.  Both layouts carry kv-heads on
+            # dim 1, so one sharding covers them.
             from skypilot_tpu.parallel import mesh as mesh_lib
             cache_sharding = mesh_lib.named_sharding(
                 mesh, None, 'kv_heads', None, None)
@@ -925,6 +1024,129 @@ class InferenceEngine:
             first_top = topk_lp(last)                    # [B, k] x2
             return pack_head(first, first_lp, *first_top), cache
 
+        bs = self.cfg.kv_block_size
+
+        def pkw(tables):
+            """Thread the block tables + block size into the model's
+            paged attention path (llama family only)."""
+            return {'paged_tables': tables, 'paged_block_size': bs}
+
+        def paged_prefill(params, tokens, starts, true_pos, cache,
+                          tables, temps, rng, adapter_ids, want_plp):
+            """The ONE paged prefill dispatch: forwards tokens [P, W] at
+            positions starts + arange(W) directly over the block pool
+            via per-lane tables [P, NB], samples at true_pos (index
+            WITHIN the window of the last real token), and returns the
+            packed head.  Serves monolithic bucket prefill (starts=0),
+            copy-free suffix prefill over shared prefix blocks (starts=
+            prefix len — DYNAMIC, so no per-start recompile like the
+            dense prefix_prefill), chunk rounds (full slot width), and
+            prefix capture (1 lane, head discarded).  Writes past a
+            lane's allocated blocks land in the dump block; rows there
+            are beyond every query position, so the attention mask
+            never sees them."""
+            w = tokens.shape[1]
+            positions = starts[:, None] + jnp.arange(w)[None]
+            logits, cache = model.apply(params, tokens, positions, cache,
+                                        **pkw(tables),
+                                        **akw(adapter_ids))
+            last = jnp.take_along_axis(
+                logits, true_pos[:, None, None], axis=1)[:, 0]
+            greedy = jnp.argmax(last, axis=-1)
+            sampled = jax.random.categorical(
+                rng, last / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
+            first = jnp.where(temps > 0, sampled,
+                              greedy).astype(jnp.int32)
+            first_lp = chosen_logprob(last, first)
+            first_top = topk_lp(last)                    # [P, k] x2
+            if want_plp:   # STATIC (monolithic starts=0 lanes only)
+                prompt_lps = chosen_logprob(logits[:, :-1],
+                                            tokens[:, 1:])  # [P, W-1]
+                prompt_tops = topk_lp(logits[:, :-1])
+                prompt_packed = jnp.concatenate([
+                    prompt_lps[..., None],
+                    jax.lax.bitcast_convert_type(prompt_tops[0],
+                                                 jnp.float32),
+                    prompt_tops[1].astype(jnp.float32)], axis=-1)
+            else:
+                prompt_packed = jnp.zeros((tokens.shape[0], 0,
+                                           1 + 2 * topk), jnp.float32)
+            return (pack_head(first, first_lp, *first_top),
+                    prompt_packed, cache)
+
+        def paged_decode(params, cache, tokens, lengths, temps, rng,
+                         adapter_ids, tables, steps):
+            """Windowed decode over the block pool: identical scan to
+            `decode`, with writes/gathers routed through the per-slot
+            tables.  The host pre-allocates blocks covering every write
+            position of the window, so the tables are constant through
+            the scan."""
+            def one_step(carry, key):
+                cache, tokens, lengths = carry
+                positions = lengths[:, None]
+                logits, cache = model.apply(params, tokens[:, None],
+                                            positions, cache,
+                                            **pkw(tables),
+                                            **akw(adapter_ids))
+                logits = logits[:, 0]
+                greedy = jnp.argmax(logits, axis=-1)
+                temps_safe = jnp.maximum(temps, 1e-4)[:, None]
+                sampled = jax.random.categorical(
+                    key, logits / temps_safe, axis=-1)
+                next_tokens = jnp.where(temps > 0, sampled,
+                                        greedy).astype(jnp.int32)
+                lp = chosen_logprob(logits, next_tokens)
+                t_ids, t_lps = topk_lp(logits)
+                return (cache, next_tokens, lengths + 1), (
+                    next_tokens, lp, t_ids, t_lps)
+
+            keys = jax.random.split(rng, steps)
+            (cache, last, lens), (toks, lps, gtoks, glps) = jax.lax.scan(
+                one_step, (cache, tokens, lengths), keys)
+            return pack_head(toks, lps, gtoks, glps), last, lens, cache
+
+        def paged_spec_verify(params, cache, tokens, lengths, temps,
+                              rng, adapter_ids, tables):
+            """Speculative verify over the block pool (see spec_verify
+            for the accept contract)."""
+            k = tokens.shape[1]
+            positions = lengths[:, None] + jnp.arange(k)[None]
+            logits, cache = model.apply(params, tokens, positions, cache,
+                                        **pkw(tables),
+                                        **akw(adapter_ids))
+            greedy = jnp.argmax(logits, axis=-1)
+            temps_safe = jnp.maximum(temps, 1e-4)[:, None, None]
+            sampled = jax.random.categorical(rng, logits / temps_safe,
+                                             axis=-1)
+            preds = jnp.where(temps[:, None] > 0, sampled,
+                              greedy).astype(jnp.int32)
+            preds_lp = chosen_logprob(logits, preds)
+            t_ids, t_lps = topk_lp(logits)
+            return pack_head(preds, preds_lp, t_ids, t_lps), cache
+
+        def paged_copy_blocks(cache, src, dsts):
+            """Copy pool block `src` into every block of dsts [G], per
+            layer — the one device op a prefix hit pays (privatizing
+            the partial tail block; the full blocks are shared by
+            table reference).  Pad dsts entries may repeat a real dst:
+            duplicate scatters write identical bytes."""
+            new = []
+            for kp, vp in cache:
+                kb = jnp.broadcast_to(kp[src][None],
+                                      (dsts.shape[0],) + kp.shape[1:])
+                vb = jnp.broadcast_to(vp[src][None],
+                                      (dsts.shape[0],) + vp.shape[1:])
+                new.append((kp.at[dsts].set(kb), vp.at[dsts].set(vb)))
+            return new
+
+        self._paged_prefill = jax.jit(paged_prefill, donate_argnums=(4,),
+                                      static_argnums=(9,))
+        self._paged_decode = jax.jit(paged_decode, donate_argnums=(1,),
+                                     static_argnums=(8,))
+        self._paged_spec_verify = jax.jit(paged_spec_verify,
+                                          donate_argnums=(1,))
+        self._paged_copy_blocks = jax.jit(paged_copy_blocks,
+                                          donate_argnums=(0,))
         self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,),
                                        static_argnums=(9,))
         self._chunk_prefill = jax.jit(chunk_prefill, donate_argnums=(4,))
@@ -934,6 +1156,171 @@ class InferenceEngine:
         self._prefill_capture = jax.jit(prefill_capture)
         self._prefix_prefill = jax.jit(prefix_prefill, static_argnums=(2,),
                                        donate_argnums=(5,))
+
+    # ----------------------------------------------------- paged allocator
+
+    def _nb_bucket(self, needed: int) -> int:
+        """Table width (in blocks) for a dispatch: the smallest power
+        of two >= needed, capped at max_blocks — the gather width is
+        bucketed so compiles stay O(log(max_blocks)) per dispatch
+        shape instead of one per block count."""
+        nb = 1
+        while nb < needed and nb < self._max_blocks:
+            nb *= 2
+        return min(nb, self._max_blocks)
+
+    def _alloc_blocks(self, k: int) -> List[int]:
+        if k > len(self._free_blocks):
+            # Admission control reserves worst-case demand up front, so
+            # a running slot can never get here; reaching it means the
+            # accounting is broken.
+            raise RuntimeError(
+                f'KV block pool exhausted: need {k}, have '
+                f'{len(self._free_blocks)} free (admission accounting '
+                'bug)')
+        out = [self._free_blocks.pop() for _ in range(k)]
+        for b in out:
+            self._block_refs[b] = 1
+        return out
+
+    def _deref_block(self, b: int) -> None:
+        if b == 0:
+            return
+        self._block_refs[b] -= 1
+        if self._block_refs[b] == 0:
+            self._free_blocks.append(b)
+
+    def _ensure_blocks(self, slot: int, upto: int) -> None:
+        """Grow the slot's table with fresh private blocks so rows
+        [0, upto) are resident (no-op when already covered)."""
+        need = min(-(-upto // self.cfg.kv_block_size), self._max_blocks)
+        cur = int(self._slot_nblocks[slot])
+        if need <= cur:
+            return
+        ids = self._alloc_blocks(need - cur)
+        self._tables_np[slot, cur:need] = ids
+        self._slot_nblocks[slot] = need
+
+    def _append_shared_blocks(self, slot: int,
+                              ids: Sequence[int]) -> None:
+        """Append a prefix's full blocks to the slot's table by
+        REFERENCE (refcount bump) — the copy-free prefix hit."""
+        cur = int(self._slot_nblocks[slot])
+        self._tables_np[slot, cur:cur + len(ids)] = ids
+        for b in ids:
+            self._block_refs[b] += 1
+        self._slot_nblocks[slot] = cur + len(ids)
+
+    def _free_slot_blocks(self, slot: int) -> None:
+        n = int(self._slot_nblocks[slot])
+        for b in self._tables_np[slot, :n]:
+            self._deref_block(int(b))
+        self._tables_np[slot, :] = 0
+        self._slot_nblocks[slot] = 0
+
+    def _slot_cap_rows(self, n: int, max_new: int) -> int:
+        """Worst-case filled rows of a request: prompt + generated
+        (the prefill token is generated token #1, so the last decode
+        write lands at row n + max_new - 2), capped at the cache."""
+        return min(n + max_new - 1, self.cfg.max_cache_len)
+
+    def _blocks_demand(self, n: int, max_new: int) -> int:
+        return -(-self._slot_cap_rows(n, max_new) //
+                 self.cfg.kv_block_size)
+
+    def _blocks_outstanding(self) -> int:
+        """Blocks running slots may still allocate (worst case): their
+        total demand minus what they already hold.  Shared prefix
+        blocks count as held, so sharing directly raises admission
+        headroom."""
+        out = 0
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                out += max(0, self._blocks_demand(
+                    len(s.request.tokens), s.max_new)
+                    - int(self._slot_nblocks[i]))
+        for slot, job in self._chunking.items():
+            out += max(0, self._blocks_demand(job.n, job.max_new)
+                       - int(self._slot_nblocks[slot]))
+        return out
+
+    def _can_admit_blocks(self, demand: int, extra: int = 0) -> bool:
+        """Admission rule: start a request only when free blocks cover
+        its worst-case demand beyond everything running slots (and
+        `extra` — demand of requests admitted in the same gap) may
+        still claim.  Guarantees _alloc_blocks never fails
+        mid-flight."""
+        if not self._paged:
+            return True
+        return (len(self._free_blocks) - self._blocks_outstanding()
+                - extra >= demand)
+
+    def _force_admit_blocks(self, demand: int) -> bool:
+        """Last resort when a request can't be admitted and NOTHING is
+        running (offline batch with prefix entries hogging the pool):
+        LRU-evict prefix entries until the request fits.  With no
+        running slot every shared ref is entry-held, so eviction
+        actually frees blocks.  Returns admissibility."""
+        while (not self._can_admit_blocks(demand) and self._prefixes and
+               not any(s is not None for s in self._slots) and
+               not self._chunking):
+            _, entry = self._prefixes.popitem(last=False)
+            for b in entry['blocks']:
+                self._deref_block(b)
+        return self._can_admit_blocks(demand)
+
+    def _lane_tables(self, slot_rows: Sequence[int],
+                     nb: int) -> jnp.ndarray:
+        """Device table array for a dispatch: the named slots' table
+        rows, truncated/padded to `nb` entries (entries past a slot's
+        allocation are 0 = the dump block)."""
+        rows = self._tables_np[np.asarray(slot_rows, np.int32)]
+        if nb <= rows.shape[1]:
+            rows = rows[:, :nb]
+        else:
+            rows = np.pad(rows, ((0, 0), (0, nb - rows.shape[1])))
+        return jnp.asarray(rows)
+
+    def stats(self) -> Dict[str, Any]:
+        """KV-cache HBM accounting (served by /stats).  Dense: the
+        static layout.  Paged: live pool occupancy — total/free/shared
+        blocks, bytes resident, and prefix sharing counters."""
+        mc = self.model_config
+        row_bytes = (2 * mc.num_kv_heads * mc.head_dim_ *
+                     np.dtype(self.cfg.cache_dtype).itemsize *
+                     mc.num_layers)
+        if not self._paged:
+            total = self.cfg.num_slots * self.cfg.max_cache_len
+            return {
+                'kv_layout': 'dense',
+                'kv_bytes_total': total * row_bytes,
+                'kv_bytes_resident': total * row_bytes,
+            }
+        bs_ = self.cfg.kv_block_size
+        block_bytes = bs_ * row_bytes
+        usable = self._num_blocks - 1
+        free = len(self._free_blocks)
+        refs = self._block_refs
+        shared = int((refs[1:] > 1).sum())
+        prefix_blocks = sum(len(e['blocks'])
+                            for e in self._prefixes.values())
+        return {
+            'kv_layout': 'paged',
+            'block_size': bs_,
+            'blocks_total': usable,
+            'blocks_free': free,
+            'blocks_allocated': usable - free,
+            'blocks_shared': shared,
+            'blocks_prefix': prefix_blocks,
+            # Table entries resolved by sharing instead of allocation
+            # (sum of refcounts beyond each shared block's first).
+            'shared_refs_saved': int((refs[1:][refs[1:] > 1] - 1).sum()),
+            'kv_bytes_per_block': int(block_bytes),
+            'kv_bytes_total': int(self._num_blocks * block_bytes),
+            'kv_bytes_resident': int((usable - free) * block_bytes),
+            'admission_deferred': self.paged_stats['deferred'],
+            'prefix_block_hits': self.paged_stats['prefix_block_hits'],
+        }
 
     # ---------------------------------------------------------- schedule
 
@@ -1005,6 +1392,14 @@ class InferenceEngine:
             raise ValueError(
                 f'prompt ({n}) + max_new_tokens ({max_new}) exceeds cache '
                 f'({self.cfg.max_cache_len})')
+        if self._paged:
+            demand = self._blocks_demand(n, max_new)
+            usable = self._num_blocks - 1
+            if demand > usable:
+                raise ValueError(
+                    f'request needs {demand} KV blocks but the pool '
+                    f'only has {usable} (kv_blocks too small for this '
+                    'prompt + max_new_tokens)')
         return n, bucket, max_new
 
     def _should_chunk(self, req: Request, n: int,
@@ -1087,7 +1482,10 @@ class InferenceEngine:
             # now stale — matching it would silently produce output
             # inconsistent with a full prefill under the new weights.
             for key in [k for k in self._prefixes if k[0] == name]:
-                del self._prefixes[key]
+                entry = self._prefixes.pop(key)
+                if self._paged:
+                    for b in entry['blocks']:
+                        self._deref_block(b)
         return idx
 
     @property
@@ -1117,6 +1515,9 @@ class InferenceEngine:
         bucket = self._bucket(n)   # raises when no bucket can hold it
         arr = np.zeros((1, bucket), np.int32)
         arr[0, :n] = tokens
+        if self._paged:
+            return self._register_prefix_paged(arr, n, bucket, adapter,
+                                               aid, tokens)
         pcache = init_cache(self.model_config, 1, bucket,
                             self.cfg.cache_dtype)
         # The capture forward (and its first-call trace/compile, which
@@ -1142,6 +1543,52 @@ class InferenceEngine:
             self._prefixes.move_to_end(key)
             while len(self._prefixes) > self.cfg.max_prefixes:
                 self._prefixes.popitem(last=False)
+        return n
+
+    def _register_prefix_paged(self, arr, n, bucket, adapter, aid,
+                               tokens) -> int:
+        """Paged prefix capture: forward the prefix over the live pool
+        into freshly allocated blocks; the entry holds one refcount on
+        each.  Later hits SHARE the full blocks (refcount bump, no
+        copy).  Runs UNDER the engine lock — unlike the dense capture
+        (which only reads params), this writes the shared pool."""
+        bs_ = self.cfg.kv_block_size
+        need = -(-n // bs_)
+        key = (adapter, tuple(int(t) for t in tokens))
+        with self._lock:
+            def headroom():
+                return (len(self._free_blocks)
+                        - self._blocks_outstanding())
+
+            # Evict LRU entries first (their blocks free immediately
+            # unless a running slot still shares them).
+            while headroom() < need and self._prefixes:
+                _, old = self._prefixes.popitem(last=False)
+                for b in old['blocks']:
+                    self._deref_block(b)
+            if headroom() < need:
+                raise ValueError(
+                    f'KV block pool too small to register a {n}-token '
+                    f'prefix ({need} blocks; {len(self._free_blocks)} '
+                    'free after honoring running slots) — raise '
+                    'kv_blocks')
+            blocks = self._alloc_blocks(need)
+            table = np.zeros((1, bucket // bs_), np.int32)
+            table[0, :need] = blocks
+            with self._ctx():
+                _, _, self.cache = self._paged_prefill(
+                    self.params, jnp.asarray(arr),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.full((1,), n - 1, jnp.int32), self.cache,
+                    jnp.asarray(table), jnp.zeros((1,), jnp.float32),
+                    jax.random.PRNGKey(0),
+                    jnp.full((1,), aid, jnp.int32), False)
+            self._prefixes[key] = {'blocks': blocks, 'len': n}
+            self._prefixes.move_to_end(key)
+            while len(self._prefixes) > self.cfg.max_prefixes:
+                _, old = self._prefixes.popitem(last=False)
+                for b in old['blocks']:
+                    self._deref_block(b)
         return n
 
     def _match_prefix(self, tokens: Sequence[int],
@@ -1190,6 +1637,9 @@ class InferenceEngine:
         """Prefill prefix-matched requests sharing (prefix, start,
         suffix bucket) in lane-batched dispatches — same chunking and
         pad-lane-duplication rules as the normal prefill path."""
+        if self._paged:
+            self._start_prefixed_group_paged(group, start, sb, key)
+            return
         kv = self._prefixes[key]
         adapter, p_tokens = key
         aid = (-1 if adapter is None else self._adapter_names[adapter])
@@ -1227,6 +1677,91 @@ class InferenceEngine:
                         jnp.asarray(true_lens), kv, self.cache,
                         jnp.asarray(slots), jnp.asarray(temps), rkey,
                         jnp.full((width,), aid, jnp.int32))
+            first_np, first_lp_np, tids, tlps = _unpack_head(
+                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+            top_np = (tids, tlps)
+            now = time.time()
+            for i, (req, slot, submit_time, n, _, max_new) in \
+                    enumerate(chunk):
+                s = _Slot(req, length=n, submit_time=submit_time,
+                          max_new=max_new)
+                s.first_token_time = now
+                s.generated.append(int(first_np[i]))
+                s.lps.append(float(first_lp_np[i]))
+                s.tops.append(_pairs(top_np[0][i], top_np[1][i]))
+                self._slots[slot] = s
+                self._lengths[slot] = n
+                self._last_tokens[slot] = s.generated[0]
+                self._temps[slot] = req.temperature
+                self._slot_adapters[slot] = aid
+            self.prefix_stats['hits'] += p
+            self.prefix_stats['tokens_reused'] += start * p
+
+    def _start_prefixed_group_paged(self, group, start: int, sb: int,
+                                    key) -> None:
+        """Copy-free prefix reuse: each matched slot's table gets the
+        prefix's full blocks by REFERENCE (refcount bump — N slots
+        share one resident system prompt), a partial tail block is
+        privatized with one block copy, and the suffix forwards over
+        the pool with a DYNAMIC start (no per-start compile, unlike
+        the dense prefix_prefill)."""
+        entry = self._prefixes[key]
+        adapter, _ = key
+        aid = (-1 if adapter is None else self._adapter_names[adapter])
+        bs_ = self.cfg.kv_block_size
+        shared_n = start // bs_
+        tail = start % bs_
+        lanes = self.cfg.prefill_lanes
+        for ofs in range(0, len(group), lanes):
+            chunk = group[ofs:ofs + lanes]
+            p = len(chunk)
+            width = lanes
+            tokens = np.zeros((width, sb), np.int32)
+            true_pos = np.zeros((width,), np.int32)
+            slots = np.zeros((width,), np.int32)
+            temps = np.zeros((width,), np.float32)
+            dsts = []
+            for req, slot, _, n, _, _ in chunk:   # real lanes only
+                self._append_shared_blocks(
+                    slot, [int(b) for b in entry['blocks'][:shared_n]])
+                if tail:
+                    [dst] = self._alloc_blocks(1)
+                    cur = int(self._slot_nblocks[slot])
+                    self._tables_np[slot, cur] = dst
+                    self._slot_nblocks[slot] = cur + 1
+                    dsts.append(dst)
+                self._ensure_blocks(slot, n)
+                self.paged_stats['prefix_block_hits'] += shared_n
+            for i in range(width):
+                req, slot, _, n, _, _ = chunk[min(i, p - 1)]
+                ns = n - start
+                tokens[i, :ns] = req.tokens[start:]
+                true_pos[i] = ns - 1
+                slots[i] = slot
+                temps[i] = req.temperature
+            assert all(slots[i] == slots[p - 1]
+                       for i in range(p, width)), (
+                f'pad lanes must duplicate the last real lane: '
+                f'{slots=} p={p}')
+            if tail and dsts:
+                # One batched copy privatizes every lane's tail block
+                # (pad entries repeat the last dst: identical writes).
+                darr = np.full((width,), dsts[-1], np.int32)
+                darr[:len(dsts)] = dsts
+                with self._ctx():
+                    self.cache = self._paged_copy_blocks(
+                        self.cache, int(entry['blocks'][shared_n]),
+                        jnp.asarray(darr))
+            nb = self._nb_bucket(-(-(start + sb) // bs_))
+            tables = self._lane_tables(slots, nb)
+            self._rng, rkey = jax.random.split(self._rng)
+            with self._ctx():
+                head, _, self.cache = self._paged_prefill(
+                    self.params, jnp.asarray(tokens),
+                    jnp.full((width,), start, jnp.int32),
+                    jnp.asarray(true_pos), self.cache, tables,
+                    jnp.asarray(temps), rkey,
+                    jnp.full((width,), aid, jnp.int32), False)
             first_np, first_lp_np, tids, tlps = _unpack_head(
                 np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
             top_np = (tids, tlps)
@@ -1342,18 +1877,33 @@ class InferenceEngine:
                            for i in range(p, width)), (
                     f'pad lanes must duplicate the last real lane: '
                     f'{slots=} p={p}')
-                pcache = init_cache(self.model_config, width, bucket,
-                                    self.cfg.cache_dtype)
                 want_plp = any(it[0].want_prompt_logprobs
                                for it in chunk)
                 self._rng, key = jax.random.split(self._rng)
-                with self._ctx():   # mesh+rules active at trace time
-                    (head, prompt_packed,
-                     self.cache) = self._prefill_insert(
-                         self.params, jnp.asarray(tokens),
-                         jnp.asarray(true_lens), pcache, self.cache,
-                         jnp.asarray(slots), jnp.asarray(temps), key,
-                         jnp.asarray(aids), want_plp)
+                if self._paged:
+                    for req, slot, _, n, _, _ in chunk:  # real lanes
+                        self._ensure_blocks(slot, n)
+                    bs_ = self.cfg.kv_block_size
+                    tables = self._lane_tables(
+                        slots, self._nb_bucket(bucket // bs_))
+                    with self._ctx():
+                        (head, prompt_packed,
+                         self.cache) = self._paged_prefill(
+                             self.params, jnp.asarray(tokens),
+                             jnp.zeros((width,), jnp.int32),
+                             jnp.asarray(true_lens - 1), self.cache,
+                             tables, jnp.asarray(temps), key,
+                             jnp.asarray(aids), want_plp)
+                else:
+                    pcache = init_cache(self.model_config, width,
+                                        bucket, self.cfg.cache_dtype)
+                    with self._ctx():   # mesh+rules active at trace
+                        (head, prompt_packed,
+                         self.cache) = self._prefill_insert(
+                             self.params, jnp.asarray(tokens),
+                             jnp.asarray(true_lens), pcache, self.cache,
+                             jnp.asarray(slots), jnp.asarray(temps),
+                             key, jnp.asarray(aids), want_plp)
                 topk = self.cfg.logprob_topk
                 first_np, first_lp_np, tids, tlps = _unpack_head(
                     np.asarray(head), topk)              # ONE transfer
@@ -1437,6 +1987,8 @@ class InferenceEngine:
             starts[slot] = job.done
             true_pos[slot] = real - 1
             aids[slot] = job.aid
+            if self._paged:
+                self._ensure_blocks(slot, job.done + real)
             if job.done + real >= job.n:
                 temps[slot] = job.req.temperature
                 finals.append((slot, job))
@@ -1448,11 +2000,25 @@ class InferenceEngine:
             self.chunk_stats['chunks'] += 1
         self.chunk_stats['rounds'] += 1
         self._rng, key = jax.random.split(self._rng)
-        with self._ctx():
-            head, self.cache = self._chunk_prefill(
-                self.params, jnp.asarray(tokens), jnp.asarray(starts),
-                jnp.asarray(true_pos), self.cache, jnp.asarray(temps),
-                key, jnp.asarray(aids))
+        if self._paged:
+            # Table width must cover EVERY lane's frontier + C (active
+            # lanes write dead rows there); an uncovered position would
+            # have its block index clamped into a LIVE block.
+            bs_ = self.cfg.kv_block_size
+            nb = self._nb_bucket(-(-(int(starts.max()) + c) // bs_))
+            tables = self._lane_tables(range(b), nb)
+            with self._ctx():
+                head, _, self.cache = self._paged_prefill(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(starts), jnp.asarray(true_pos),
+                    self.cache, tables, jnp.asarray(temps), key,
+                    jnp.asarray(aids), False)
+        else:
+            with self._ctx():
+                head, self.cache = self._chunk_prefill(
+                    self.params, jnp.asarray(tokens), jnp.asarray(starts),
+                    jnp.asarray(true_pos), self.cache, jnp.asarray(temps),
+                    key, jnp.asarray(aids))
         if finals:
             first_np, first_lp_np, tids, tlps = _unpack_head(
                 np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
@@ -1517,6 +2083,8 @@ class InferenceEngine:
         self._lengths[i] = 0
         self._temps[i] = 0.0
         self._slot_adapters[i] = -1
+        if self._paged:
+            self._free_slot_blocks(i)
         if req.request_id is not None:
             self._cancelled.pop(req.request_id, None)   # stale mark
         return req, res
@@ -1582,11 +2150,38 @@ class InferenceEngine:
         self._maybe_dispatch_ahead(chain, list(self._slots), steps)
         self._consume_window(packed)
 
+    def _decode_tables(self, horizon: int):
+        """Ensure every active slot's blocks for `horizon` more rows
+        (capped at its worst-case demand — writes past the cap go to
+        the dump block) and build the dispatch table, wide enough to
+        cover every lane's frontier + horizon: chunking/idle lanes
+        write dead rows there, and an uncovered position's block index
+        would be CLAMPED into a live block."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._ensure_blocks(i, min(
+                    int(self._lengths[i]) + horizon,
+                    self._slot_cap_rows(len(s.request.tokens),
+                                        s.max_new)))
+        bs_ = self.cfg.kv_block_size
+        nb = self._nb_bucket(
+            -(-(int(self._lengths.max()) + horizon) // bs_))
+        return self._lane_tables(range(self.cfg.num_slots), nb)
+
     def _dispatch_decode(self, steps: int):
         """One device dispatch from the HOST slot mirrors.  Returns the
         packed result handle plus the device-resident (tokens, lengths)
         chain for a potential lookahead dispatch."""
         self._rng, key = jax.random.split(self._rng)
+        if self._paged:
+            tables = self._decode_tables(steps)
+            with self._ctx():
+                packed, last, lens, self.cache = self._paged_decode(
+                    self.params, self.cache,
+                    jnp.asarray(self._last_tokens),
+                    jnp.asarray(self._lengths), jnp.asarray(self._temps),
+                    key, jnp.asarray(self._slot_adapters), tables, steps)
+            return packed, (last, lens)
         with self._ctx():           # mesh+rules active at trace time
             packed, last, lens, self.cache = self._decode(
                 self.params, self.cache, jnp.asarray(self._last_tokens),
@@ -1637,11 +2232,24 @@ class InferenceEngine:
                <= in_flight_steps for s in live):
             return          # every survivor finishes in flight
         self._rng, key = jax.random.split(self._rng)
-        with self._ctx():
-            packed, last, lens, self.cache = self._decode(
-                self.params, self.cache, chain[0], chain[1],
-                jnp.asarray(self._temps), key,
-                jnp.asarray(self._slot_adapters), self.cfg.decode_steps)
+        if self._paged:
+            # The in-flight window advances device lengths past the
+            # host mirror: budget blocks for both windows' rows.
+            tables = self._decode_tables(
+                in_flight_steps + self.cfg.decode_steps)
+            with self._ctx():
+                packed, last, lens, self.cache = self._paged_decode(
+                    self.params, self.cache, chain[0], chain[1],
+                    jnp.asarray(self._temps), key,
+                    jnp.asarray(self._slot_adapters), tables,
+                    self.cfg.decode_steps)
+        else:
+            with self._ctx():
+                packed, last, lens, self.cache = self._decode(
+                    self.params, self.cache, chain[0], chain[1],
+                    jnp.asarray(self._temps), key,
+                    jnp.asarray(self._slot_adapters),
+                    self.cfg.decode_steps)
         self._ahead = ((packed, (last, lens), snap,
                         self._prefill_epoch))
 
@@ -1734,11 +2342,19 @@ class InferenceEngine:
                 return
         self._spec_skips = 0
         self._rng, key = jax.random.split(self._rng)
-        with self._ctx():
-            packed, self.cache = self._spec_verify(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(self._lengths), jnp.asarray(self._temps), key,
-                jnp.asarray(self._slot_adapters))
+        if self._paged:
+            tables = self._decode_tables(k)
+            with self._ctx():
+                packed, self.cache = self._paged_spec_verify(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(self._lengths), jnp.asarray(self._temps),
+                    key, jnp.asarray(self._slot_adapters), tables)
+        else:
+            with self._ctx():
+                packed, self.cache = self._spec_verify(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(self._lengths), jnp.asarray(self._temps),
+                    key, jnp.asarray(self._slot_adapters))
         preds_np, preds_lp_np, g_toks_np, g_lps_np = _unpack_head(
             np.asarray(packed), self.cfg.logprob_topk)       # [B, K...]
         self.spec_stats['dispatches'] += 1
@@ -1801,6 +2417,8 @@ class InferenceEngine:
                     # before reading it).
                     del self._chunking[slot]
                     self._lengths[slot] = 0
+                    if self._paged:
+                        self._free_slot_blocks(slot)
                     return True
             self._cancelled[request_id] = time.time()
             return False
@@ -1863,15 +2481,38 @@ class InferenceEngine:
                 # loop generate_stream DOES cap, to protect in-flight
                 # requests' latency during bursts.)
                 to_start = []
+                admit_extra = 0
                 while pending:
                     slot = self._free_slot(exclude=[it[1]
                                                     for it in to_start])
                     if slot is None:
                         break
+                    if self._paged:
+                        req = pending[0]
+                        demand = self._blocks_demand(
+                            len(req.tokens), self._max_new(req))
+                        # Oversized demand falls through to
+                        # _validate_request, which fails the request
+                        # alone instead of deferring it forever.
+                        if demand <= self._num_blocks - 1 and \
+                                not self._can_admit_blocks(demand,
+                                                           admit_extra):
+                            # Nothing running and nothing about to:
+                            # evict prefix entries rather than deadlock
+                            # (validation bounds demand by the pool).
+                            if (to_start or self._chunking or
+                                    any(s is not None
+                                        for s in self._slots) or
+                                    not self._force_admit_blocks(
+                                        demand)):
+                                self.paged_stats['deferred'] += 1
+                                break
                     req = pending.pop(0)
                     try:
                         to_start.append((req, slot, t0,
                                          *self._validate_request(req)))
+                        if self._paged:
+                            admit_extra += demand
                     except ValueError as e:
                         # A bad request fails alone, not the whole batch.
                         finished.append((req, RequestResult(
@@ -1923,6 +2564,7 @@ class InferenceEngine:
         while not stop_event.is_set():
             moved = False
             to_start = []
+            admit_extra = 0
             dequeued = cancelled_deq = 0
             while True:
                 if len(to_start) >= self.cfg.prefills_per_gap and any(
@@ -1931,10 +2573,34 @@ class InferenceEngine:
                 slot = self._free_slot(exclude=[it[1] for it in to_start])
                 if slot is None:
                     break
-                try:
-                    req = request_queue.get_nowait()
-                except queue.Empty:
-                    break
+                # Admission-deferred requests go first (head-of-line:
+                # a big request must not starve behind a stream of
+                # small ones that keep fitting around it).
+                from_deferred = bool(self._deferred)
+                if from_deferred:
+                    req = self._deferred.pop(0)
+                else:
+                    try:
+                        req = request_queue.get_nowait()
+                    except queue.Empty:
+                        break
+                if self._paged:
+                    demand = self._blocks_demand(
+                        len(req.tokens), self._max_new(req))
+                    admissible = (demand > self._num_blocks - 1 or
+                                  self._can_admit_blocks(demand,
+                                                         admit_extra))
+                    if not admissible and not to_start and \
+                            not self._chunking and \
+                            not any(s is not None for s in self._slots):
+                        with self._lock:    # mutates self._prefixes
+                            admissible = self._force_admit_blocks(demand)
+                    if not admissible:
+                        # Put it back at the head and stop dequeuing:
+                        # it is admitted first once blocks free up.
+                        self._deferred.insert(0, req)
+                        self.paged_stats['deferred'] += 1
+                        break
                 if (req.request_id is not None and
                         req.request_id in self._cancelled):
                     # Cancelled while queued: never prefill it.
@@ -1961,6 +2627,8 @@ class InferenceEngine:
                     to_start.append((req, slot,
                                      req.arrival_time or time.time(),
                                      *self._validate_request(req)))
+                    if self._paged:
+                        admit_extra += demand
                 except ValueError as e:
                     with self._lock:
                         result_cb(RequestResult(
@@ -2026,6 +2694,11 @@ class InferenceEngine:
                                 self._slots[slot] = None
                                 self._lengths[slot] = 0
                                 self._temps[slot] = 0.0
+                            if self._paged and self._slots[slot] is None \
+                                    and slot not in self._chunking:
+                                # Blocks a half-applied batch already
+                                # allocated for this slot would leak.
+                                self._free_slot_blocks(slot)
                         for req, slot, *_ in to_start:
                             result_cb(RequestResult(
                                 request_id=req.request_id,

@@ -552,6 +552,10 @@ def _make_handler(server: InferenceServer):
                     'prefill_chunk': eng.cfg.prefill_chunk,
                     'chunking_slots': len(eng._chunking),
                     'chunk': dict(eng.chunk_stats),
+                    # KV HBM accounting: layout + (paged) pool occupancy
+                    # — blocks total/free/shared, bytes resident, prefix
+                    # blocks held by refcount (engine.stats()).
+                    'kv_cache': eng.stats(),
                 })
             else:
                 self._json(404, {'error': 'not found'})
@@ -1243,7 +1247,9 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         adaptive_window: bool = False,
         decode_lookahead: bool = False,
         auto_prefix: bool = False,
-        prefill_chunk: int = 0) -> None:
+        prefill_chunk: int = 0,
+        kv_block_size: int = 0,
+        kv_blocks: Optional[int] = None) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -1361,7 +1367,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       lora_max_adapters=lora_max_adapters,
                       adaptive_decode_window=adaptive_window,
                       decode_lookahead=decode_lookahead,
-                      prefill_chunk=prefill_chunk)
+                      prefill_chunk=prefill_chunk,
+                      kv_block_size=kv_block_size, kv_blocks=kv_blocks)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -1427,6 +1434,21 @@ def main() -> None:
                              'chunk and lifting the largest-bucket '
                              'prompt cap (0 = monolithic prefill; must '
                              'divide --max-cache-len)')
+    parser.add_argument('--kv-block-size', type=int, default=0,
+                        help='block-paged KV cache: pool block size in '
+                             'tokens (0 = dense slotted layout; must '
+                             'divide --max-cache-len, every prefill '
+                             'bucket, and --prefill-chunk). Decode '
+                             'streams ceil(len/block)*block cached rows '
+                             'per step instead of max_cache_len, and '
+                             'prefix hits share blocks copy-free')
+    parser.add_argument('--kv-blocks', type=int, default=None,
+                        help='pool size in blocks (incl. the reserved '
+                             'dump block). Default fully provisions '
+                             'num_slots*max_cache_len/block + 1; smaller '
+                             'pools oversubscribe HBM and admission-'
+                             'defer requests whose worst-case demand '
+                             'does not fit')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
@@ -1441,7 +1463,8 @@ def main() -> None:
         adaptive_window=args.adaptive_window,
         decode_lookahead=args.decode_lookahead,
         auto_prefix=args.auto_prefix,
-        prefill_chunk=args.prefill_chunk)
+        prefill_chunk=args.prefill_chunk,
+        kv_block_size=args.kv_block_size, kv_blocks=args.kv_blocks)
 
 
 if __name__ == '__main__':

@@ -197,6 +197,48 @@ def write_kv_and_attend(kv_cache, k, v, q, positions, window=None):
     return out, (k_cache, v_cache)
 
 
+def paged_write_kv_and_attend(kv_pool, k, v, q, positions, tables,
+                              block_size, window=None):
+    """Block-paged twin of write_kv_and_attend: the cache is one
+    [num_blocks, Hkv, block_size, D] pool per layer shared by every
+    sequence, and `tables` [B, NB] maps each sequence's logical block
+    index to a pool block id (the infer engine's host-side allocator
+    owns the mapping; block 0 is a reserved dump block that absorbs
+    writes past a sequence's allocated region).
+
+    Writes scatter the T new K/V rows to (tables[b, pos // bs],
+    pos % bs); attention gathers only the NB allocated blocks into a
+    [B, Hkv, NB*bs, D] view, so decode streams ceil(len/bs)*bs rows
+    instead of max_cache_len — HBM traffic proportional to tokens
+    actually held.  Gathered row r IS absolute position r (tables are
+    logically ordered), so the existing decode_attention mask applies
+    unchanged; rows from unallocated table entries land past every
+    query position and are masked.  A position beyond the table's
+    range clamps to the last entry (jnp gather semantics) — the engine
+    guarantees such overrun writes only ever hit rows that are already
+    dead (see infer.engine)."""
+    k_pool, v_pool = kv_pool
+    bs = block_size
+    blk = jnp.take_along_axis(tables, positions // bs, axis=1)   # [B, T]
+    off = positions % bs                                         # [B, T]
+    # Advanced indices (blk, off) around the Hkv slice move to the
+    # front: the value shape is [B, T, Hkv, D].
+    k_pool = k_pool.at[blk, :, off].set(
+        jnp.swapaxes(k, 1, 2).astype(k_pool.dtype))
+    v_pool = v_pool.at[blk, :, off].set(
+        jnp.swapaxes(v, 1, 2).astype(v_pool.dtype))
+
+    def view(pool):
+        g = pool[tables]                  # [B, NB, Hkv, bs, D]
+        g = jnp.swapaxes(g, 1, 2)         # [B, Hkv, NB, bs, D]
+        b_, h_, nb_, _, d_ = g.shape
+        return g.reshape(b_, h_, nb_ * bs, d_)
+
+    out = decode_attention(q, view(k_pool), view(v_pool), positions,
+                           window=window)
+    return out, (k_pool, v_pool)
+
+
 def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      q_positions: jax.Array,
                      window: Optional[int] = None) -> jax.Array:
@@ -330,7 +372,8 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, adapter_ids=None):
+    def __call__(self, x, positions, kv_cache=None, adapter_ids=None,
+                 paged_tables=None, paged_block_size=None):
         cfg = self.config
         d = cfg.head_dim_
 
@@ -353,7 +396,14 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling_)
         k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling_)
         new_cache = None
-        if kv_cache is not None:
+        if kv_cache is not None and paged_tables is not None:
+            # Block-paged decode/prefill: scatter the new rows into the
+            # sequence's allocated pool blocks, attend over the gathered
+            # block view (length-proportional HBM traffic).
+            out, new_cache = paged_write_kv_and_attend(
+                kv_cache, k, v, q, positions, paged_tables,
+                paged_block_size, window=cfg.sliding_window)
+        elif kv_cache is not None:
             # Incremental decode/prefill: write the (roped) new K/V rows
             # into the cache, then attend over the whole cache.
             out, new_cache = write_kv_and_attend(kv_cache, k, v, q,
@@ -416,7 +466,8 @@ class DecoderLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, adapter_ids=None):
+    def __call__(self, x, positions, kv_cache=None, adapter_ids=None,
+                 paged_tables=None, paged_block_size=None):
         # Residual-stream activations are anchored to the batch-sharded
         # layout at BOTH norm seams, not just the layer output: without
         # an anchor on the norm outputs, the backward of the qkv/mlp
@@ -431,7 +482,9 @@ class DecoderLayer(nn.Module):
         attn = Attention(self.config, name='attn')
         if kv_cache is not None:
             attn_out, new_cache = attn(attn_in, positions, kv_cache,
-                                       adapter_ids=adapter_ids)
+                                       adapter_ids=adapter_ids,
+                                       paged_tables=paged_tables,
+                                       paged_block_size=paged_block_size)
         else:
             attn_out = attn(attn_in, positions,
                             adapter_ids=adapter_ids)
@@ -453,7 +506,8 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, cache=None,
-                 hidden_only=False, adapter_ids=None):
+                 hidden_only=False, adapter_ids=None,
+                 paged_tables=None, paged_block_size=None):
         """Training/scoring: __call__(tokens) -> logits.
 
         hidden_only=True returns the final-norm hidden states [B, S, H]
@@ -465,6 +519,11 @@ class Llama(nn.Module):
         (logits, new_cache) where `cache` is a per-layer list of
         (k_cache, v_cache) [B, Hkv, M, D] pairs (see infer.engine) and
         `positions` [B, T] are the absolute cache positions of `tokens`.
+
+        Block-paged inference: additionally pass paged_tables [B, NB]
+        (pool block ids per sequence, infer.engine's allocator) and
+        paged_block_size (a static int); `cache` is then the per-layer
+        [(k_pool, v_pool)] block pools from init_paged_cache.
         """
         cfg = self.config
         if positions is None:
@@ -487,7 +546,9 @@ class Llama(nn.Module):
             layer = DecoderLayer(cfg, name=f'layer_{i}')
             if cache is not None:
                 x, layer_cache = layer(x, positions, cache[i],
-                                       adapter_ids=adapter_ids)
+                                       adapter_ids=adapter_ids,
+                                       paged_tables=paged_tables,
+                                       paged_block_size=paged_block_size)
                 new_cache.append(layer_cache)
             elif adapter_ids is not None:
                 # Multi-LoRA scoring (no cache): remat is a training
@@ -526,5 +587,18 @@ def init_cache(config: LlamaConfig, batch_size: int, max_len: int,
                dtype=jnp.bfloat16):
     """Per-layer [(k, v)] KV cache, each [B, Hkv, max_len, head_dim]."""
     shape = (batch_size, config.num_kv_heads, max_len, config.head_dim_)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(config.num_layers)]
+
+
+def init_paged_cache(config: LlamaConfig, num_blocks: int,
+                     block_size: int, dtype=jnp.bfloat16):
+    """Per-layer [(k_pool, v_pool)] block-paged KV cache, each
+    [num_blocks, Hkv, block_size, head_dim].  Block 0 is reserved as the
+    dump block by the engine's allocator (absorbs dead-lane and overrun
+    writes); sequences map logical blocks to pool blocks via the tables
+    passed to paged_write_kv_and_attend."""
+    shape = (num_blocks, config.num_kv_heads, block_size,
+             config.head_dim_)
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(config.num_layers)]

@@ -1,0 +1,316 @@
+"""Block-paged KV cache: paged-vs-dense token identity + allocator.
+
+The contract under test (infer/engine.py): with a fully provisioned
+pool (the default) a paged engine is OBSERVABLY IDENTICAL to the dense
+engine — same greedy tokens, same logprobs, same scheduling — across
+offline batches, serving interleavings, chunked prefill, speculative
+decoding, and prefix reuse.  The paged win (bounded pool + admission
+control + copy-free prefix sharing) is exercised by the tight-pool and
+allocator tests.
+
+Everything here is tier-1 (CPU dryrun): one tiny 2-layer model, its
+params built ONCE and shared by every engine, fixed seeds.
+"""
+import copy
+import queue
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
+                                       Request)  # noqa: E402
+from skypilot_tpu.models.llama import LlamaConfig  # noqa: E402
+
+
+@pytest.fixture(scope='module')
+def tiny_config():
+    return LlamaConfig(name='paged-test', vocab_size=101, hidden_size=32,
+                       intermediate_size=64, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_seq_len=128,
+                       tie_embeddings=True, dtype='float32')
+
+
+COMMON = dict(num_slots=4, max_cache_len=64, prefill_buckets=(8, 16, 32),
+              max_new_tokens=8, cache_dtype=jnp.float32)
+
+
+@pytest.fixture(scope='module')
+def shared_params(tiny_config):
+    """One param tree for every engine in the module: identity tests
+    need bit-identical weights, and each init re-jit is tier-1 time."""
+    eng = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          rng=jax.random.PRNGKey(0))
+    return eng.params
+
+
+def _pair(tiny_config, shared_params, block_size=8, **overrides):
+    """(dense, paged) engines sharing weights and rng seed."""
+    base = dict(COMMON)
+    base.update(overrides)
+    dense = InferenceEngine(tiny_config, InferConfig(**base),
+                            params=shared_params,
+                            rng=jax.random.PRNGKey(7))
+    paged = InferenceEngine(tiny_config,
+                            InferConfig(kv_block_size=block_size, **base),
+                            params=shared_params,
+                            rng=jax.random.PRNGKey(7))
+    return dense, paged
+
+
+def _random_requests(seed, n, max_prompt=30, max_new=8, vocab=101,
+                     ids=False):
+    r = random.Random(seed)
+    return [Request(request_id=str(i) if ids else None,
+                    tokens=[r.randrange(1, vocab)
+                            for _ in range(r.randrange(3, max_prompt))],
+                    max_new_tokens=r.randrange(1, max_new))
+            for i in range(n)]
+
+
+def _assert_identical(out_d, out_p):
+    for a, b in zip(out_d, out_p):
+        assert a.output_tokens == b.output_tokens
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_allclose(a.logprobs, b.logprobs, atol=1e-5)
+
+
+def _serve(eng, jobs, burst=3, pause=0.03):
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    t = threading.Thread(
+        target=eng.generate_stream,
+        args=(q, lambda res: results.__setitem__(res.request_id, res),
+              stop))
+    t.start()
+    try:
+        for i, job in enumerate(jobs):
+            q.put(copy.deepcopy(job))
+            if i % burst == burst - 1:
+                time.sleep(pause)   # force multiple dequeue gaps
+        deadline = time.time() + 120
+        while len(results) < len(jobs) and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join()
+    assert len(results) == len(jobs)
+    return results
+
+
+def test_paged_offline_identity(tiny_config, shared_params):
+    dense, paged = _pair(tiny_config, shared_params)
+    reqs = _random_requests(0, 6)
+    out_d = dense.generate([copy.deepcopy(r) for r in reqs])
+    out_p = paged.generate([copy.deepcopy(r) for r in reqs])
+    _assert_identical(out_d, out_p)
+    st = paged.stats()
+    assert st['kv_layout'] == 'paged'
+    assert st['blocks_allocated'] == 0          # everything freed
+    assert st['blocks_free'] == st['blocks_total']
+
+
+def test_paged_serving_interleaved_identity(tiny_config, shared_params):
+    """Randomized serving interleaving (bursty arrivals, chunked
+    prefill, decode lookahead) vs the same engine pair: the paged
+    scheduler must make the SAME decisions, so streams are identical
+    per request id."""
+    dense, paged = _pair(tiny_config, shared_params, prefill_chunk=8,
+                         decode_lookahead=True)
+    reqs = _random_requests(11, 10, max_prompt=45, ids=True)
+    res_d = _serve(dense, reqs)
+    res_p = _serve(paged, reqs)
+    for req in reqs:
+        a, b = res_d[req.request_id], res_p[req.request_id]
+        assert a.output_tokens == b.output_tokens, req.request_id
+    st = paged.stats()
+    assert st['blocks_allocated'] == 0
+
+
+def test_paged_speculative_identity(tiny_config, shared_params):
+    """Prompt-lookup speculative decoding over the pool: small vocab
+    makes n-gram draft hits frequent, so the verify path actually
+    accepts tokens in both engines."""
+    dense, paged = _pair(tiny_config, shared_params, draft_len=3,
+                         max_new_tokens=12)
+    r = random.Random(3)
+    reqs = [Request(tokens=[r.randrange(1, 5)
+                            for _ in range(r.randrange(6, 20))],
+                    max_new_tokens=r.randrange(4, 12)) for _ in range(5)]
+    out_d = dense.generate([copy.deepcopy(q) for q in reqs])
+    out_p = paged.generate([copy.deepcopy(q) for q in reqs])
+    _assert_identical(out_d, out_p)
+    assert paged.spec_stats == dense.spec_stats
+    assert paged.spec_stats['dispatches'] > 0
+
+
+def test_paged_prefix_identity_and_sharing(tiny_config, shared_params):
+    """Prefix reuse: dense copies KV rows, paged bumps refcounts on the
+    prefix's blocks (copy-free).  Tokens must match, including the
+    prompt == prefix edge (one-token forward), and shared blocks must
+    show up in stats while slots are mid-flight."""
+    dense, paged = _pair(tiny_config, shared_params, max_prefixes=2,
+                         kv_blocks=64)
+    prefix = [7] * 11                           # crosses a block edge
+    dense.register_prefix(prefix)
+    paged.register_prefix(prefix)
+    st = paged.stats()
+    assert st['blocks_prefix'] == 2             # ceil(11/8)
+    r = random.Random(1)
+    reqs = []
+    for _ in range(5):
+        tail = [r.randrange(1, 101) for _ in range(r.randrange(1, 10))]
+        reqs.append(Request(tokens=prefix + tail,
+                            max_new_tokens=r.randrange(2, 8)))
+    reqs.append(Request(tokens=list(prefix), max_new_tokens=4))
+    out_d = dense.generate([copy.deepcopy(q) for q in reqs])
+    out_p = paged.generate([copy.deepcopy(q) for q in reqs])
+    _assert_identical(out_d, out_p)
+    assert paged.prefix_stats == dense.prefix_stats
+    assert paged.prefix_stats['hits'] >= 5
+    assert paged.paged_stats['prefix_block_hits'] > 0
+    # Entry blocks survive the batch with exactly the entry's refcount.
+    st = paged.stats()
+    assert st['blocks_prefix'] == 2
+    assert st['blocks_allocated'] == 2          # only the entry remains
+    # Mid-flight sharing is visible: start two prefix-matched requests
+    # host-side and look at the pool before finishing them.
+    items = []
+    for slot in range(2):
+        req = Request(tokens=prefix + [50 + slot], max_new_tokens=4)
+        items.append((req, slot, 0.0, *paged._validate_request(req)))
+    paged._start_batch(items)
+    st = paged.stats()
+    assert st['blocks_shared'] == 1             # the full block, 3 refs
+    assert st['shared_refs_saved'] == 2
+    for i in range(2):
+        paged._finish_slot(i, 'cancelled')
+    assert paged.stats()['blocks_allocated'] == 2
+
+
+def test_paged_fp8_cache_identity(tiny_config, shared_params):
+    """fp8 cache_dtype through the paged write/gather path: both
+    layouts quantize rows the same way, so greedy streams still
+    match."""
+    if not hasattr(jnp, 'float8_e4m3fn'):
+        pytest.skip('no fp8 in this jax')
+    dense, paged = _pair(tiny_config, shared_params,
+                         cache_dtype=jnp.float8_e4m3fn)
+    reqs = _random_requests(5, 4, max_prompt=20)
+    out_d = dense.generate([copy.deepcopy(r) for r in reqs])
+    out_p = paged.generate([copy.deepcopy(r) for r in reqs])
+    for a, b in zip(out_d, out_p):
+        assert a.output_tokens == b.output_tokens
+
+
+def test_paged_tight_pool_defers_and_completes(tiny_config,
+                                               shared_params):
+    """A pool smaller than num_slots * max_blocks admission-defers
+    instead of corrupting: every request still finishes (offline and
+    serving), blocks drain to zero, and the deferral counter moves."""
+    cfg = InferConfig(num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=32,
+                      cache_dtype=jnp.float32, kv_block_size=8,
+                      kv_blocks=17)      # fits ~2 worst-case requests
+    eng = InferenceEngine(tiny_config, cfg, params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    r = random.Random(5)
+    jobs = [Request(request_id=str(i),
+                    tokens=[r.randrange(1, 101) for _ in range(20)],
+                    max_new_tokens=32) for i in range(5)]
+    out = eng.generate([copy.deepcopy(j) for j in jobs])
+    assert all(len(o.output_tokens) == 32 for o in out)
+    assert eng.paged_stats['deferred'] > 0
+    res = _serve(eng, jobs)
+    assert all(len(res[j.request_id].output_tokens) == 32 for j in jobs)
+    st = eng.stats()
+    assert st['blocks_allocated'] == 0
+    assert st['admission_deferred'] == eng.paged_stats['deferred']
+
+
+def test_paged_allocator_unit(tiny_config, shared_params):
+    """Host-side allocator invariants, no dispatches: refcounts, the
+    nb bucketing, admission arithmetic, and table build."""
+    _, eng = _pair(tiny_config, shared_params)
+    assert eng._nb_bucket(1) == 1
+    assert eng._nb_bucket(3) == 4
+    assert eng._nb_bucket(9) == 8          # capped at max_blocks=64/8
+    free0 = len(eng._free_blocks)
+    eng._ensure_blocks(0, 17)              # 3 blocks
+    assert int(eng._slot_nblocks[0]) == 3
+    assert len(eng._free_blocks) == free0 - 3
+    eng._ensure_blocks(0, 17)              # idempotent
+    assert len(eng._free_blocks) == free0 - 3
+    shared = [int(b) for b in eng._tables_np[0, :2]]
+    eng._append_shared_blocks(1, shared)
+    assert [int(b) for b in eng._tables_np[1, :2]] == shared
+    assert all(eng._block_refs[b] == 2 for b in shared)
+    eng._free_slot_blocks(0)
+    # Shared blocks survive slot 0's free (slot 1 still references).
+    assert all(eng._block_refs[b] == 1 for b in shared)
+    assert len(eng._free_blocks) == free0 - 2
+    eng._free_slot_blocks(1)
+    assert len(eng._free_blocks) == free0
+    assert not eng._block_refs[1:].any()
+    # The dump block is permanently held and never allocated.
+    assert eng._block_refs[0] == 1 and 0 not in eng._free_blocks
+    # Tables truncate/pad to the dispatch width (pad entries = dump).
+    eng._ensure_blocks(2, 20)
+    t = np.asarray(eng._lane_tables([2], 8))
+    assert t.shape == (1, 8) and (t[0, 3:] == 0).all() and t[0, 0] != 0
+    eng._free_slot_blocks(2)
+    # Admission: full pool admits worst case; a claimed pool does not.
+    demand = eng._blocks_demand(20, 32)    # min(20+31, 64) rows -> 7
+    assert demand == 7
+    assert eng._can_admit_blocks(demand)
+    assert not eng._can_admit_blocks(len(eng._free_blocks) + 1)
+
+
+def test_paged_config_validation(tiny_config):
+    with pytest.raises(ValueError, match='max_cache_len'):
+        InferenceEngine(tiny_config, InferConfig(
+            num_slots=2, max_cache_len=60, prefill_buckets=(8,),
+            kv_block_size=8))
+    with pytest.raises(ValueError, match='bucket'):
+        InferenceEngine(tiny_config, InferConfig(
+            num_slots=2, max_cache_len=64, prefill_buckets=(12,),
+            kv_block_size=8))
+    with pytest.raises(ValueError, match='prefill_chunk'):
+        InferenceEngine(tiny_config, InferConfig(
+            num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+            prefill_chunk=12, kv_block_size=8))
+    with pytest.raises(ValueError, match='kv_blocks'):
+        InferenceEngine(tiny_config, InferConfig(
+            num_slots=2, max_cache_len=64, prefill_buckets=(8,),
+            kv_block_size=8, kv_blocks=4))
+
+
+def test_check_tier1_budget_parser(tmp_path):
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        'check_tier1_budget',
+        pathlib.Path(__file__).resolve().parent.parent / 'scripts' /
+        'check_tier1_budget.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    log = tmp_path / 't1.log'
+    log.write_text(
+        '............\n'
+        'slowest 15 durations\n'
+        '  12.31s call     tests/test_a.py::test_slow\n'
+        '   0.50s setup    tests/test_a.py::test_slow\n'
+        '==== 240 passed in 512.34s ====\n')
+    wall, durs = mod.parse_log(log.read_text())
+    assert wall == 512.34
+    assert durs[0] == (12.31, 'call', 'tests/test_a.py::test_slow')
+    assert mod.main([str(log)]) == 0                   # within budget
+    assert mod.main([str(log), '--budget', '500']) == 1  # over
+    # 512.34 is inside the 870 cliff but NOT the 10% headroom of 550.
+    assert mod.main([str(log), '--budget', '550']) == 1
+    log.write_text('....\n')   # timed out: no summary line
+    assert mod.main([str(log)]) == 1
