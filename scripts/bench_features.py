@@ -280,6 +280,70 @@ def bench_kv_occupancy(block_size: int = 16):
     return out
 
 
+def bench_fault_containment(num_requests: int = 16,
+                            prompt_len: int = 128,
+                            new_tokens: int = 64):
+    """Cost of the fault-tolerance surface, measured on-chip:
+
+    - armed-vs-unarmed decode overhead (the zero-overhead-unarmed
+      claim: a plan that never fires should cost one attribute check
+      per site consult);
+    - containment wall-time: an attributed decode-step fault mid-batch
+      fails one request while the survivors run to completion — the
+      faulted batch should cost about the same as the clean one, not
+      a restart.
+    """
+    import numpy as np
+
+    from skypilot_tpu.infer import FaultPlan, FaultSpec, Request
+    eng = _engine(num_slots=8, max_cache_len=256)
+    rng = np.random.default_rng(0)
+
+    def reqs():
+        return [Request(tokens=rng.integers(
+            0, 32000, size=prompt_len).tolist(),
+                        max_new_tokens=new_tokens, request_id=str(i))
+                for i in range(num_requests)]
+
+    eng.warmup_decode(reqs()[0].tokens)
+
+    t0 = time.time()
+    clean = eng.generate(reqs())
+    wall_clean = time.time() - t0
+    assert all(r.finish_reason == 'length' for r in clean)
+
+    # Armed but never firing: measures the consult overhead alone.
+    eng.arm_faults(FaultPlan(seed=0, specs=[
+        FaultSpec(site='decode_step', hits=(10 ** 9,))]))
+    t0 = time.time()
+    armed = eng.generate(reqs())
+    wall_armed = time.time() - t0
+    eng.disarm_faults()
+    assert all(r.finish_reason == 'length' for r in armed)
+
+    # Attributed mid-batch fault: one request dies, survivors finish.
+    eng.arm_faults(FaultPlan(seed=0, specs=[
+        FaultSpec(site='decode_step', hits=(3,), slot=1)]))
+    t0 = time.time()
+    faulted = eng.generate(reqs())
+    wall_faulted = time.time() - t0
+    eng.disarm_faults()
+    failed = [r for r in faulted if r.finish_reason == 'error']
+    assert len(failed) == 1 and failed[0].error_class == 'internal'
+
+    return {
+        'wall_clean_s': round(wall_clean, 3),
+        'wall_armed_unfired_s': round(wall_armed, 3),
+        'armed_overhead_pct': round(
+            100.0 * (wall_armed - wall_clean) / wall_clean, 2),
+        'wall_faulted_s': round(wall_faulted, 3),
+        'failed_requests': len(failed),
+        'survivors_completed': sum(
+            1 for r in faulted if r.finish_reason == 'length'),
+        'counters': dict(eng.fault_stats),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--out', default=None)
@@ -309,6 +373,8 @@ def main():
         print(json.dumps(result['chunked_prefill']))
     result['kv_occupancy'] = bench_kv_occupancy()
     print(json.dumps(result['kv_occupancy']))
+    result['fault_containment'] = bench_fault_containment()
+    print(json.dumps(result['fault_containment']))
     if args.out:
         with open(args.out, 'w') as f:
             json.dump(result, f, indent=2)

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Chaos smoke: seeded fault sweep over the small model.
+
+Tier-1 companion to tests/test_faults.py: where the tests pin exact
+scenarios (one fault, one assertion), this sweep arms a *mixture* of
+probabilistic faults across every injection site and checks the two
+properties that must hold under ANY fault sequence:
+
+  1. **No hang** — every serving episode drains within its wall bound
+     (nothing waits on a dead loop or a stuck allocator).
+  2. **Full request accounting** — every submitted request gets exactly
+     one terminal result (ok / error / deadline), and the paged block
+     pool balances at drain (all blocks free, refcounts zero).
+
+Probabilistic specs draw from per-spec seeded streams (FaultPlan), so
+a failing seed reproduces exactly:  scripts/chaos_smoke.py --seeds 3
+
+Exit code: 0 = all episodes passed, 1 = any property violated.
+"""
+import argparse
+import copy
+import queue
+import sys
+import threading
+import time
+
+sys.path.insert(0, '.')
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.infer import (FaultPlan, FaultSpec, InferConfig,
+                                InferenceEngine, Request)
+from skypilot_tpu.models.llama import LlamaConfig
+
+EPISODE_WALL_S = 120.0
+
+
+def build_engine() -> InferenceEngine:
+    mc = LlamaConfig(name='chaos-smoke', vocab_size=101, hidden_size=32,
+                     intermediate_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, max_seq_len=128,
+                     tie_embeddings=True, dtype='float32')
+    cfg = InferConfig(num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=8,
+                      cache_dtype=jnp.float32, kv_block_size=8)
+    return InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+
+
+def make_plan(seed: int) -> FaultPlan:
+    """A bit of everything: attributed and unattributed dispatch
+    faults, allocator pressure, NaN lanes, stalls, and loop death."""
+    return FaultPlan(seed=seed, specs=[
+        FaultSpec(site='decode_step', prob=0.10, slot=1, max_fires=2),
+        FaultSpec(site='decode_step', prob=0.04, max_fires=1),
+        FaultSpec(site='prefill', prob=0.10, max_fires=2),
+        FaultSpec(site='chunk_round', prob=0.10, max_fires=1),
+        FaultSpec(site='block_alloc', prob=0.15, max_fires=4),
+        FaultSpec(site='nonfinite_logits', prob=0.08, slot=0,
+                  max_fires=2),
+        FaultSpec(site='stall', prob=0.10, stall_s=0.05),
+        FaultSpec(site='serve_loop', prob=0.05, max_fires=2),
+    ])
+
+
+def make_requests(n: int):
+    reqs = []
+    for i in range(n):
+        toks = [(5 * i + j) % 97 + 1 for j in range(3 + i % 5)]
+        reqs.append(Request(
+            request_id=f'r{i}', tokens=toks,
+            max_new_tokens=4 + i % 12,
+            # Every 5th request carries a (generous) deadline so the
+            # eviction path runs inside the sweep too.
+            deadline_s=30.0 if i % 5 == 0 else None))
+    return reqs
+
+
+def episode(eng: InferenceEngine, seed: int, n: int) -> list:
+    """One serving episode under an armed plan; returns violations."""
+    plan = make_plan(seed)
+    reqs = make_requests(n)
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    for r in reqs:
+        q.put(copy.deepcopy(r))
+    eng.arm_faults(plan)
+    loop_exc = []
+
+    def run():
+        try:
+            eng.generate_stream(
+                q, lambda res: results.setdefault(res.request_id, res),
+                stop)
+        except Exception as e:  # supervisor gave up: legal iff every
+            loop_exc.append(e)  # request was still accounted for
+    t = threading.Thread(target=run, daemon=True)
+    t0 = time.time()
+    t.start()
+    try:
+        while len(results) < n and time.time() - t0 < EPISODE_WALL_S:
+            if loop_exc and len(results) >= n:
+                break
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        eng.disarm_faults()
+
+    bad = []
+    if t.is_alive():
+        bad.append('HANG: serving loop did not stop')
+    if len(results) != n:
+        missing = sorted(set(r.request_id for r in reqs) - set(results))
+        bad.append(f'ACCOUNTING: {len(results)}/{n} results; '
+                   f'missing {missing}')
+    reasons = {}
+    for res in results.values():
+        reasons[res.finish_reason] = reasons.get(res.finish_reason,
+                                                 0) + 1
+        if res.finish_reason not in ('length', 'eos', 'error',
+                                     'deadline'):
+            bad.append(f'BAD finish_reason {res.finish_reason!r} '
+                       f'for {res.request_id}')
+    if eng._paged:
+        if len(eng._free_blocks) != eng._num_blocks - 1 or \
+                eng._block_refs[0] != 1 or \
+                not (eng._block_refs[1:] == 0).all():
+            bad.append(
+                f'BLOCK LEAK: {len(eng._free_blocks)} free of '
+                f'{eng._num_blocks - 1}, refs={eng._block_refs.tolist()}')
+    print(f'  seed={seed}: {reasons} wall={time.time() - t0:.1f}s '
+          f'fired={plan.stats()["fired"]} '
+          f'counters={eng.fault_stats} '
+          f'{"terminal-giveup " if loop_exc else ""}'
+          f'{"FAIL" if bad else "ok"}')
+    return bad
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--seeds', type=int, nargs='+', default=[0, 1, 2],
+                    help='fault-plan seeds to sweep')
+    ap.add_argument('--requests', type=int, default=12)
+    args = ap.parse_args()
+    print(f'chaos smoke: seeds={args.seeds} '
+          f'requests/episode={args.requests}')
+    eng = build_engine()
+    failures = []
+    for seed in args.seeds:
+        failures += episode(eng, seed, args.requests)
+    if failures:
+        print('CHAOS SMOKE FAILED:')
+        for f in failures:
+            print(f'  {f}')
+        return 1
+    print('chaos smoke: PASS')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -17,4 +17,9 @@ timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
 python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" || rc=1
+# Seeded chaos sweep (fault injection): no hang + full request
+# accounting under randomized faults.  Outside the pytest window on
+# purpose — it must not eat durations budget from the suite.
+timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python scripts/chaos_smoke.py || rc=1
 exit "$rc"

@@ -4,10 +4,15 @@ The reference serves LLMs by launching external engines (vLLM on GPUs,
 JetStream on TPUs — examples/tpu/v6e/serve-llama2-7b.yaml); here the
 engine is part of the framework: slotted KV cache, bucketed prefill,
 jitted single-token decode over the whole batch, continuous batching.
+Fault tolerance is part of the surface too: deterministic fault
+injection (faults.FaultPlan), step-level failure containment, and
+per-request deadlines (Request.deadline_s).
 """
 from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
                                        Request, RequestResult,
                                        resolve_cache_dtype)
+from skypilot_tpu.infer.faults import FaultPlan, FaultSpec, InjectedFault
 
 __all__ = ['InferConfig', 'InferenceEngine', 'Request', 'RequestResult',
-           'resolve_cache_dtype']
+           'resolve_cache_dtype', 'FaultPlan', 'FaultSpec',
+           'InjectedFault']

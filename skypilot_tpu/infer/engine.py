@@ -203,6 +203,14 @@ class InferConfig:
     # Parity: vLLM automatic-prefix-caching, here with explicit
     # registration (engine.register_prefix / POST /cache_prefix).
     max_prefixes: int = 16
+    # Stall bound for benchmark_serving/run(): if NO request completes
+    # for this many seconds while results are outstanding, the run is
+    # declared stalled and aborted with the engine's stats() in the
+    # error (replaces the old hard-coded 3600 s wait, under which a
+    # dead serving loop stranded every client for an hour).  Progress
+    # resets the window, so long runs are bounded by per-completion
+    # gaps, not total wall time.
+    run_stall_timeout_s: float = 120.0
 
 
 @dataclasses.dataclass
@@ -228,6 +236,12 @@ class Request:
     # Such requests bypass prefix-KV reuse (reused rows have no
     # logits).
     want_prompt_logprobs: bool = False
+    # Per-request deadline, in seconds from submit/arrival (serving:
+    # arrival_time when set, else the engine's dequeue time).  Enforced
+    # ENGINE-side: an expired request is evicted mid-decode — slot and
+    # paged blocks freed, finish_reason='deadline', partial output
+    # returned — so a client that stopped caring never holds a lane.
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -237,7 +251,7 @@ class RequestResult:
     output_tokens: List[int]
     ttft_s: float                 # arrival/submit -> first token
     latency_s: float              # arrival/submit -> last token
-    finish_reason: str            # 'eos' | 'length' | 'error'
+    finish_reason: str            # 'eos' | 'length' | 'error' | 'deadline'
     error: Optional[str] = None
     error_class: Optional[str] = None   # 'client' | 'internal'
     # log P(token | context) for each generated token (always present
@@ -496,6 +510,26 @@ class InferenceEngine:
                 f'logprob_topk must be in [1, vocab_size='
                 f'{model_config.vocab_size}] (got '
                 f'{self.cfg.logprob_topk})')
+        if self.cfg.run_stall_timeout_s <= 0:
+            raise ValueError(f'run_stall_timeout_s must be > 0 '
+                             f'(got {self.cfg.run_stall_timeout_s})')
+        # Failure/recovery observability (stats()['faults'], /stats):
+        #   internal_errors      requests failed with error_class='internal'
+        #   deadline_evictions   requests evicted past Request.deadline_s
+        #   loop_restarts        serving-loop supervisor restarts
+        #   quarantined_batches  unattributed decode failures that failed
+        #                        the whole active batch (+ cache rebuild)
+        #   nonfinite_lanes      lanes killed by the non-finite logit guard
+        self.fault_stats = {'internal_errors': 0, 'deadline_evictions': 0,
+                            'loop_restarts': 0, 'quarantined_batches': 0,
+                            'nonfinite_lanes': 0}
+        # Deterministic fault injection (tests/chaos only): an armed
+        # faults.FaultPlan consulted at named sites via _fault()/
+        # _fault_raise().  None = unarmed = one attribute check per site.
+        self._faults = None
+        # Requests failed from INSIDE the dispatch path (non-finite
+        # guard) — drained by _harvest into the normal delivery path.
+        self._pending_failures: List[Tuple[Request, RequestResult]] = []
         # Speculation observability: dispatches that ran the verify path,
         # draft tokens offered, draft tokens accepted (acceptance rate =
         # accepted/offered; extra tok/dispatch = accepted/dispatches).
@@ -611,40 +645,19 @@ class InferenceEngine:
                     f'kv_block_size + 1 ({self._max_blocks + 1}): one '
                     'full-length request must fit the pool')
             self._num_blocks = n_blocks
-            self.cache = init_paged_cache(model_config, n_blocks, bs_,
-                                          self.cfg.cache_dtype)
             # Host-side allocator: refcounts per block (dump block 0 is
             # permanently held), a free list, and per-slot block tables
             # (+ allocated counts).  Shared prefix blocks simply carry
             # refcount > 1; freeing a slot decrefs every table entry.
             self._block_refs = np.zeros((n_blocks,), np.int32)
-            self._block_refs[0] = 1
-            self._free_blocks = list(range(n_blocks - 1, 0, -1))
             self._tables_np = np.zeros((b, self._max_blocks), np.int32)
             self._slot_nblocks = np.zeros((b,), np.int32)
             self.paged_stats = {'deferred': 0, 'prefix_block_hits': 0}
-        else:
-            self.cache = init_cache(model_config, b,
-                                    self.cfg.max_cache_len,
-                                    self.cfg.cache_dtype)
+        self._reset_cache()
         # Requests dequeued but not admissible yet (paged admission
         # control); always present so the serving loop can poll it
         # without caring about the layout.
         self._deferred: List[Request] = []
-        if mesh is not None:
-            # Cache [B, Hkv, S, D] (paged: [N, Hkv, bs, D]): kv heads
-            # shard like the weights' 'kv_heads' logical axis (the
-            # per-shard K/V the sharded projections produce) — resolved
-            # through the same rules as every other sharding, not a
-            # hand-named mesh axis.  Both layouts carry kv-heads on
-            # dim 1, so one sharding covers them.
-            from skypilot_tpu.parallel import mesh as mesh_lib
-            cache_sharding = mesh_lib.named_sharding(
-                mesh, None, 'kv_heads', None, None)
-            self.cache = [
-                (jax.device_put(k, cache_sharding),
-                 jax.device_put(v, cache_sharding)) for k, v in self.cache
-            ]
         self._slots: List[Optional[_Slot]] = [None] * b
         # Request ids cancelled while still PENDING (not yet slotted):
         # generate_stream drops them at dequeue/prefill time.  In-slot
@@ -693,6 +706,79 @@ class InferenceEngine:
         # Every dispatch's token ids ride the bitcast-packed transfer:
         # verify it is bit-exact on this backend before serving anything.
         _check_bitcast_roundtrip(self.cfg.logprob_topk)
+
+    def _reset_cache(self):
+        """(Re)create the device KV cache and, when paged, reset the
+        host-side allocator to empty.  Used at construction and by the
+        quarantine path after an UNATTRIBUTED dispatch failure: a jitted
+        call that fails after buffer donation leaves self.cache pointing
+        at deleted buffers, so without a rebuild every later dispatch —
+        including fresh prefills — would fail too and the engine would
+        be bricked per-process instead of degraded per-request.
+
+        Caller must hold no live slots (the quarantine path fails them
+        all first).  Paged prefixes live in the pool, so a paged rebuild
+        drops them (re-registration re-prefills); dense prefixes are
+        separate buffers and survive.
+        """
+        if self._paged:
+            self.cache = init_paged_cache(self.model_config,
+                                          self._num_blocks,
+                                          self.cfg.kv_block_size,
+                                          self.cfg.cache_dtype)
+            self._block_refs[:] = 0
+            self._block_refs[0] = 1
+            self._free_blocks = list(range(self._num_blocks - 1, 0, -1))
+            self._tables_np[:] = 0
+            self._slot_nblocks[:] = 0
+            self._prefixes.clear()
+        else:
+            self.cache = init_cache(self.model_config, self.cfg.num_slots,
+                                    self.cfg.max_cache_len,
+                                    self.cfg.cache_dtype)
+        if self._mesh is not None:
+            # Cache [B, Hkv, S, D] (paged: [N, Hkv, bs, D]): kv heads
+            # shard like the weights' 'kv_heads' logical axis (the
+            # per-shard K/V the sharded projections produce) — resolved
+            # through the same rules as every other sharding, not a
+            # hand-named mesh axis.  Both layouts carry kv-heads on
+            # dim 1, so one sharding covers them.
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            cache_sharding = mesh_lib.named_sharding(
+                self._mesh, None, 'kv_heads', None, None)
+            self.cache = [
+                (jax.device_put(k, cache_sharding),
+                 jax.device_put(v, cache_sharding)) for k, v in self.cache
+            ]
+
+    # ------------------------------------------------------ fault plans
+
+    def arm_faults(self, plan):
+        """Arm a faults.FaultPlan: the engine consults it at named sites
+        (see faults.SITES).  Tests/chaos tooling only."""
+        self._faults = plan
+
+    def disarm_faults(self):
+        self._faults = None
+
+    def _fault(self, site: str):
+        """One consult of a named injection site.  The unarmed path is
+        a single attribute check — zero overhead in production."""
+        if self._faults is None:
+            return None
+        return self._faults.check(site)
+
+    def _fault_raise(self, site: str):
+        """Consult and raise InjectedFault if the plan fires.  Called
+        HOST-SIDE before dispatches: a post-donation device failure
+        would invalidate the cache, which is the unattributed-
+        quarantine case, not the per-slot one (faults.py docstring)."""
+        sp = self._fault(site)
+        if sp is not None:
+            from skypilot_tpu.infer.faults import InjectedFault
+            raise InjectedFault(
+                f'{sp.message} [site={site}]', site,
+                slots=None if sp.slot is None else [sp.slot])
 
     # ---------------------------------------------------------- sharding
 
@@ -1252,6 +1338,11 @@ class InferenceEngine:
         mid-flight."""
         if not self._paged:
             return True
+        if self._fault('block_alloc') is not None:
+            # Injected pool pressure: answer "no" so the request takes
+            # the normal defer path — exhaustion must degrade to
+            # queueing, never to a crash.
+            return False
         return (len(self._free_blocks) - self._blocks_outstanding()
                 - extra >= demand)
 
@@ -1295,6 +1386,7 @@ class InferenceEngine:
                 'kv_layout': 'dense',
                 'kv_bytes_total': total * row_bytes,
                 'kv_bytes_resident': total * row_bytes,
+                'faults': dict(self.fault_stats),
             }
         bs_ = self.cfg.kv_block_size
         block_bytes = bs_ * row_bytes
@@ -1320,6 +1412,7 @@ class InferenceEngine:
             'kv_bytes_resident': int((usable - free) * block_bytes),
             'admission_deferred': self.paged_stats['deferred'],
             'prefix_block_hits': self.paged_stats['prefix_block_hits'],
+            'faults': dict(self.fault_stats),
         }
 
     # ---------------------------------------------------------- schedule
@@ -1374,6 +1467,9 @@ class InferenceEngine:
             raise ValueError(
                 f'max_new_tokens must be >= 1 (got {max_new}); generation '
                 'always produces at least the prefill token')
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            raise ValueError(
+                f'deadline_s must be > 0 (got {req.deadline_s})')
         try:
             bucket: Optional[int] = self._bucket(n)
         except ValueError:
@@ -1804,6 +1900,7 @@ class InferenceEngine:
         duplicate the last real row — rewriting the same slot with the
         same KV rows is idempotent, so no validity masking is needed.
         """
+        self._fault_raise('prefill')
         self._prefill_epoch += 1
         if self._prefixes:
             groups: Dict[Any, list] = {}
@@ -1962,6 +2059,7 @@ class InferenceEngine:
         """
         if not self._chunking:
             return False
+        self._fault_raise('chunk_round')
         c = self.cfg.prefill_chunk
         m = self.cfg.max_cache_len
         if self._ahead is not None:
@@ -2054,8 +2152,10 @@ class InferenceEngine:
                 except Exception:  # noqa: BLE001
                     pass
 
-    def _finish_slot(self, i: int,
-                     reason: str) -> Tuple[Request, RequestResult]:
+    def _finish_slot(self, i: int, reason: str,
+                     error: Optional[str] = None,
+                     error_class: Optional[str] = None,
+                     ) -> Tuple[Request, RequestResult]:
         s = self._slots[i]
         assert s is not None
         if s.request.stream_cb is not None and \
@@ -2072,6 +2172,8 @@ class InferenceEngine:
             ttft_s=(s.first_token_time or now) - s.submit_time,
             latency_s=now - s.submit_time,
             finish_reason=reason,
+            error=error,
+            error_class=error_class,
             logprobs=list(s.lps),
             top_logprobs=list(s.tops),
             prompt_logprobs=(list(s.prompt_lps)
@@ -2088,6 +2190,86 @@ class InferenceEngine:
         if req.request_id is not None:
             self._cancelled.pop(req.request_id, None)   # stale mark
         return req, res
+
+    # ----------------------------------------------------- containment
+
+    def _fail_slot(self, i: int,
+                   error: str) -> Tuple[Request, RequestResult]:
+        """Fail ONE active slot's request with error_class='internal':
+        slot + paged blocks freed (_finish_slot owns that discipline),
+        partial output returned, already-streamed tokens untouched."""
+        self.fault_stats['internal_errors'] += 1
+        return self._finish_slot(i, 'error', error=error,
+                                 error_class='internal')
+
+    def _fail_chunk_job(self, slot: int, reason: str,
+                        error: Optional[str] = None,
+                        ) -> Tuple[Request, RequestResult]:
+        """Terminate a part-prefilled chunk job (reason 'error' or
+        'deadline'): release the reserved slot and every block its
+        chunks already wrote."""
+        job = self._chunking.pop(slot)
+        self._lengths[slot] = 0
+        self._temps[slot] = 0.0
+        self._slot_adapters[slot] = -1
+        if self._paged:
+            self._free_slot_blocks(slot)
+        if error is not None:
+            self.fault_stats['internal_errors'] += 1
+        if job.req.request_id is not None:
+            self._cancelled.pop(job.req.request_id, None)
+        now = time.time()
+        res = RequestResult(
+            request_id=job.req.request_id,
+            prompt_tokens=list(job.req.tokens),
+            output_tokens=[],
+            ttft_s=now - job.submit_time,
+            latency_s=now - job.submit_time,
+            finish_reason=reason,
+            error=error,
+            error_class='internal' if error is not None else None)
+        return job.req, res
+
+    def _contain_failure(self, exc: BaseException,
+                         phase: str) -> List[Tuple[Request,
+                                                   RequestResult]]:
+        """Step-level containment for a decode-phase dispatch failure
+        (runs under the engine lock).  Mirrors the prefill containment
+        the serve loop has always had, so an exception in _chunk_round/
+        _step degrades per-request instead of killing the loop thread.
+
+        Attribution: an InjectedFault names the slot(s) it injured —
+        only those requests fail.  Anything unattributed (a REAL device
+        error) cannot be bisected post-hoc: decode is one batched
+        dispatch with donated cache buffers, so by the time the host
+        sees the exception the previous cache may already be invalid.
+        The whole active batch is quarantined (failed with
+        error_class='internal') and the cache rebuilt (_reset_cache),
+        leaving the engine clean for the queue that is still waiting.
+        """
+        msg = f'{phase} failed: {exc!r}'
+        slots_hint = getattr(exc, 'slots', None)
+        failed: List[Tuple[Request, RequestResult]] = []
+        # The in-flight lookahead window (if any) was dispatched against
+        # pre-failure state; drop it rather than consume it.
+        self._ahead = None
+        if slots_hint:
+            for i in slots_hint:
+                if 0 <= i < self.cfg.num_slots \
+                        and self._slots[i] is not None:
+                    failed.append(self._fail_slot(i, msg))
+                elif i in self._chunking:
+                    failed.append(
+                        self._fail_chunk_job(i, 'error', error=msg))
+            return failed
+        self.fault_stats['quarantined_batches'] += 1
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                failed.append(self._fail_slot(i, msg))
+        for slot in list(self._chunking):
+            failed.append(self._fail_chunk_job(slot, 'error', error=msg))
+        self._reset_cache()
+        return failed
 
     def _select_window(self) -> int:
         """Decode-window policy (adaptive_decode_window): QUEUE-aware,
@@ -2257,6 +2439,18 @@ class InferenceEngine:
         # ONE device->host transfer for the whole window (pack_head).
         toks_np, lps_np, gtoks_np, glps_np = _unpack_head(
             np.asarray(packed), self.cfg.logprob_topk)       # [K, B...]
+        sp = self._fault('nonfinite_logits')
+        if sp is not None:
+            # Poison one lane's logprobs AFTER the transfer: exercises
+            # the guard below exactly the way a real NaN blowup in a
+            # lane's logits would surface host-side.
+            lane = sp.slot
+            if lane is None:
+                lane = next((i for i, s in enumerate(self._slots)
+                             if s is not None), 0)
+            lps_np = np.array(lps_np)        # the unpack view is read-only
+            lps_np[:, lane] = np.nan
+        bad: List[int] = []
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -2273,13 +2467,27 @@ class InferenceEngine:
                     break
                 if s.length + 1 >= self.cfg.max_cache_len:
                     break
+                if not np.isfinite(lps_np[k, i]):
+                    # Non-finite logprob: THIS lane's logits blew up
+                    # (overflow/degenerate adapter/bad weights slice).
+                    # Its remaining window tokens are garbage; kill the
+                    # lane, not the batch — other lanes' columns are
+                    # independent.  Stop before counting the row so the
+                    # cache write stays a dead row.
+                    bad.append(i)
+                    break
                 s.length += 1        # the token we just fed is now cached
                 tok = int(toks_np[k, i])
                 s.generated.append(tok)
                 s.lps.append(float(lps_np[k, i]))
                 s.tops.append(_pairs(gtoks_np[k, i], glps_np[k, i]))
             self._lengths[i] = s.length
-            self._last_tokens[i] = s.generated[-1]
+            if s.generated:
+                self._last_tokens[i] = s.generated[-1]
+        for i in bad:
+            self.fault_stats['nonfinite_lanes'] += 1
+            self._pending_failures.append(self._fail_slot(
+                i, 'non-finite logits in decode window (lane killed)'))
 
     def _spec_step(self) -> None:
         """One speculative-decode dispatch: draft with prompt-lookup,
@@ -2359,6 +2567,7 @@ class InferenceEngine:
             np.asarray(packed), self.cfg.logprob_topk)       # [B, K...]
         self.spec_stats['dispatches'] += 1
         accepted_before = self.spec_stats['accepted']
+        bad: List[int] = []
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -2370,6 +2579,11 @@ class InferenceEngine:
                         s.generated[-1] == self.cfg.eos_id):
                     break
                 if s.length + 1 >= cache_len:
+                    break
+                if not np.isfinite(preds_lp_np[i, t]):
+                    # Same per-lane guard as _consume_window: a blown-up
+                    # lane dies alone.
+                    bad.append(i)
                     break
                 if t > 0:
                     # Position t fed draft tokens[i, t]; it only counts
@@ -2385,6 +2599,10 @@ class InferenceEngine:
                 s.tops.append(_pairs(g_toks_np[i, t], g_lps_np[i, t]))
             self._lengths[i] = s.length
             self._last_tokens[i] = s.generated[-1]
+        for i in bad:
+            self.fault_stats['nonfinite_lanes'] += 1
+            self._pending_failures.append(self._fail_slot(
+                i, 'non-finite logits in spec verify (lane killed)'))
         dispatch_drafted = int(drafted.sum())
         dispatch_accepted = (self.spec_stats['accepted'] -
                              accepted_before)
@@ -2442,6 +2660,7 @@ class InferenceEngine:
     def _step(self) -> None:
         """One decode dispatch: speculative verify when drafting is
         enabled, else the windowed (lax.scan) decode."""
+        self._fault_raise('decode_step')
         if self.cfg.draft_len > 0:
             self._spec_step()
         else:
@@ -2449,16 +2668,37 @@ class InferenceEngine:
 
     def _harvest(self) -> List[Tuple[Request, RequestResult]]:
         done = []
+        if self._pending_failures:
+            # Lanes killed inside the dispatch path (non-finite guard):
+            # deliver through the same channel as every other finish.
+            done.extend(self._pending_failures)
+            self._pending_failures = []
+        now = time.time()
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
-            if self.cfg.eos_id is not None and \
+            dl = s.request.deadline_s
+            if dl is not None and now - s.submit_time >= dl:
+                # Deadline eviction: the client stopped caring; partial
+                # output ships, the slot and its paged blocks free NOW
+                # instead of at max_new.  Checked before eos/length so
+                # an expired request never counts as a clean finish.
+                self.fault_stats['deadline_evictions'] += 1
+                done.append(self._finish_slot(i, 'deadline'))
+            elif self.cfg.eos_id is not None and \
                     s.generated[-1] == self.cfg.eos_id:
                 done.append(self._finish_slot(i, 'eos'))
             elif len(s.generated) >= s.max_new:
                 done.append(self._finish_slot(i, 'length'))
             elif s.length + 1 >= self.cfg.max_cache_len:
                 done.append(self._finish_slot(i, 'length'))
+        for slot, job in list(self._chunking.items()):
+            dl = job.req.deadline_s
+            if dl is not None and now - job.submit_time >= dl:
+                # A part-prefilled prompt past its deadline: stop paying
+                # chunk dispatches for a result nobody will read.
+                self.fault_stats['deadline_evictions'] += 1
+                done.append(self._fail_chunk_job(slot, 'deadline'))
         return done
 
     # -------------------------------------------------------------- API
@@ -2527,28 +2767,79 @@ class InferenceEngine:
                     # Offline, only prompts no bucket can hold chunk
                     # (_should_chunk): one chunk per loop iteration,
                     # interleaved with the decode windows below.
-                    self._chunk_round()
+                    try:
+                        self._chunk_round()
+                    except Exception as e:  # pylint: disable=broad-except
+                        finished.extend(
+                            self._contain_failure(e, 'chunk round'))
                 # Harvest between prefill and decode: the prefill already
                 # produced one token, which may satisfy max_new_tokens=1
                 # or be the EOS.
                 finished.extend(self._harvest())
                 if not any(s is not None for s in self._slots):
                     continue
-                self._step()
+                try:
+                    self._step()
+                except Exception as e:  # pylint: disable=broad-except
+                    # Same containment as the serving loop: a decode
+                    # failure costs the affected (or, unattributed, the
+                    # active) requests — the rest of the batch and the
+                    # still-pending list keep going.
+                    finished.extend(
+                        self._contain_failure(e, 'decode step'))
                 finished.extend(self._harvest())
             order = {id(r): i for i, r in enumerate(requests)}
             finished.sort(key=lambda pair: order.get(id(pair[0]), 0))
             return [res for _, res in finished]
 
+    # Consecutive fast deaths (loop up < 1 s) before the supervisor
+    # gives up: a crash loop that never makes progress must surface to
+    # the caller instead of spinning and silently eating the queue.
+    _MAX_LOOP_RESTARTS = 3
+
     def generate_stream(self, request_queue: 'queue.Queue[Request]',
                         result_cb, stop_event: threading.Event,
                         idle_sleep: float = 0.005) -> None:
         """Server loop: pull requests from a queue, run continuous
-        batching forever, deliver RequestResults via result_cb."""
+        batching forever, deliver RequestResults via result_cb.
+
+        Supervised: the loop thread is the whole data plane, so an
+        exception that escapes _serve_loop's contained regions must not
+        strand its clients.  The supervisor (1) fails every in-flight
+        request NOW with error_class='internal' — clients hear within
+        one loop pass, not when their own timeouts trip; (2) restarts
+        the loop with the queue intact, so requests behind the failure
+        still serve; (3) gives up after _MAX_LOOP_RESTARTS consecutive
+        sub-second deaths (a crash loop making no progress), failing
+        the queued requests too and re-raising to the caller.
+        """
+        consecutive = 0
         try:
             self._serving = True
-            self._serve_loop(request_queue, result_cb, stop_event,
-                             idle_sleep)
+            while True:
+                t_up = time.time()
+                try:
+                    self._serve_loop(request_queue, result_cb,
+                                     stop_event, idle_sleep)
+                    return
+                except Exception as e:  # pylint: disable=broad-except
+                    self.fault_stats['loop_restarts'] += 1
+                    with self._lock:
+                        self._ahead = None
+                        for _, res in self._fail_all_inflight(
+                                f'serving loop died: {e!r}'):
+                            try:
+                                result_cb(res)
+                            except Exception:  # noqa: BLE001
+                                pass
+                    if stop_event.is_set():
+                        return
+                    consecutive = (consecutive + 1
+                                   if time.time() - t_up < 1.0 else 1)
+                    if consecutive > self._MAX_LOOP_RESTARTS:
+                        self._drain_queue_failing(request_queue,
+                                                  result_cb, e)
+                        raise
         finally:
             # A loop stopped with a non-empty queue must not leave a
             # stale positive hint that would force short windows on
@@ -2559,9 +2850,64 @@ class InferenceEngine:
             self._ahead = None
             self._arrivals_hint = 0
 
+    def _fail_all_inflight(self, msg: str) -> List[Tuple[Request,
+                                                         RequestResult]]:
+        """Fail every active slot and chunk job with
+        error_class='internal' (caller holds the lock).  Used by the
+        supervisor: by the time the loop thread is dead, nothing will
+        ever advance these requests again."""
+        failed: List[Tuple[Request, RequestResult]] = []
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                failed.append(self._fail_slot(i, msg))
+        for slot in list(self._chunking):
+            failed.append(self._fail_chunk_job(slot, 'error', error=msg))
+        return failed
+
+    def _drain_queue_failing(self, request_queue, result_cb,
+                             exc: BaseException) -> None:
+        """Terminal supervisor path: the loop is crash-looping, so the
+        queued (and admission-deferred) requests will never serve —
+        fail them all now rather than leave their clients blocking on
+        timeouts."""
+        with self._lock:
+            pending = list(self._deferred)
+            self._deferred = []
+            while True:
+                try:
+                    pending.append(request_queue.get_nowait())
+                except queue.Empty:
+                    break
+            for req in pending:
+                self.fault_stats['internal_errors'] += 1
+                try:
+                    result_cb(RequestResult(
+                        request_id=req.request_id,
+                        prompt_tokens=list(req.tokens),
+                        output_tokens=[], ttft_s=0.0, latency_s=0.0,
+                        finish_reason='error',
+                        error=f'serving loop dead: {exc!r}',
+                        error_class='internal'))
+                except Exception:  # noqa: BLE001
+                    pass
+
     def _serve_loop(self, request_queue, result_cb, stop_event,
                     idle_sleep) -> None:
         while not stop_event.is_set():
+            if self._faults is not None:
+                # Injection sites for the chaos tests (guarded so the
+                # unarmed loop pays one attribute check per pass).
+                sp = self._fault('stall')
+                if sp is not None:
+                    time.sleep(sp.stall_s)   # wedged host thread
+                if self._chunking or any(s is not None
+                                         for s in self._slots):
+                    # Loop death OUTSIDE every contained region — the
+                    # supervisor's case.  Consulted only on passes with
+                    # work in flight so a plan's "hit 1" is
+                    # deterministic w.r.t. request state instead of
+                    # racing the idle spin.
+                    self._fault_raise('serve_loop')
             moved = False
             to_start = []
             admit_extra = 0
@@ -2623,6 +2969,23 @@ class InferenceEngine:
                     cancelled_deq += 1
                     continue
                 dequeued += 1
+                now = time.time()
+                if (req.deadline_s is not None and
+                        req.arrival_time is not None and
+                        now - req.arrival_time >= req.deadline_s):
+                    # Expired while queued: never spend a prefill on it.
+                    # (Without arrival_time the deadline clock starts
+                    # at the submit_time below; _harvest enforces it.)
+                    self.fault_stats['deadline_evictions'] += 1
+                    with self._lock:
+                        result_cb(RequestResult(
+                            request_id=req.request_id,
+                            prompt_tokens=list(req.tokens),
+                            output_tokens=[], ttft_s=0.0,
+                            latency_s=now - req.arrival_time,
+                            finish_reason='deadline'))
+                    moved = True
+                    continue
                 try:
                     to_start.append((req, slot,
                                      req.arrival_time or time.time(),
@@ -2700,6 +3063,7 @@ class InferenceEngine:
                                 # allocated for this slot would leak.
                                 self._free_slot_blocks(slot)
                         for req, slot, *_ in to_start:
+                            self.fault_stats['internal_errors'] += 1
                             result_cb(RequestResult(
                                 request_id=req.request_id,
                                 prompt_tokens=list(req.tokens),
@@ -2713,7 +3077,16 @@ class InferenceEngine:
                     # stall any active slot sees from a long-prompt
                     # arrival is bounded by chunk_ms + window_ms
                     # instead of the full prefill duration.
-                    moved = self._chunk_round() or moved
+                    # Contained like prefill: a chunk-dispatch failure
+                    # costs the attributed (or all chunking/active)
+                    # requests, never the loop.
+                    try:
+                        moved = self._chunk_round() or moved
+                    except Exception as e:  # pylint: disable=broad-except
+                        for _, res in self._contain_failure(
+                                e, 'chunk round'):
+                            result_cb(res)
+                        moved = True
                 self._flush_streams()            # prefill first tokens
                 for _, res in self._harvest():   # prefill-only finishes
                     result_cb(res)
@@ -2725,7 +3098,16 @@ class InferenceEngine:
                     # cancel-only streak decays the hint (see above).
                     self._arrivals_hint = (
                         request_queue.qsize() >> self._cancel_only_streak)
-                    self._step()
+                    # The decode phase gets the same step-level
+                    # containment prefill has always had: fail the
+                    # injured requests, quarantine what can't be
+                    # attributed, keep serving (_contain_failure).
+                    try:
+                        self._step()
+                    except Exception as e:  # pylint: disable=broad-except
+                        for _, res in self._contain_failure(
+                                e, 'decode step'):
+                            result_cb(res)
                     self._flush_streams()
                     for _, res in self._harvest():
                         result_cb(res)
@@ -2818,13 +3200,28 @@ class InferenceEngine:
             time.sleep(float(gap))
             req.arrival_time = time.time()
             q.put(req)
-        finished = done.wait(timeout=3600)
+        # Progress-aware stall detection (replaces a hard-coded 3600 s
+        # wait): a dead or wedged serving loop is declared after ONE
+        # completion-free run_stall_timeout_s window, while a healthy
+        # long run just keeps resetting the window with every finish.
+        stall_s = self.cfg.run_stall_timeout_s
+        last_done = 0
+        while not done.wait(timeout=stall_s):
+            if len(results) == last_done:
+                stop.set()
+                loop.join(timeout=30)
+                raise RuntimeError(
+                    f'serving stalled: {len(results)}/{num_requests} '
+                    f'requests finished, none in the last '
+                    f'{stall_s:.0f}s (InferConfig.run_stall_timeout_s);'
+                    f' engine stats: {self.stats()}')
+            last_done = len(results)
         stop.set()
         loop.join(timeout=30)
         elapsed = time.time() - t0
-        if not results or not finished:
-            # A stalled/crashed serving loop must fail loudly, not hang
-            # into an IndexError or report partial metrics as complete.
+        if not results:
+            # Unreachable once done fired, but keep the loud failure
+            # over an IndexError below if the accounting ever breaks.
             raise RuntimeError(
                 f'serving benchmark incomplete: {len(results)}/'
                 f'{num_requests} requests finished in {elapsed:.0f}s')

@@ -1,0 +1,191 @@
+"""Deterministic fault injection for the serving engine.
+
+The reference system's value proposition is surviving failure (spot
+preemption recovery, failover, replica health), and the serving data
+plane must hold the same bar: degrade per-request, never per-process.
+Proving that requires *reproducible* failures — a chaos test that only
+fails once a week is worse than no test.  This module gives the engine
+named injection sites it consults through one attribute check:
+
+    plan = FaultPlan(seed=7, specs=[
+        FaultSpec(site='decode_step', hits=(2,), slot=1),
+    ])
+    engine.arm_faults(plan)
+
+Design rules:
+
+- **Zero overhead unarmed.**  Every site costs exactly one
+  ``self._faults is None`` check when no plan is armed; no RNG draw,
+  no counter, no lock.
+- **Fully reproducible armed.**  Firing is a pure function of the
+  plan's seed and the per-site consult sequence.  ``hits`` fires on
+  exact 1-based consult indices; ``prob`` draws one Bernoulli per
+  consult from a per-spec ``numpy`` Generator seeded from
+  ``(seed, spec index)`` — so two runs with the same plan and the same
+  request stream fire identically, and specs never perturb each
+  other's streams.
+- **Attribution is part of the fault.**  A raised :class:`InjectedFault`
+  can carry the slot(s) it claims to have injured; the engine's
+  containment path uses that to fail only those requests.  Faults
+  without attribution exercise the quarantine-the-batch fallback.
+
+Sites (where the engine consults the plan):
+
+==================  =====================================================
+``prefill``         top of ``_start_batch``, before the prefill dispatch
+``decode_step``     top of ``_step``, before the decode-window dispatch
+``chunk_round``     top of ``_chunk_round`` when chunk jobs exist
+``block_alloc``     inside ``_can_admit_blocks`` — a firing spec forces
+                    the admission answer to "no" (defer), modelling a
+                    transiently exhausted pool rather than a crash
+``nonfinite_logits``  after the decode window's host unpack — a firing
+                    spec overwrites one lane's logprobs with NaN to
+                    exercise the non-finite guard
+``stall``           top of each serving-loop iteration — a firing spec
+                    sleeps ``stall_s`` to exercise stall detection
+``serve_loop``      top of serving-loop iterations that have active
+                    slots or chunk jobs — a firing spec raises OUTSIDE
+                    every contained region, killing the loop thread to
+                    exercise the supervisor (conditioning on active
+                    work makes "hit 1" deterministic with respect to
+                    request state instead of racing the idle spin)
+==================  =====================================================
+
+Injected dispatch faults are raised HOST-SIDE, before the jitted call:
+a jitted call that fails after buffer donation can invalidate the KV
+cache, which would break the survivors-byte-identical guarantee the
+chaos tests assert.  (A *real* post-donation device failure is exactly
+the unattributed case: the engine quarantines the batch and rebuilds
+the cache.)
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+SITES = (
+    'prefill',
+    'decode_step',
+    'chunk_round',
+    'block_alloc',
+    'nonfinite_logits',
+    'stall',
+    'serve_loop',
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the engine at a fault site the armed plan fired on.
+
+    ``slots`` is the injected attribution: the engine slot indices the
+    fault claims to have injured (None = unattributed, which makes the
+    containment path quarantine every active slot).
+    """
+
+    def __init__(self, message: str, site: str,
+                 slots: Optional[Sequence[int]] = None):
+        super().__init__(message)
+        self.site = site
+        self.slots = None if slots is None else [int(s) for s in slots]
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One deterministic failure rule at a named site.
+
+    site       one of :data:`SITES`.
+    hits       1-based consult indices of the site at which this spec
+               fires (e.g. ``(2,)`` = the second time the engine
+               consults the site).  Exact and reproducible.
+    prob       when ``hits`` is None: per-consult Bernoulli firing
+               probability, drawn from the spec's own seeded stream.
+    max_fires  stop firing after this many fires (None = unlimited;
+               ``hits`` specs are naturally bounded by ``len(hits)``).
+    slot       attribution: the engine slot this fault claims to have
+               injured (None = unattributed → batch quarantine).
+    stall_s    for the ``stall`` site: how long the loop sleeps.
+    message    human-readable tag carried into the raised error.
+    """
+
+    site: str
+    hits: Optional[Tuple[int, ...]] = None
+    prob: float = 0.0
+    max_fires: Optional[int] = None
+    slot: Optional[int] = None
+    stall_s: float = 0.0
+    message: str = 'injected fault'
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f'unknown fault site {self.site!r}; valid sites: {SITES}')
+        if self.hits is not None:
+            self.hits = tuple(int(h) for h in self.hits)
+            if any(h < 1 for h in self.hits):
+                raise ValueError('hits are 1-based consult indices (>= 1)')
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f'prob must be in [0, 1] (got {self.prob})')
+        if self.hits is None and self.prob == 0.0:
+            raise ValueError('spec can never fire: give hits or prob > 0')
+
+
+class FaultPlan:
+    """A seeded set of :class:`FaultSpec` rules the engine consults.
+
+    Thread-safe: the serving loop and ``benchmark_serving``'s feeder
+    consult concurrently.  ``consults``/``fired`` expose per-site
+    counters for tests and the chaos smoke's accounting.
+    """
+
+    def __init__(self, seed: int = 0,
+                 specs: Sequence[FaultSpec] = ()):
+        self.seed = int(seed)
+        self.specs: List[FaultSpec] = list(specs)
+        self._lock = threading.Lock()
+        self.consults: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}
+        # Per-spec state: independent RNG stream (so spec ordering and
+        # other sites' consult volume never shift a spec's draws) and
+        # a fire counter for max_fires.
+        self._rngs = [np.random.default_rng((self.seed, i))
+                      for i in range(len(self.specs))]
+        self._fires = [0] * len(self.specs)
+        self._by_site: Dict[str, List[int]] = {}
+        for i, sp in enumerate(self.specs):
+            self._by_site.setdefault(sp.site, []).append(i)
+
+    def check(self, site: str) -> Optional[FaultSpec]:
+        """One consult of ``site``; returns the firing spec, else None.
+
+        Every consult advances the site's counter and (for prob specs
+        at this site) their RNG streams, whether or not anything fires
+        — firing is a pure function of the consult sequence.
+        """
+        with self._lock:
+            n = self.consults.get(site, 0) + 1
+            self.consults[site] = n
+            hit: Optional[FaultSpec] = None
+            for i in self._by_site.get(site, ()):
+                sp = self.specs[i]
+                if sp.hits is not None:
+                    fires = n in sp.hits
+                else:
+                    # Always draw: keeps the stream aligned to the
+                    # consult index even when max_fires already tripped.
+                    fires = float(self._rngs[i].random()) < sp.prob
+                if (sp.max_fires is not None
+                        and self._fires[i] >= sp.max_fires):
+                    fires = False
+                if fires and hit is None:
+                    self._fires[i] += 1
+                    self.fired[site] = self.fired.get(site, 0) + 1
+                    hit = sp
+            return hit
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {'consults': dict(self.consults),
+                    'fired': dict(self.fired)}

@@ -329,6 +329,17 @@ class InferenceServer:
             # fabricated 0.0 TTFT would suppress shedding exactly when
             # cancels spike (overloaded clients giving up).
             self._drop_admitted(rid)
+        if res is None:
+            # Timed out: cancel INTO the engine so the slot and its
+            # paged blocks free NOW — without this the abandoned
+            # request keeps decoding to max_new_tokens for nobody
+            # (submit_stream's finally has always done this; the
+            # blocking path leaked).  A finish racing this cancel
+            # delivers into _results with no event registered and is
+            # dropped there; the stale pending mark then expires
+            # (engine._CANCEL_MARK_TTL_S) or is cleared by the finish
+            # itself when it won before the mark landed.
+            self.engine.cancel(rid)
         return res
 
     def submit_stream(self, req: Request, timeout: float = 300.0,
@@ -520,7 +531,10 @@ def _make_handler(server: InferenceServer):
                               'output_tokens': streamed,
                               'ttft_s': 0.0, 'latency_s': 0.0})
             except (BrokenPipeError, ConnectionResetError):
-                pass   # client went away mid-stream; engine finishes solo
+                # Client went away mid-stream: closing the generator
+                # runs submit_stream's finally, which cancels into the
+                # engine — the slot and its paged blocks free now.
+                pass
 
         def do_GET(self):
             if self.path in ('/health', '/'):
@@ -556,6 +570,10 @@ def _make_handler(server: InferenceServer):
                     # — blocks total/free/shared, bytes resident, prefix
                     # blocks held by refcount (engine.stats()).
                     'kv_cache': eng.stats(),
+                    # Failure/recovery counters (engine.fault_stats):
+                    # internal_errors, deadline_evictions, loop_restarts,
+                    # quarantined_batches, nonfinite_lanes.
+                    'faults': dict(eng.fault_stats),
                 })
             else:
                 self._json(404, {'error': 'not found'})
@@ -606,9 +624,20 @@ def _make_handler(server: InferenceServer):
                 echo = bool(payload.get('echo'))
                 n_raw = payload.get('n')
                 n_choices = 1 if n_raw is None else int(n_raw)
+                # Extension field (no OpenAI equivalent): server-side
+                # deadline; the engine evicts past it
+                # (finish_reason='deadline').
+                deadline_raw = payload.get('deadline_s')
+                deadline_s = (None if deadline_raw is None
+                              else float(deadline_raw))
             except (TypeError, ValueError) as e:
                 self._json(400, {'error': {'message': f'bad field: {e}',
                                            'type': 'invalid_request_error'}})
+                return None
+            if deadline_s is not None and deadline_s <= 0:
+                self._json(400, {'error': {
+                    'message': 'deadline_s must be > 0',
+                    'type': 'invalid_request_error'}})
                 return None
             max_n = max(1, min(8, server.engine.cfg.num_slots))
             if not 1 <= n_choices <= max_n:
@@ -727,7 +756,8 @@ def _make_handler(server: InferenceServer):
                           temperature=temperature,
                           request_id=uuid.uuid4().hex,
                           adapter=adapter,
-                          want_prompt_logprobs=want_lp and echo)
+                          want_prompt_logprobs=want_lp and echo,
+                          deadline_s=deadline_s)
             return req, stop, opts
 
         @staticmethod
@@ -1146,15 +1176,21 @@ def _make_handler(server: InferenceServer):
                 max_new = payload.get('max_new_tokens')
                 max_new = None if max_new is None else int(max_new)
                 temperature = float(payload.get('temperature', 0.0))
+                deadline = payload.get('deadline_s')
+                deadline = None if deadline is None else float(deadline)
             except (TypeError, ValueError) as e:
                 self._json(400, {'error': f'bad field: {e}'})
+                return
+            if deadline is not None and deadline <= 0:
+                self._json(400, {'error': 'deadline_s must be > 0'})
                 return
             req = Request(tokens=tokens, max_new_tokens=max_new,
                           temperature=temperature,
                           request_id=uuid.uuid4().hex,
                           adapter=payload.get('adapter'),
                           want_prompt_logprobs=bool(
-                              payload.get('prompt_logprobs')))
+                              payload.get('prompt_logprobs')),
+                          deadline_s=deadline)
             if payload.get('stream'):
                 # Admit BEFORE the SSE 200 goes out: a shed must be a
                 # clean 429 the client (and LB) can act on.
