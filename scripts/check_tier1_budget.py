@@ -15,9 +15,17 @@ regression they are.  The checker reports:
   95% of the window WILL time out on a noisy runner);
 - the slowest-test table so the offender is named in the failure.
 
+- per-file rollups of the recorded duration rows, so a file that grew
+  across several tests is named even when no single test tops the
+  table;
+- `--require <file>`: tier-1 files that MUST appear in the log — a new
+  test file silently dropped from the window (collection error, bad
+  marker, renamed path) fails the guard instead of passing by absence.
+
 Usage:
     python scripts/check_tier1_budget.py /tmp/_t1.log \
-        [--budget 870] [--margin 0.10] [--top 15]
+        [--budget 870] [--margin 0.10] [--top 15] \
+        [--require tests/test_radix.py ...]
 
 Exit codes: 0 within budget, 1 over budget (or the run itself timed
 out, which a missing summary line implies), 2 unreadable log.
@@ -59,6 +67,11 @@ def main(argv=None) -> int:
                          'budget*(1-margin), not just past the cliff')
     ap.add_argument('--top', type=int, default=15,
                     help='slowest tests to print')
+    ap.add_argument('--require', action='append', default=[],
+                    metavar='FILE',
+                    help='test file that must show up in the log '
+                         '(repeatable); guards tier-1 files against '
+                         'silently dropping out of the window')
     args = ap.parse_args(argv)
     try:
         with open(args.log, encoding='utf-8', errors='replace') as f:
@@ -71,9 +84,22 @@ def main(argv=None) -> int:
         print(f'slowest {min(args.top, len(durations))} test phases:')
         for secs, phase, test in durations[:args.top]:
             print(f'  {secs:8.2f}s  {phase:<8}  {test}')
+        by_file = {}
+        for secs, _, test in durations:
+            by_file[test.split('::')[0]] = \
+                by_file.get(test.split('::')[0], 0.0) + secs
+        print('per-file totals over the recorded rows:')
+        for path, secs in sorted(by_file.items(), key=lambda kv: -kv[1]):
+            print(f'  {secs:8.2f}s  {path}')
     else:
         print('no --durations rows in the log (run pytest with '
               '--durations=15)')
+    missing = [req for req in args.require if req not in text]
+    if missing:
+        print('FAIL: required tier-1 file(s) absent from the log '
+              '(collection error, bad marker, or renamed path?): '
+              + ', '.join(missing))
+        return 1
     if wall is None:
         # No `in NNNs` summary: pytest never finished — the timeout
         # already fired.  That IS the over-budget condition.

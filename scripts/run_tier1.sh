@@ -16,7 +16,11 @@ timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
     -p no:randomly --durations=15 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
-python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" || rc=1
+# --require: every tier-1 test file must actually reach the window —
+# a file lost to a collection error or marker typo fails by name.
+python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
+    --require tests/test_paged_kv.py --require tests/test_faults.py \
+    --require tests/test_radix.py || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
 # accounting under randomized faults.  Outside the pytest window on
 # purpose — it must not eat durations budget from the suite.

@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer.radix import RadixTree
 from skypilot_tpu.models.llama import (Llama, LlamaConfig, init_cache,
                                        init_paged_cache)
 
@@ -193,6 +194,21 @@ class InferConfig:
     # leave headroom for registered prefixes (their blocks are pool-
     # resident too).  See the admission rule in the module docstring.
     kv_blocks: Optional[int] = None
+    # Automatic prefix caching (requires kv_block_size > 0): an
+    # engine-level radix tree keyed on kv_block_size-token runs indexes
+    # the pool blocks of completed (and chunk-boundary) prompts, and
+    # every admitted prompt reuses its longest block-aligned cached
+    # prefix copy-free (refcount bump + suffix-only prefill).  Nothing
+    # to register: the tree builds itself from traffic and sheds
+    # unreferenced leaves LRU-first under pool pressure, BEFORE
+    # admission control defers a request.  register_prefix becomes
+    # optional pinning (pinned nodes are eviction-exempt).  Greedy
+    # token streams are byte-identical with this on or off: only
+    # prefill-written full PROMPT blocks are ever indexed, and the
+    # suffix attends over the same quantized rows a full prefill would
+    # have written.  Parity: vLLM automatic-prefix-caching / SGLang
+    # RadixAttention at block granularity.
+    auto_prefix_cache: bool = False
     # Prefix KV caching: registered prefixes (system prompts) keep
     # their per-layer KV rows resident on device; a request whose
     # prompt starts with a registered prefix prefills ONLY its suffix —
@@ -495,6 +511,11 @@ class InferenceEngine:
                 raise ValueError(
                     f'prefill_chunk ({self.cfg.prefill_chunk}) must be '
                     f'a multiple of kv_block_size ({bs_})')
+        if self.cfg.auto_prefix_cache and not self._paged:
+            raise ValueError(
+                'auto_prefix_cache requires the block-paged KV cache '
+                '(set kv_block_size > 0): the radix tree shares pool '
+                'blocks by refcount')
         if self.cfg.draft_len < 0:
             raise ValueError(f'draft_len must be >= 0 '
                              f'(got {self.cfg.draft_len})')
@@ -653,6 +674,15 @@ class InferenceEngine:
             self._tables_np = np.zeros((b, self._max_blocks), np.int32)
             self._slot_nblocks = np.zeros((b,), np.int32)
             self.paged_stats = {'deferred': 0, 'prefix_block_hits': 0}
+        # Automatic radix-tree prefix caching over the pool (None when
+        # off).  Must exist before _reset_cache(), which drops the tree
+        # on every (re)build.  radix_stats always exists so stats()
+        # reports one shape regardless of layout/knobs.
+        self._radix = (RadixTree(self.cfg.kv_block_size)
+                       if self._paged and self.cfg.auto_prefix_cache
+                       else None)
+        self.radix_stats = {'hits': 0, 'tokens_reused': 0, 'lookups': 0,
+                            'inserts': 0, 'evictions': 0}
         self._reset_cache()
         # Requests dequeued but not admissible yet (paged admission
         # control); always present so the serving loop can poll it
@@ -732,6 +762,11 @@ class InferenceEngine:
             self._tables_np[:] = 0
             self._slot_nblocks[:] = 0
             self._prefixes.clear()
+            if self._radix is not None:
+                # The tree's block references die with the pool; the
+                # generation bump invalidates any match taken against
+                # the pre-reset tree (it rebuilds from traffic).
+                self._radix.clear()
         else:
             self.cache = init_cache(self.model_config, self.cfg.num_slots,
                                     self.cfg.max_cache_len,
@@ -1022,32 +1057,49 @@ class InferenceEngine:
             return pc
 
         def prefix_prefill(params, tokens, start, true_lens, prefix_kv,
-                           cache, slots, temps, rng, adapter_ids):
+                           rem_kv, cache, slots, temps, rng,
+                           adapter_ids):
             """Lane-batched suffix prefill over shared preloaded prefix
             KV: P matched prompts forward only their suffixes, sample
             first tokens, and insert all start+SB rows per slot — one
             dispatch (the prefix-reuse twin of prefill_insert).
 
-            tokens [P, SB] (suffixes); start (STATIC) = reused prefix
-            rows; prefix_kv: per-layer ([Hkv, start, D]) pairs shared
-            by every lane.  Compiles per (start, SB): starts come only
-            from registered prefix lengths (len or len-1), so the key
-            space stays small — matching is restricted to full-prefix
-            matches for exactly this reason.
+            tokens [P, SB] (suffixes); start is a DYNAMIC traced
+            scalar, so the compile key is SHAPES only — (B, SB) where
+            B = pow2_floor(start) — and distinct registered-prefix
+            lengths share executables (O(#buckets * #suffix_buckets)
+            compiles, not one per length).  prefix_kv: per-layer
+            ([Hkv, B, D]) pairs = prefix rows [0, B); rem_kv: same
+            shape, rows [0, start-B) holding prefix rows [B, start)
+            (rest zero).  The lane cache is the concat [B | B | SB]:
+            row index == position for every row a query can see —
+            remainder rows sit at indices B..start-1, and the zero rows
+            at [start, 2B) are all overwritten by the suffix's own
+            writes (positions start..start+SB-1) before attention, so
+            padding is never read.  Since start < 2B, the final rows
+            [0, start+SB) are written back to the slot in two
+            static-width updates: [0, B) and a (B+SB)-wide window at
+            dynamic offset start-B (the overlap [start-B, B) rewrites
+            identical prefix rows).
             """
             p, sb = tokens.shape
             positions = start + jnp.broadcast_to(
                 jnp.arange(sb)[None], tokens.shape)
+            b_ = prefix_kv[0][0].shape[1]
             pcache = []
-            for pk, pv in prefix_kv:
+            for (pk, pv), (rk, rv) in zip(prefix_kv, rem_kv):
                 hkv, _, hd = pk.shape
                 pad = jnp.zeros((p, hkv, sb, hd), cache_dtype)
-                pk_b = jnp.broadcast_to(pk[None].astype(cache_dtype),
-                                        (p,) + pk.shape)
-                pv_b = jnp.broadcast_to(pv[None].astype(cache_dtype),
-                                        (p,) + pv.shape)
-                pcache.append((jnp.concatenate([pk_b, pad], axis=2),
-                               jnp.concatenate([pv_b, pad], axis=2)))
+
+                def bcast(x, p=p):
+                    return jnp.broadcast_to(
+                        x[None].astype(cache_dtype), (p,) + x.shape)
+
+                pcache.append(
+                    (jnp.concatenate([bcast(pk), bcast(rk), pad],
+                                     axis=2),
+                     jnp.concatenate([bcast(pv), bcast(rv), pad],
+                                     axis=2)))
             logits, pc = model.apply(params, tokens, positions, pcache,
                                      **akw(adapter_ids))
             last = jnp.take_along_axis(
@@ -1061,16 +1113,23 @@ class InferenceEngine:
             first_top = topk_lp(last)                    # [P, k] x2
             new_cache = []
             for (k, v), (pk2, pv2) in zip(cache, pc):
+                hkv, _, hd = pk2.shape[1:]
 
-                def write(i, kv, pk2=pk2, pv2=pv2):
+                def write(i, kv, pk2=pk2, pv2=pv2, hkv=hkv, hd=hd):
                     kk, vv = kv
-                    sk = jax.lax.dynamic_slice_in_dim(pk2, i, 1, 0)
-                    sv = jax.lax.dynamic_slice_in_dim(pv2, i, 1, 0)
-                    at = (slots[i], 0, 0, 0)
-                    return (jax.lax.dynamic_update_slice(
-                                kk, sk.astype(kk.dtype), at),
-                            jax.lax.dynamic_update_slice(
-                                vv, sv.astype(vv.dtype), at))
+
+                    def upd(dst, lane, ofs, width):
+                        sl = jax.lax.dynamic_slice(
+                            lane, (i, 0, ofs, 0), (1, hkv, width, hd))
+                        return jax.lax.dynamic_update_slice(
+                            dst, sl.astype(dst.dtype),
+                            (slots[i], 0, ofs, 0))
+
+                    kk = upd(kk, pk2, 0, b_)
+                    vv = upd(vv, pv2, 0, b_)
+                    kk = upd(kk, pk2, start - b_, b_ + sb)
+                    vv = upd(vv, pv2, start - b_, b_ + sb)
+                    return kk, vv
 
                 kk, vv = jax.lax.fori_loop(0, p, write, (k, v))
                 new_cache.append((kk, vv))
@@ -1240,8 +1299,10 @@ class InferenceEngine:
                                static_argnums=(7,))
         self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
         self._prefill_capture = jax.jit(prefill_capture)
-        self._prefix_prefill = jax.jit(prefix_prefill, static_argnums=(2,),
-                                       donate_argnums=(5,))
+        # start is traced (dynamic): compiles key on (pow2_floor(start),
+        # suffix bucket) SHAPES only — see prefix_prefill's docstring.
+        self._prefix_prefill = jax.jit(prefix_prefill,
+                                       donate_argnums=(6,))
 
     # ----------------------------------------------------- paged allocator
 
@@ -1275,6 +1336,21 @@ class InferenceEngine:
         self._block_refs[b] -= 1
         if self._block_refs[b] == 0:
             self._free_blocks.append(b)
+
+    def _addref_block(self, b: int) -> None:
+        """Refcount bump for a holder OTHER than a slot table (the
+        radix tree adopting a finishing slot's prompt blocks)."""
+        self._block_refs[b] += 1
+
+    def _evict_radix(self, need: int) -> int:
+        """Evict unpinned radix LEAVES whose only reference is the
+        tree's own (so the deref actually frees a block), LRU-first,
+        until `need` blocks freed or nothing evictable remains.
+        Cascades as parents become leaves.  Caller holds the lock."""
+        freed = self._radix.evict(need, self._block_refs,
+                                  self._deref_block)
+        self.radix_stats['evictions'] += freed
+        return freed
 
     def _ensure_blocks(self, slot: int, upto: int) -> None:
         """Grow the slot's table with fresh private blocks so rows
@@ -1343,6 +1419,13 @@ class InferenceEngine:
             # the normal defer path — exhaustion must degrade to
             # queueing, never to a crash.
             return False
+        short = (demand + self._blocks_outstanding() + extra
+                 - len(self._free_blocks))
+        if short > 0 and self._radix is not None:
+            # Cached-but-unreferenced radix blocks are reclaimable
+            # capacity, not load: shed leaves BEFORE deferring the
+            # request (a request must never queue behind cache).
+            self._evict_radix(short)
         return (len(self._free_blocks) - self._blocks_outstanding()
                 - extra >= demand)
 
@@ -1372,17 +1455,49 @@ class InferenceEngine:
             rows = np.pad(rows, ((0, 0), (0, nb - rows.shape[1])))
         return jnp.asarray(rows)
 
+    def _radix_section(self) -> Dict[str, Any]:
+        rs = self.radix_stats
+        lookups = rs['lookups']
+        return {
+            'enabled': self._radix is not None,
+            'hits': rs['hits'],
+            'lookups': lookups,
+            'hit_rate': (rs['hits'] / lookups) if lookups else 0.0,
+            'tokens_reused': rs['tokens_reused'],
+            'inserts': rs['inserts'],
+            'evictions': rs['evictions'],
+            'nodes': self._radix.nodes if self._radix else 0,
+            'blocks_held': (self._radix.blocks_held
+                            if self._radix else 0),
+            'pinned': self._radix.pinned if self._radix else 0,
+        }
+
     def stats(self) -> Dict[str, Any]:
-        """KV-cache HBM accounting (served by /stats).  Dense: the
-        static layout.  Paged: live pool occupancy — total/free/shared
-        blocks, bytes resident, and prefix sharing counters."""
+        """KV-cache accounting (served by /stats).  Everything lives
+        under ONE structured 'kv' section — layout, blocks, bytes,
+        prefix + radix caching, admission — while the historical flat
+        keys (kv_layout, kv_bytes_*, blocks_*, admission_deferred,
+        prefix_block_hits, ...) remain as DEPRECATED aliases so
+        existing dashboards keep reading."""
         mc = self.model_config
         row_bytes = (2 * mc.num_kv_heads * mc.head_dim_ *
                      np.dtype(self.cfg.cache_dtype).itemsize *
                      mc.num_layers)
+        prefix = {**self.prefix_stats,
+                  'resident': len(self._prefixes)}
+        radix = self._radix_section()
         if not self._paged:
             total = self.cfg.num_slots * self.cfg.max_cache_len
+            kv = {
+                'layout': 'dense',
+                'bytes': {'total': total * row_bytes,
+                          'resident': total * row_bytes},
+                'prefix': prefix,
+                'radix': radix,
+            }
             return {
+                'kv': kv,
+                # deprecated aliases of kv.*
                 'kv_layout': 'dense',
                 'kv_bytes_total': total * row_bytes,
                 'kv_bytes_resident': total * row_bytes,
@@ -1396,7 +1511,33 @@ class InferenceEngine:
         shared = int((refs[1:] > 1).sum())
         prefix_blocks = sum(len(e['blocks'])
                             for e in self._prefixes.values())
+        prefix['block_hits'] = self.paged_stats['prefix_block_hits']
+        prefix['blocks'] = prefix_blocks
+        kv = {
+            'layout': 'paged',
+            'blocks': {
+                'size': bs_,
+                'total': usable,
+                'free': free,
+                'allocated': usable - free,
+                'shared': shared,
+                # Table entries resolved by sharing instead of
+                # allocation (refcounts beyond each block's first).
+                'shared_refs_saved':
+                    int((refs[1:][refs[1:] > 1] - 1).sum()),
+            },
+            'bytes': {
+                'per_block': int(block_bytes),
+                'total': int(self._num_blocks * block_bytes),
+                'resident': int((usable - free) * block_bytes),
+            },
+            'admission': {'deferred': self.paged_stats['deferred']},
+            'prefix': prefix,
+            'radix': radix,
+        }
         return {
+            'kv': kv,
+            # deprecated aliases of kv.*
             'kv_layout': 'paged',
             'block_size': bs_,
             'blocks_total': usable,
@@ -1404,9 +1545,7 @@ class InferenceEngine:
             'blocks_allocated': usable - free,
             'blocks_shared': shared,
             'blocks_prefix': prefix_blocks,
-            # Table entries resolved by sharing instead of allocation
-            # (sum of refcounts beyond each shared block's first).
-            'shared_refs_saved': int((refs[1:][refs[1:] > 1] - 1).sum()),
+            'shared_refs_saved': kv['blocks']['shared_refs_saved'],
             'kv_bytes_per_block': int(block_bytes),
             'kv_bytes_total': int(self._num_blocks * block_bytes),
             'kv_bytes_resident': int((usable - free) * block_bytes),
@@ -1612,6 +1751,9 @@ class InferenceEngine:
         arr = np.zeros((1, bucket), np.int32)
         arr[0, :n] = tokens
         if self._paged:
+            if self._radix is not None:
+                return self._register_prefix_radix(arr, n, bucket,
+                                                   adapter, aid, tokens)
             return self._register_prefix_paged(arr, n, bucket, adapter,
                                                aid, tokens)
         pcache = init_cache(self.model_config, 1, bucket,
@@ -1687,6 +1829,51 @@ class InferenceEngine:
                     self._deref_block(b)
         return n
 
+    def _register_prefix_radix(self, arr, n, bucket, adapter, aid,
+                               tokens) -> int:
+        """register_prefix under auto_prefix_cache = optional PINNING:
+        the prefix's full blocks are prefilled into the pool (or found
+        already cached), inserted into the radix tree, and marked
+        pinned — eviction-exempt, so a cold-start system prompt stays
+        resident under pool pressure instead of churning with the LRU.
+        Returns the pinned length, block-aligned (the tree shares
+        whole blocks only; a sub-block tail is not cacheable)."""
+        bs_ = self.cfg.kv_block_size
+        m = (n // bs_) * bs_
+        if m < bs_:
+            raise ValueError(
+                f'prefix shorter than one KV block ({bs_} tokens) '
+                'cannot be pinned under auto_prefix_cache')
+        need = m // bs_
+        with self._lock:
+            # _can_admit_blocks sheds unpinned radix leaves first, so
+            # pinning displaces cache before it can fail.
+            if not self._can_admit_blocks(need):
+                raise ValueError(
+                    f'KV block pool too small to pin a {n}-token '
+                    f'prefix ({need} blocks; {len(self._free_blocks)} '
+                    'free after honoring running slots) — raise '
+                    'kv_blocks')
+            blocks = self._alloc_blocks(need)
+            table = np.zeros((1, bucket // bs_), np.int32)
+            table[0, :need] = blocks
+            # Rows [m, n) (the sub-block tail) scatter into table
+            # entries past `need`, i.e. the dump block — discarded.
+            with self._ctx():
+                _, _, self.cache = self._paged_prefill(
+                    self.params, jnp.asarray(arr),
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.full((1,), n - 1, jnp.int32), self.cache,
+                    jnp.asarray(table), jnp.zeros((1,), jnp.float32),
+                    jax.random.PRNGKey(0),
+                    jnp.full((1,), aid, jnp.int32), False)
+            # own=True: the tree takes over our allocation refs;
+            # duplicates of already-cached runs are dereffed (freed).
+            self.radix_stats['inserts'] += self._radix.insert(
+                adapter, tokens, blocks, addref=self._addref_block,
+                deref=self._deref_block, own=True, pinned=True)
+        return m
+
     def _match_prefix(self, tokens: Sequence[int],
                       adapter: Optional[str] = None):
         """Longest registered prefix FULLY matching the prompt's head
@@ -1694,9 +1881,10 @@ class InferenceEngine:
         Returns (start, key): start = len(prefix) reused rows, or
         len(prefix)-1 when the prompt IS the prefix (one token must
         forward to produce logits).  Prompts lying strictly inside a
-        prefix fall back to full prefill: their start would equal the
-        client-chosen prompt length, an unbounded jit-key space (the
-        static `start` compiles per value)."""
+        prefix still fall back to full prefill — the dynamic-start
+        prefix_prefill no longer compiles per start value, but partial
+        matches stay out of scope here (the radix tree is the
+        block-granular generalization)."""
         n = len(tokens)
         best = None
         for key in self._prefixes:
@@ -1743,6 +1931,24 @@ class InferenceEngine:
             # prompt == prefix: all rows but the last (row start..n-1
             # would shadow the one forwarded token).
             kv = [(k[:, :start], v[:, :start]) for k, v in kv]
+        # pow2-floor bucketing of the DYNAMIC start: rows [0, b) ride
+        # as-is, rows [b, start) are copied into a zero-padded b-wide
+        # remainder buffer — the jit key is (b, sb), not start.
+        b_ = 1
+        while b_ * 2 <= start:
+            b_ *= 2
+        prefix_b = [(k[:, :b_], v[:, :b_]) for k, v in kv]
+        r = start - b_
+        rem = []
+        for k, v in kv:
+            hkv, _, hd = k.shape
+            if r:
+                zk = jnp.zeros((hkv, b_ - r, hd), k.dtype)
+                rem.append((jnp.concatenate([k[:, b_:start], zk], axis=1),
+                            jnp.concatenate([v[:, b_:start], zk], axis=1)))
+            else:
+                rem.append((jnp.zeros((hkv, b_, hd), k.dtype),
+                            jnp.zeros((hkv, b_, hd), v.dtype)))
         lanes = self.cfg.prefill_lanes
         for ofs in range(0, len(group), lanes):
             chunk = group[ofs:ofs + lanes]
@@ -1769,9 +1975,11 @@ class InferenceEngine:
             with self._ctx():
                 head, self.cache = \
                     self._prefix_prefill(
-                        self.params, jnp.asarray(tokens), start,
-                        jnp.asarray(true_lens), kv, self.cache,
-                        jnp.asarray(slots), jnp.asarray(temps), rkey,
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray(start, jnp.int32),
+                        jnp.asarray(true_lens), prefix_b, rem,
+                        self.cache, jnp.asarray(slots),
+                        jnp.asarray(temps), rkey,
                         jnp.full((width,), aid, jnp.int32))
             first_np, first_lp_np, tids, tlps = _unpack_head(
                 np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
@@ -1878,6 +2086,87 @@ class InferenceEngine:
             self.prefix_stats['hits'] += p
             self.prefix_stats['tokens_reused'] += start * p
 
+    def _start_radix_group_paged(self, group, sb: int,
+                                 gen: int) -> None:
+        """Start radix-matched requests sharing a suffix bucket: each
+        slot's table gets its matched blocks by REFERENCE (refcount
+        bump — matches are block-aligned, so unlike the registered-
+        prefix path there is never a tail block to privatize), then
+        the suffixes forward in lane-batched paged_prefill dispatches
+        with PER-LANE dynamic starts — lanes with different match
+        lengths (and adapters) share one dispatch, so the compile key
+        stays (sb, table width).
+
+        group: ((req, slot, submit_time, n, bucket, max_new), start,
+        blocks) triples.  `gen` is the tree generation the matches
+        were taken under; everything from match to refcount bump runs
+        under one lock acquisition, so a mismatch means a reset slid
+        in between — fail loudly rather than share dead blocks."""
+        assert gen == self._radix.generation, (
+            'radix tree reset between match and start '
+            f'({gen} != {self._radix.generation})')
+        bs_ = self.cfg.kv_block_size
+        lanes = self.cfg.prefill_lanes
+        for ofs in range(0, len(group), lanes):
+            chunk = group[ofs:ofs + lanes]
+            p = len(chunk)
+            width = lanes
+            tokens = np.zeros((width, sb), np.int32)
+            starts = np.zeros((width,), np.int32)
+            true_pos = np.zeros((width,), np.int32)
+            slots = np.zeros((width,), np.int32)
+            temps = np.zeros((width,), np.float32)
+            aids = np.full((width,), -1, np.int32)
+            for it, start, blocks in chunk:       # real lanes only
+                req, slot, _, n, _, _ = it
+                self._append_shared_blocks(slot, blocks)
+                self._ensure_blocks(slot, n)
+                self.paged_stats['prefix_block_hits'] += len(blocks)
+                self.radix_stats['hits'] += 1
+                self.radix_stats['tokens_reused'] += start
+            for i in range(width):
+                it, start, _ = chunk[min(i, p - 1)]
+                req, slot, _, n, _, _ = it
+                ns = n - start
+                tokens[i, :ns] = req.tokens[start:]
+                starts[i] = start
+                true_pos[i] = ns - 1
+                slots[i] = slot
+                temps[i] = req.temperature
+                aids[i] = self._adapter_id(req)
+            assert all(slots[i] == slots[p - 1]
+                       for i in range(p, width)), (
+                f'pad lanes must duplicate the last real lane: '
+                f'{slots=} p={p}')
+            # Table width covers every lane's start + suffix bucket
+            # (pad lanes duplicate a real lane, so the max is real).
+            nb = self._nb_bucket(-(-(int(starts.max()) + sb) // bs_))
+            tables = self._lane_tables(slots, nb)
+            self._rng, rkey = jax.random.split(self._rng)
+            with self._ctx():
+                head, _, self.cache = self._paged_prefill(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(starts), jnp.asarray(true_pos),
+                    self.cache, tables, jnp.asarray(temps), rkey,
+                    jnp.asarray(aids), False)
+            first_np, first_lp_np, tids, tlps = _unpack_head(
+                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+            top_np = (tids, tlps)
+            now = time.time()
+            for i, (it, start, _) in enumerate(chunk):
+                req, slot, submit_time, n, _, max_new = it
+                s = _Slot(req, length=n, submit_time=submit_time,
+                          max_new=max_new)
+                s.first_token_time = now
+                s.generated.append(int(first_np[i]))
+                s.lps.append(float(first_lp_np[i]))
+                s.tops.append(_pairs(top_np[0][i], top_np[1][i]))
+                self._slots[slot] = s
+                self._lengths[slot] = n
+                self._last_tokens[slot] = s.generated[0]
+                self._temps[slot] = req.temperature
+                self._slot_adapters[slot] = self._adapter_id(req)
+
     def _start_batch(self, items) -> None:
         """Prefill validated requests in batched dispatches.
 
@@ -1902,6 +2191,37 @@ class InferenceEngine:
         """
         self._fault_raise('prefill')
         self._prefill_epoch += 1
+        if self._radix is not None and items:
+            rgroups: Dict[int, list] = {}
+            rest = []
+            gen = self._radix.generation
+            bs_ = self.cfg.kv_block_size
+            for it in items:
+                req, _, _, n, _, _ = it
+                # Prompt scoring needs every prompt position's logits:
+                # reused rows have none — same bypass as registered
+                # prefixes (requests that skip reuse keep skipping).
+                if req.want_prompt_logprobs:
+                    rest.append(it)
+                    continue
+                self.radix_stats['lookups'] += 1
+                # Cap the match at n-1 tokens: at least one token must
+                # forward to produce the first sampled head, even when
+                # the whole prompt is cached.
+                blocks = self._radix.match(req.adapter, req.tokens,
+                                           n - 1)
+                if not blocks:
+                    rest.append(it)
+                    continue
+                start = len(blocks) * bs_
+                sb = self._suffix_bucket(start, n - start)
+                if sb is None:       # no bucket fits beside the match
+                    rest.append(it)
+                    continue
+                rgroups.setdefault(sb, []).append((it, start, blocks))
+            for sb, rgroup in rgroups.items():
+                self._start_radix_group_paged(rgroup, sb, gen)
+            items = rest
         if self._prefixes:
             groups: Dict[Any, list] = {}
             rest = []
@@ -2117,6 +2437,17 @@ class InferenceEngine:
                     self.params, jnp.asarray(tokens), jnp.asarray(starts),
                     jnp.asarray(true_pos), self.cache, jnp.asarray(temps),
                     key, jnp.asarray(aids))
+        if self._radix is not None:
+            # Block-boundary insertion (AFTER the dispatch, so a raised
+            # chunk fault never indexes unwritten rows): every full
+            # block of prompt rows the pool now holds is matchable
+            # immediately — an overlapping prompt arriving mid-prefill
+            # reuses them without waiting for this one to finish.
+            # finals are still in _chunking here; their completion-time
+            # adopt in _finish_slot is an idempotent no-op on top.
+            for slot, job in self._chunking.items():
+                self._radix_adopt(slot, job.req.tokens, job.done,
+                                  job.req.adapter)
         if finals:
             first_np, first_lp_np, tids, tlps = _unpack_head(
                 np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
@@ -2136,6 +2467,24 @@ class InferenceEngine:
                 self._temps[slot] = job.req.temperature
                 self._slot_adapters[slot] = job.aid
         return True
+
+    def _radix_adopt(self, slot: int, tokens: Sequence[int],
+                     rows: int, adapter: Optional[str]) -> None:
+        """Insert the slot's full PROMPT blocks (rows [0, rows) of
+        `tokens`, whole blocks only) into the radix tree by reference.
+        Only prefill-written rows are ever indexed — decode-written
+        rows at the same position could differ numerically from a
+        fresh prefill (different dispatch shape/accumulation order),
+        which would break the radix-on == radix-off byte-identity
+        bar.  Idempotent: already-cached runs just get an LRU touch.
+        Caller holds the lock."""
+        bs_ = self.cfg.kv_block_size
+        full = min(rows // bs_, int(self._slot_nblocks[slot]))
+        if full < 1:
+            return
+        blocks = [int(b) for b in self._tables_np[slot, :full]]
+        self.radix_stats['inserts'] += self._radix.insert(
+            adapter, tokens, blocks, addref=self._addref_block)
 
     def _flush_streams(self) -> None:
         """Deliver newly generated tokens of every active streaming slot.
@@ -2186,6 +2535,14 @@ class InferenceEngine:
         self._temps[i] = 0.0
         self._slot_adapters[i] = -1
         if self._paged:
+            if (self._radix is not None and reason != 'error' and
+                    not req.want_prompt_logprobs):
+                # Adopt the slot's full PROMPT blocks into the radix
+                # tree before the table is torn down.  'error' finishes
+                # are excluded: a failed dispatch may have left rows
+                # unwritten or garbled.
+                self._radix_adopt(i, req.tokens, len(req.tokens),
+                                  req.adapter)
             self._free_slot_blocks(i)
         if req.request_id is not None:
             self._cancelled.pop(req.request_id, None)   # stale mark
@@ -2933,9 +3290,15 @@ class InferenceEngine:
                 if self._paged:
                     demand = self._blocks_demand(
                         len(req.tokens), self._max_new(req))
-                    admissible = (demand > self._num_blocks - 1 or
-                                  self._can_admit_blocks(demand,
-                                                         admit_extra))
+                    # Under the lock: _can_admit_blocks may now EVICT
+                    # radix leaves (a pool mutation), and the lock is
+                    # also what serializes this check against a
+                    # quarantine _reset_cache — a deferred request
+                    # replayed here never sees a half-cleared tree.
+                    with self._lock:
+                        admissible = (demand > self._num_blocks - 1 or
+                                      self._can_admit_blocks(demand,
+                                                             admit_extra))
                     if not admissible and not to_start and \
                             not self._chunking and \
                             not any(s is not None for s in self._slots):

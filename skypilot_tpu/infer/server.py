@@ -238,6 +238,12 @@ class InferenceServer:
         second sighting, register it as a prefix (background thread) so
         later requests prefill suffix-only.  No-op unless auto_prefix
         and the engine has prefix slots."""
+        if getattr(self.engine, '_radix', None) is not None:
+            # Engine-level automatic radix caching supersedes this
+            # whole-prompt heuristic: every completed prompt's full
+            # blocks are already matchable at block granularity, so
+            # counting heads here would only duplicate work.
+            return
         if not self.auto_prefix or not self.engine.cfg.max_prefixes:
             return
         if req.want_prompt_logprobs:
@@ -552,6 +558,7 @@ def _make_handler(server: InferenceServer):
                 self._json(200, {'object': 'list', 'data': rows})
             elif self.path == '/stats':
                 eng = server.engine
+                st = eng.stats()
                 self._json(200, {
                     'slots_active': sum(s is not None
                                         for s in eng._slots),
@@ -560,16 +567,19 @@ def _make_handler(server: InferenceServer):
                     'awaiting_first_token': len(server._awaiting_first),
                     'shed_count': server.shed_count,
                     'spec': dict(eng.spec_stats),
+                    # THE structured KV section: layout, blocks, bytes,
+                    # prefix + radix caching (hits/hit_rate/
+                    # tokens_reused/nodes/blocks_held/evictions),
+                    # admission — engine.stats()['kv'].
+                    'kv': st['kv'],
+                    # Deprecated aliases of kv.* (old dashboards):
                     'prefix': dict(eng.prefix_stats),
                     'resident_prefixes': len(eng._prefixes),
+                    'kv_cache': st,
                     'adapters': sorted(eng.adapters),
                     'prefill_chunk': eng.cfg.prefill_chunk,
                     'chunking_slots': len(eng._chunking),
                     'chunk': dict(eng.chunk_stats),
-                    # KV HBM accounting: layout + (paged) pool occupancy
-                    # — blocks total/free/shared, bytes resident, prefix
-                    # blocks held by refcount (engine.stats()).
-                    'kv_cache': eng.stats(),
                     # Failure/recovery counters (engine.fault_stats):
                     # internal_errors, deadline_evictions, loop_restarts,
                     # quarantined_batches, nonfinite_lanes.
@@ -1134,6 +1144,10 @@ def _make_handler(server: InferenceServer):
             if self.path == '/cache_prefix':
                 # Register a prefix (system prompt): its KV rows stay
                 # on device and matching prompts prefill suffix-only.
+                # Under --auto-prefix-cache this is OPTIONAL PINNING:
+                # caching already happens automatically, and this call
+                # just marks the prefix's radix nodes eviction-exempt
+                # (cached_prefix_len is then block-aligned).
                 tokens = payload.get('tokens')
                 if tokens is None and server.tokenizer is not None:
                     prompt = payload.get('prompt')
@@ -1285,7 +1299,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         auto_prefix: bool = False,
         prefill_chunk: int = 0,
         kv_block_size: int = 0,
-        kv_blocks: Optional[int] = None) -> None:
+        kv_blocks: Optional[int] = None,
+        auto_prefix_cache: bool = False) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -1404,7 +1419,8 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       adaptive_decode_window=adaptive_window,
                       decode_lookahead=decode_lookahead,
                       prefill_chunk=prefill_chunk,
-                      kv_block_size=kv_block_size, kv_blocks=kv_blocks)
+                      kv_block_size=kv_block_size, kv_blocks=kv_blocks,
+                      auto_prefix_cache=auto_prefix_cache)
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -1485,6 +1501,17 @@ def main() -> None:
                              'pools oversubscribe HBM and admission-'
                              'defer requests whose worst-case demand '
                              'does not fit')
+    parser.add_argument('--auto-prefix-cache', action='store_true',
+                        help='engine-level automatic radix-tree prefix '
+                             'caching over the paged KV pool (requires '
+                             '--kv-block-size): completed prompts\' '
+                             'full blocks become matchable, admitted '
+                             'prompts reuse their longest block-aligned '
+                             'cached prefix copy-free, unreferenced '
+                             'leaves are LRU-evicted under pool '
+                             'pressure. Supersedes the --auto-prefix '
+                             'heuristic; /cache_prefix becomes optional '
+                             'pinning')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
@@ -1500,7 +1527,8 @@ def main() -> None:
         decode_lookahead=args.decode_lookahead,
         auto_prefix=args.auto_prefix,
         prefill_chunk=args.prefill_chunk,
-        kv_block_size=args.kv_block_size, kv_blocks=args.kv_blocks)
+        kv_block_size=args.kv_block_size, kv_blocks=args.kv_blocks,
+        auto_prefix_cache=args.auto_prefix_cache)
 
 
 if __name__ == '__main__':

@@ -1,0 +1,477 @@
+"""Automatic radix-tree prefix caching: correctness + identity.
+
+The contract under test (infer/radix.py + engine wiring): with
+``auto_prefix_cache`` on, the engine indexes every finished request's
+full prompt blocks in a block-granular radix tree and admits later
+requests by bumping refcounts on the longest matching block-aligned
+prefix — and the result is OBSERVABLY IDENTICAL to radix-off (same
+greedy tokens, logprobs, finish reasons) because only prefill-written
+rows are ever indexed.  Eviction integrates with admission: radix
+leaves are shed LRU-first before any request is deferred, and fault
+quarantine drops the tree wholesale without leaking a block.
+
+Everything here is tier-1 (CPU dryrun): one tiny 2-layer model, its
+params built ONCE and shared by every engine, fixed seeds.
+"""
+import copy
+import queue
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
+                                       Request)  # noqa: E402
+from skypilot_tpu.infer.faults import FaultPlan, FaultSpec  # noqa: E402
+from skypilot_tpu.infer.radix import RadixTree  # noqa: E402
+from skypilot_tpu.models.llama import LlamaConfig  # noqa: E402
+
+
+@pytest.fixture(scope='module')
+def tiny_config():
+    return LlamaConfig(name='radix-test', vocab_size=101, hidden_size=32,
+                       intermediate_size=64, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_seq_len=128,
+                       tie_embeddings=True, dtype='float32')
+
+
+COMMON = dict(num_slots=4, max_cache_len=64, prefill_buckets=(8, 16, 32),
+              max_new_tokens=8, cache_dtype=jnp.float32)
+
+
+@pytest.fixture(scope='module')
+def shared_params(tiny_config):
+    eng = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          rng=jax.random.PRNGKey(0))
+    return eng.params
+
+
+def _pair(tiny_config, shared_params, **overrides):
+    """(radix-off, radix-on) paged engines sharing weights and rng."""
+    base = dict(COMMON)
+    base.update(overrides)
+    off = InferenceEngine(tiny_config,
+                          InferConfig(kv_block_size=8, **base),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    on = InferenceEngine(tiny_config,
+                         InferConfig(kv_block_size=8,
+                                     auto_prefix_cache=True, **base),
+                         params=shared_params,
+                         rng=jax.random.PRNGKey(7))
+    return off, on
+
+
+def _overlapping_requests(seed, n, ids=False):
+    """Prompt families sharing long prefixes (system-prompt style)."""
+    r = random.Random(seed)
+    shared = [r.randrange(1, 101) for _ in range(24)]
+    out = []
+    for i in range(n):
+        if r.random() < 0.7:
+            toks = (shared[:r.choice([8, 16, 24])] +
+                    [r.randrange(1, 101) for _ in range(r.randrange(1, 8))])
+        else:
+            toks = [r.randrange(1, 101) for _ in range(r.randrange(3, 28))]
+        out.append(Request(request_id=str(i) if ids else None,
+                           tokens=toks,
+                           max_new_tokens=r.randrange(1, 8)))
+    return out
+
+
+def _assert_identical(out_a, out_b):
+    for a, b in zip(out_a, out_b):
+        assert a.output_tokens == b.output_tokens
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_allclose(a.logprobs, b.logprobs, atol=1e-5)
+
+
+def _assert_radix_conserved(eng):
+    """Block-refcount conservation: once every slot has drained, the
+    only live references besides the dump block belong to the tree —
+    one per node — so accounting balances to zero net leakage."""
+    refs = eng._block_refs
+    live = int((refs[1:] > 0).sum())
+    assert live == eng._radix.blocks_held, (live, eng._radix.blocks_held)
+    assert int(refs[1:].sum()) == eng._radix.blocks_held
+    assert len(eng._free_blocks) == eng._num_blocks - 1 - live
+
+
+def _serve(eng, jobs, burst=3, pause=0.03):
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    t = threading.Thread(
+        target=eng.generate_stream,
+        args=(q, lambda res: results.__setitem__(res.request_id, res),
+              stop))
+    t.start()
+    try:
+        for i, job in enumerate(jobs):
+            q.put(copy.deepcopy(job))
+            if i % burst == burst - 1:
+                time.sleep(pause)
+        deadline = time.time() + 120
+        while len(results) < len(jobs) and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join()
+    assert len(results) == len(jobs)
+    return results
+
+
+# ------------------------------------------------ trie property model
+
+def test_radix_trie_property():
+    """Randomized insert/match/evict against a reference dict-of-paths
+    model, with simulated refcounts: the tree must agree with the model
+    on every match result, node count, LRU eviction victim, and block
+    refcount after every operation."""
+    bs = 4
+    r = random.Random(0)
+    tree = RadixTree(bs)
+    refs = {}                     # block -> refcount
+    next_block = [1]
+    model = {}                    # (adapter, runs-tuple) -> node dict
+    clock = [0]
+    history = []                  # inserted (adapter, tokens) for replay
+
+    def addref(b):
+        refs[b] += 1
+
+    def deref(b):
+        refs[b] -= 1
+        assert refs[b] >= 0
+
+    def model_children(key):
+        ad, path = key
+        return [k for k in model
+                if k[0] == ad and len(k[1]) == len(path) + 1
+                and k[1][:len(path)] == path]
+
+    adapters = [None, 'lora-a']
+    for _ in range(300):
+        op = r.random()
+        ad = r.choice(adapters)
+        if op < 0.45:
+            # Insert: caller holds one ref per block (a slot's table),
+            # hands blocks to the tree, then frees its own refs — the
+            # _finish_slot adoption sequence.
+            n_runs = r.randrange(1, 5)
+            if history and r.random() < 0.4:
+                # extend or repeat a previous path to exercise the
+                # idempotent-overlap branch
+                ad, prev = r.choice(history)
+                toks = list(prev[:r.randrange(bs, len(prev) + 1)])
+                toks += [r.randrange(0, 6)
+                         for _ in range(r.randrange(0, 2 * bs))]
+            else:
+                toks = [r.randrange(0, 6)
+                        for _ in range(n_runs * bs + r.randrange(0, bs))]
+            nblocks = len(toks) // bs
+            if nblocks < 1:
+                continue
+            pin = r.random() < 0.1
+            blocks = []
+            for _ in range(nblocks):
+                b = next_block[0]
+                next_block[0] += 1
+                refs[b] = 1
+                blocks.append(b)
+            created = tree.insert(ad, toks, blocks, addref=addref,
+                                  pinned=pin)
+            clock[0] += 1
+            history.append((ad, list(toks)))
+            exp_created, path = 0, ()
+            for i in range(nblocks):
+                run = tuple(toks[i * bs:(i + 1) * bs])
+                path = path + (run,)
+                key = (ad, path)
+                if key not in model:
+                    model[key] = {'block': blocks[i], 'pinned': False}
+                    exp_created += 1
+                if pin:
+                    model[key]['pinned'] = True
+                model[key]['last_used'] = clock[0]
+            assert created == exp_created
+            for b in blocks:          # caller releases its slot refs
+                deref(b)
+        elif op < 0.8:
+            # Match: replay a known path's prefix (hit) or random noise
+            if history and r.random() < 0.7:
+                ad, prev = r.choice(history)
+                toks = list(prev[:r.randrange(1, len(prev) + 1)])
+            else:
+                toks = [r.randrange(0, 6)
+                        for _ in range(r.randrange(1, 4 * bs))]
+            cap = (len(toks) if r.random() < 0.7
+                   else r.randrange(0, len(toks) + 1))
+            got = tree.match(ad, toks, cap)
+            exp, path = [], ()
+            limit = min(len(toks), cap) // bs
+            touched = []
+            for i in range(limit):
+                run = tuple(toks[i * bs:(i + 1) * bs])
+                path = path + (run,)
+                nd = model.get((ad, path))
+                if nd is None:
+                    break
+                exp.append(nd['block'])
+                touched.append(nd)
+            assert got == exp
+            if touched:               # tree ticked and touched the path
+                clock[0] += 1
+                for nd in touched:
+                    nd['last_used'] = clock[0]
+        else:
+            # Evict: model picks the same LRU victims on a refs
+            # snapshot, then the tree must free the same count.
+            need = r.randrange(1, 4)
+            snap = dict(refs)
+            exp_freed = 0
+            while exp_freed < need:
+                elig = [k for k in model
+                        if not model_children(k)
+                        and not model[k]['pinned']
+                        and snap[model[k]['block']] == 1]
+                if not elig:
+                    break
+                victim = min(elig, key=lambda k: model[k]['last_used'])
+                snap[model[victim]['block']] -= 1
+                del model[victim]
+                exp_freed += 1
+            freed = tree.evict(need, refs, deref)
+            assert freed == exp_freed
+        # Conservation invariants after EVERY op: the tree is the sole
+        # holder of exactly one ref per node, nothing else is live.
+        assert tree.nodes == len(model)
+        assert tree.blocks_held == len(model)
+        live = {b for b, c in refs.items() if c > 0}
+        assert live == {model[k]['block'] for k in model}
+        assert all(refs[b] == 1 for b in live)
+        assert tree.pinned == sum(model[k]['pinned'] for k in model)
+    # clear() drops everything without touching refcounts
+    gen = tree.generation
+    tree.clear()
+    assert tree.nodes == 0 and tree.generation == gen + 1
+    assert tree.match(None, history[0][1], 10 * bs) == []
+
+
+# ----------------------------------------------------- byte identity
+
+def test_radix_offline_identity_and_stats(tiny_config, shared_params):
+    """Two offline waves of overlapping prompts: token streams are
+    byte-identical radix-on vs radix-off, the second wave hits the
+    tree, and the structured kv stats section agrees with the flat
+    deprecated aliases."""
+    off, on = _pair(tiny_config, shared_params)
+    for seed in (3, 4):
+        reqs = _overlapping_requests(seed, 10)
+        out_off = off.generate([copy.deepcopy(q) for q in reqs])
+        out_on = on.generate([copy.deepcopy(q) for q in reqs])
+        _assert_identical(out_off, out_on)
+        _assert_radix_conserved(on)
+    assert on.radix_stats['hits'] > 0
+    assert on.radix_stats['tokens_reused'] > 0
+    st = on.stats()
+    kv = st['kv']
+    assert kv['radix']['enabled'] is True
+    assert kv['radix']['hits'] == on.radix_stats['hits']
+    assert kv['radix']['nodes'] == on._radix.nodes
+    assert 0.0 < kv['radix']['hit_rate'] <= 1.0
+    # deprecated flat aliases still mirror the structured section
+    assert st['kv_layout'] == kv['layout'] == 'paged'
+    assert st['blocks_total'] == kv['blocks']['total']
+    assert st['blocks_free'] == kv['blocks']['free']
+    assert st['prefix_block_hits'] == kv['prefix']['block_hits']
+    assert st['admission_deferred'] == kv['admission']['deferred']
+    off_st = off.stats()
+    assert off_st['kv']['radix']['enabled'] is False
+
+
+def test_radix_serving_identity(tiny_config, shared_params):
+    """Bursty serving arrivals: per-request streams identical with the
+    tree on, across dequeue gaps that interleave prefill and decode."""
+    off, on = _pair(tiny_config, shared_params)
+    jobs = _overlapping_requests(21, 10, ids=True)
+    res_off = _serve(off, jobs)
+    res_on = _serve(on, jobs)
+    for job in jobs:
+        a, b = res_off[job.request_id], res_on[job.request_id]
+        assert a.output_tokens == b.output_tokens, job.request_id
+        assert a.finish_reason == b.finish_reason
+    assert on.radix_stats['lookups'] > 0
+    _assert_radix_conserved(on)
+
+
+def test_radix_chunked_identity(tiny_config, shared_params):
+    """Chunked prefill inserts at block boundaries mid-prompt; streams
+    must stay identical and chunk-boundary insertion must only index
+    rows the dispatched chunks have already written."""
+    off, on = _pair(tiny_config, shared_params, prefill_chunk=8)
+    jobs = _overlapping_requests(22, 8, ids=True)
+    res_off = _serve(off, jobs)
+    res_on = _serve(on, jobs)
+    for job in jobs:
+        assert (res_off[job.request_id].output_tokens ==
+                res_on[job.request_id].output_tokens), job.request_id
+    _assert_radix_conserved(on)
+
+
+def test_radix_speculative_identity(tiny_config, shared_params):
+    """Prompt-lookup speculative decode over radix-shared blocks: the
+    verify path reads shared prefix rows, so acceptance decisions (and
+    tokens) must not shift."""
+    off, on = _pair(tiny_config, shared_params, draft_len=3,
+                    max_new_tokens=12)
+    r = random.Random(5)
+    shared = [r.randrange(1, 5) for _ in range(16)]
+    reqs = [Request(tokens=shared[:r.choice([8, 16])] +
+                    [r.randrange(1, 5) for _ in range(r.randrange(1, 6))],
+                    max_new_tokens=r.randrange(4, 10)) for _ in range(6)]
+    out_off = off.generate([copy.deepcopy(q) for q in reqs])
+    out_on = on.generate([copy.deepcopy(q) for q in reqs])
+    _assert_identical(out_off, out_on)
+    assert on.spec_stats == off.spec_stats
+    _assert_radix_conserved(on)
+
+
+# ------------------------------------------- eviction and admission
+
+def test_radix_eviction_before_defer(tiny_config, shared_params):
+    """Acceptance bar: under block pressure, unreferenced radix leaves
+    are evicted before any request is deferred — no spurious `deferred`
+    increments while the tree still holds shed-able blocks."""
+    eng = InferenceEngine(tiny_config,
+                          InferConfig(kv_block_size=8, kv_blocks=20,
+                                      auto_prefix_cache=True, **COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    r = random.Random(9)
+    for _ in range(4):
+        reqs = [Request(tokens=[r.randrange(1, 101)
+                                for _ in range(r.randrange(12, 30))],
+                        max_new_tokens=4) for _ in range(4)]
+        out = eng.generate(reqs)
+        assert all(o.finish_reason in ('eos', 'length') for o in out)
+    # Every wave over-subscribes the 19 usable blocks, so the tree had
+    # to shed — yet nothing was ever deferred, because eviction runs
+    # inside _can_admit_blocks before the defer verdict.
+    assert eng.radix_stats['evictions'] > 0
+    assert eng.stats()['kv']['admission']['deferred'] == 0
+    _assert_radix_conserved(eng)
+
+
+def test_radix_register_prefix_is_pinning(tiny_config, shared_params):
+    """register_prefix in radix mode pins the prefix's nodes: pinned
+    nodes survive eviction pressure that strips every other leaf, and
+    later prompts still hit them."""
+    eng = InferenceEngine(tiny_config,
+                          InferConfig(kv_block_size=8, kv_blocks=20,
+                                      auto_prefix_cache=True, **COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    r = random.Random(13)
+    prefix = [r.randrange(1, 101) for _ in range(16)]
+    m = eng.register_prefix(prefix)
+    assert m == 16                      # block-aligned registration
+    assert eng._radix.pinned == 2
+    for _ in range(3):                  # pressure waves force evictions
+        eng.generate([Request(tokens=[r.randrange(1, 101)
+                                      for _ in range(r.randrange(12, 30))],
+                              max_new_tokens=4) for _ in range(4)])
+    assert eng.radix_stats['evictions'] > 0
+    assert eng._radix.pinned == 2       # pinned nodes never evicted
+    hits0 = eng.radix_stats['hits']
+    out = eng.generate([Request(tokens=prefix + [3, 4], max_new_tokens=3)])
+    assert out[0].finish_reason in ('eos', 'length')
+    assert eng.radix_stats['hits'] == hits0 + 1
+    _assert_radix_conserved(eng)
+
+
+# --------------------------------------------------- faults and reset
+
+def test_radix_quarantine_drops_and_rebuilds(tiny_config, shared_params):
+    """Chaos bar: an unattributed decode fault quarantines the batch and
+    _reset_cache drops the tree (generation bump) without leaking a
+    block; traffic afterwards rebuilds it from scratch."""
+    eng = InferenceEngine(tiny_config,
+                          InferConfig(kv_block_size=8,
+                                      auto_prefix_cache=True, **COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    r = random.Random(17)
+    warm = [Request(tokens=[5] * 20 + [r.randrange(1, 101)],
+                    max_new_tokens=3) for _ in range(3)]
+    eng.generate(warm)
+    assert eng._radix.nodes > 0
+    gen0 = eng._radix.generation
+    eng.arm_faults(FaultPlan(seed=1, specs=[
+        FaultSpec(site='decode_step', hits=(1,))]))
+    try:
+        out = eng.generate([Request(tokens=[5] * 20 + [9],
+                                    max_new_tokens=4) for _ in range(2)])
+    finally:
+        eng.disarm_faults()
+    assert all(o.finish_reason == 'error' for o in out)
+    assert eng.fault_stats['quarantined_batches'] >= 1
+    assert eng._radix.nodes == 0
+    assert eng._radix.generation > gen0
+    _assert_radix_conserved(eng)        # no leaked refs after reset
+    out = eng.generate([Request(tokens=[5] * 20 + [11], max_new_tokens=3)])
+    assert out[0].finish_reason in ('eos', 'length')
+    assert eng._radix.nodes > 0         # rebuilt from traffic
+    _assert_radix_conserved(eng)
+
+
+def test_radix_expired_at_dequeue_never_touches_tree(tiny_config,
+                                                     shared_params):
+    """Satellite fix: a request that died in the queue must neither
+    match nor insert — the tree (and its counters) stay untouched."""
+    _, on = _pair(tiny_config, shared_params)
+    on.generate([Request(tokens=[7] * 20, max_new_tokens=2)])  # seed tree
+    nodes0 = on._radix.nodes
+    lookups0 = on.radix_stats['lookups']
+    req = Request(request_id='late', tokens=[7] * 20, max_new_tokens=4,
+                  deadline_s=1.0, arrival_time=time.time() - 10)
+    res = _serve(on, [req])['late']
+    assert res.finish_reason == 'deadline'
+    assert res.output_tokens == []
+    assert on._radix.nodes == nodes0
+    assert on.radix_stats['lookups'] == lookups0
+    _assert_radix_conserved(on)
+
+
+# ------------------------------------------- dense compile bounding
+
+def test_dense_prefix_prefill_compile_bound(tiny_config, shared_params):
+    """Satellite: dense prefix_prefill takes `start` dynamically with
+    power-of-two lane-cache bucketing, so three distinct registered
+    prefix lengths in the same bucket share ONE executable — and the
+    results still match a prefix-free engine byte-for-byte."""
+    ea = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                         params=shared_params, rng=jax.random.PRNGKey(7))
+    eb = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                         params=shared_params, rng=jax.random.PRNGKey(7))
+    r = random.Random(11)
+    prefixes = [[r.randrange(1, 101) for _ in range(n)]
+                for n in (9, 11, 13)]   # all bucket to b=8
+    for p in prefixes:
+        ea.register_prefix(p)
+    reqs = []
+    for p in prefixes:
+        for _ in range(2):
+            reqs.append(Request(
+                tokens=p + [r.randrange(1, 101)
+                            for _ in range(r.randrange(1, 6))],
+                max_new_tokens=5))
+    out_a = ea.generate([copy.deepcopy(q) for q in reqs])
+    out_b = eb.generate([copy.deepcopy(q) for q in reqs])
+    _assert_identical(out_a, out_b)
+    assert ea.prefix_stats['hits'] == len(reqs)
+    # O(#buckets), not O(#prefix lengths): one (b, sb) shape here.
+    assert ea._prefix_prefill._cache_size() == 1
