@@ -16,8 +16,15 @@ its full serving stack.  This script measures OURS the same way:
 Writes rows into BENCH_SERVE_r03.json (alongside engine-direct rows for
 the plane-vs-engine overhead comparison) when run with --out.
 
+``--failover`` runs the replica-fault section instead: a two-replica
+in-process fleet behind the LB, killing the serving replica after the
+first relayed SSE chunk, and reporting the p50/p99 latency a resumed
+stream pays over a clean one (the cost of detection + continuation
+replay).  CPU-friendly (tiny model); writes BENCH_SERVE_r06.json.
+
 Usage:
   python scripts/bench_serve_lb.py --qps 2.0 --qps 3.5 --out BENCH_SERVE_r03.json
+  python scripts/bench_serve_lb.py --failover --out BENCH_SERVE_r06.json
 """
 import argparse
 import json
@@ -26,6 +33,7 @@ import sys
 import threading
 import time
 import urllib.request
+from http.client import HTTPConnection
 
 sys.path.insert(0, '.')
 
@@ -125,6 +133,139 @@ def run_sweep_row(endpoint: str, qps: float, num_requests: int,
     }
 
 
+# ------------------------------------------------- failover section
+
+
+def _failover_stream(port: int, payload: dict, on_first_chunk=None):
+    """Stream /generate via the LB; returns (latency_s, done_event).
+    Calls on_first_chunk after the first token event arrives."""
+    conn = HTTPConnection('127.0.0.1', port, timeout=120)
+    t0 = time.time()
+    try:
+        conn.request('POST', '/generate',
+                     body=json.dumps(payload).encode(),
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f'HTTP {resp.status}')
+        buf, fired, done = b'', False, None
+        while done is None:
+            chunk = resp.read1(65536)
+            if not chunk:
+                raise RuntimeError('stream ended without done event')
+            buf += chunk
+            while b'\n\n' in buf and done is None:
+                ev, buf = buf.split(b'\n\n', 1)
+                for line in ev.split(b'\n'):
+                    if line.startswith(b'data: '):
+                        msg = json.loads(line[6:])
+                        if msg.get('done'):
+                            done = msg
+            if not fired and on_first_chunk is not None:
+                fired = True
+                on_first_chunk()
+        return time.time() - t0, done
+    finally:
+        conn.close()
+
+
+def run_failover_bench(iters: int, out: str) -> None:
+    """Clean vs killed-and-resumed stream latency through the LB."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import FaultPlan, FaultSpec, InferConfig
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    from skypilot_tpu.infer.engine import InferenceEngine
+    from skypilot_tpu.models.llama import LlamaConfig
+
+    os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    mc = LlamaConfig(name='lbbench-t', vocab_size=101, hidden_size=32,
+                     intermediate_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, max_seq_len=128,
+                     tie_embeddings=True, dtype='float32')
+    cfg = InferConfig(num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=32,
+                      cache_dtype=jnp.float32, decode_steps=4)
+
+    def make_engine():
+        eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+        # Stretch the stream across loop iterations so the mid-stream
+        # kill has a mid-stream to land in (sleep only; both arms of
+        # the comparison pay it equally).
+        eng.arm_faults(FaultPlan(seed=0, specs=[
+            FaultSpec(site='stall', prob=1.0, stall_s=0.04)]))
+        return eng
+
+    payload = {'tokens': [3, 14, 15, 9, 2, 6], 'max_new_tokens': 24,
+               'stream': True}
+    fleet = ChaosFleet(make_engine, 2)
+    fleet.start()
+    try:
+        def settle():
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if len(fleet.live_replicas()) == 2 and not \
+                        fleet.lb.lb_stats()['breaker_open_now']:
+                    return
+                time.sleep(0.05)
+            raise TimeoutError('fleet never settled')
+
+        _, ref_done = _failover_stream(fleet.lb.port, payload)
+        reference = ref_done['output_tokens']
+
+        clean, resumed = [], []
+        for _ in range(iters):
+            lat, done = _failover_stream(fleet.lb.port, payload)
+            assert done['output_tokens'] == reference
+            clean.append(lat)
+        for i in range(iters):
+            settle()
+            lat, done = _failover_stream(
+                fleet.lb.port, payload,
+                on_first_chunk=lambda: fleet.kill_one())
+            if not done.get('resumed'):
+                raise RuntimeError(
+                    f'iteration {i}: stream was not resumed ({done})')
+            if done['output_tokens'] != reference:
+                raise RuntimeError(f'iteration {i}: tokens diverged')
+            resumed.append(lat)
+            fleet.respawn_dead()
+        stats = fleet.lb.lb_stats()
+    finally:
+        fleet.stop()
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+    row = {
+        'iters': iters,
+        'clean_p50_s': statistics.median(clean),
+        'clean_p99_s': pct(clean, 0.99),
+        'failover_p50_s': statistics.median(resumed),
+        'failover_p99_s': pct(resumed, 0.99),
+        'added_p50_s': statistics.median(resumed) -
+                       statistics.median(clean),
+        'added_p99_s': pct(resumed, 0.99) - pct(clean, 0.99),
+        'streams_resumed': stats['streams_resumed'],
+        'failovers': stats['failovers'],
+        'model': 'tiny-cpu',
+        'measured_at': 'load_balancer_endpoint',
+    }
+    print(json.dumps(row, indent=2), flush=True)
+    try:
+        doc = json.load(open(out))
+    except (FileNotFoundError, ValueError):
+        doc = {}
+    doc.setdefault('failover', [])
+    doc['failover'].append(row)
+    json.dump(doc, open(out, 'w'), indent=2)
+    print(f'wrote {out}')
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--qps', action='append', type=float, default=[])
@@ -148,7 +289,15 @@ def main() -> None:
                         help='leave the service running afterwards')
     parser.add_argument('--endpoint', default=None,
                         help='reuse an existing endpoint (skip serve up)')
+    parser.add_argument('--failover', action='store_true',
+                        help='run the replica-failover latency section '
+                             '(in-process fleet, CPU-friendly)')
+    parser.add_argument('--failover-iters', type=int, default=6)
     args = parser.parse_args()
+    if args.failover:
+        run_failover_bench(args.failover_iters,
+                           args.out or 'BENCH_SERVE_r06.json')
+        return
     qps_list = args.qps or [2.0, 3.5]
 
     from skypilot_tpu import Resources, Task, state

@@ -15,14 +15,26 @@ properties that must hold under ANY fault sequence:
 Probabilistic specs draw from per-spec seeded streams (FaultPlan), so
 a failing seed reproduces exactly:  scripts/chaos_smoke.py --seeds 3
 
+``--multi-replica N`` switches to the replica-plane sweep: N killable
+in-process replicas behind the real load balancer, with a seeded
+killer thread consulting the plan's ``replica_kill`` site and
+preempting replicas mid-decode.  The property there is the tentpole
+one: **every greedy request completes byte-identical to the fault-free
+run** — zero failed requests under replica preemption — plus a drain
+exercise asserting a draining replica finishes its in-flight stream
+while the LB answers zero 5xx.
+
 Exit code: 0 = all episodes passed, 1 = any property violated.
 """
 import argparse
 import copy
+import json
+import os
 import queue
 import sys
 import threading
 import time
+from http.client import HTTPConnection
 
 sys.path.insert(0, '.')
 
@@ -136,12 +148,213 @@ def episode(eng: InferenceEngine, seed: int, n: int) -> list:
     return bad
 
 
+# ------------------------------------------------ multi-replica sweep
+
+
+def _replica_engine() -> InferenceEngine:
+    mc = LlamaConfig(name='chaos-replica', vocab_size=101,
+                     hidden_size=32, intermediate_size=64, num_layers=2,
+                     num_heads=4, num_kv_heads=2, max_seq_len=128,
+                     tie_embeddings=True, dtype='float32')
+    cfg = InferConfig(num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=32,
+                      cache_dtype=jnp.float32, decode_steps=4)
+    eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+    # Stretch generations across loop iterations so kills land while
+    # streams are genuinely in flight (sleep only; tokens unaffected).
+    eng.arm_faults(FaultPlan(seed=0, specs=[
+        FaultSpec(site='stall', prob=1.0, stall_s=0.04)]))
+    return eng
+
+
+def _request_spec(i: int) -> dict:
+    return {'tokens': [(3 * i + j) % 97 + 1 for j in range(4 + i % 4)],
+            'max_new_tokens': 12 + i % 5, 'stream': True}
+
+
+def _stream_generate(port: int, payload: dict, timeout: float = 60.0):
+    """POST /generate via the LB; returns the parsed SSE event list."""
+    conn = HTTPConnection('127.0.0.1', port, timeout=timeout)
+    try:
+        conn.request('POST', '/generate',
+                     body=json.dumps(payload).encode(),
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f'LB answered {resp.status}')
+        buf, events = b'', []
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b'\n\n' in buf:
+                ev, buf = buf.split(b'\n\n', 1)
+                for line in ev.split(b'\n'):
+                    if line.startswith(b'data: '):
+                        events.append(json.loads(line[6:]))
+        return events
+    finally:
+        conn.close()
+
+
+def _finish_of(events):
+    done = [e for e in events if e.get('done')]
+    if len(done) != 1:
+        raise RuntimeError(f'{len(done)} terminal events')
+    return done[0]
+
+
+def _drain_exercise(fleet, references) -> list:
+    """Drain the replica serving an in-flight stream: the stream must
+    complete (byte-identical) and the LB must answer zero 5xx."""
+    bad = []
+    result, exc = {}, []
+
+    def client():
+        try:
+            result['events'] = _stream_generate(
+                fleet.lb.port, _request_spec(0))
+        except Exception as e:  # noqa: BLE001
+            exc.append(e)
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    busy = None
+    while time.time() < deadline and busy is None:
+        busy = next((r for r in fleet.replicas if r.busy()), None)
+        time.sleep(0.01)
+    if busy is None:
+        return ['DRAIN: stream never reached a replica']
+    conn = HTTPConnection('127.0.0.1', busy.port, timeout=10)
+    conn.request('POST', '/drain', body=b'{"deadline_s": 60}')
+    if conn.getresponse().status != 200:
+        bad.append('DRAIN: /drain rejected')
+    conn.close()
+    for i in range(1, 5):
+        try:
+            done = _finish_of(_stream_generate(fleet.lb.port,
+                                               _request_spec(i)))
+            if done.get('output_tokens') != references[i]:
+                bad.append(f'DRAIN: request {i} diverged')
+        except RuntimeError as e:
+            bad.append(f'DRAIN: request {i} during drain: {e}')
+    t.join(60)
+    if t.is_alive() or exc:
+        bad.append(f'DRAIN: in-flight stream failed: {exc}')
+    elif _finish_of(result['events']).get('output_tokens') != \
+            references[0]:
+        bad.append('DRAIN: in-flight stream diverged')
+    if not busy.server.drained.wait(30):
+        bad.append('DRAIN: replica never reported drained')
+    conn = HTTPConnection('127.0.0.1', busy.port, timeout=10)
+    conn.request('POST', '/drain', body=b'{"cancel": true}')
+    conn.getresponse()
+    conn.close()
+    return bad
+
+
+def multi_replica_sweep(n_replicas: int, seeds, n_requests: int) -> int:
+    from skypilot_tpu.infer.chaos import ChaosFleet, SeededKiller
+
+    os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    print(f'replica chaos: {n_replicas} replicas seeds={seeds} '
+          f'requests/episode={n_requests}')
+    fleet = ChaosFleet(_replica_engine, n_replicas)
+    fleet.start()
+    failures = []
+    try:
+        # Fault-free pass = the byte-exact reference per request spec.
+        references = {}
+        for i in range(max(n_requests, 5)):
+            done = _finish_of(_stream_generate(fleet.lb.port,
+                                               _request_spec(i)))
+            references[i] = done['output_tokens']
+
+        for seed in seeds:
+            t0 = time.time()
+            killer = SeededKiller(fleet, FaultPlan(seed=seed, specs=[
+                FaultSpec(site='replica_kill', prob=0.02, max_fires=2),
+            ]))
+            killer.start()
+            bad, done_stats = [], {'resumed': 0}
+            lock = threading.Lock()
+
+            def worker(idx, bad=bad, done_stats=done_stats, lock=lock):
+                try:
+                    events = _stream_generate(fleet.lb.port,
+                                              _request_spec(idx))
+                    done = _finish_of(events)
+                    if done.get('finish_reason') not in ('length', 'eos'):
+                        raise RuntimeError(
+                            f'finish_reason={done.get("finish_reason")} '
+                            f'error={done.get("error")!r}')
+                    if done['output_tokens'] != references[idx]:
+                        raise RuntimeError(
+                            f'tokens diverged: {done["output_tokens"]} '
+                            f'!= {references[idx]}')
+                    with lock:
+                        done_stats['resumed'] += bool(done.get('resumed'))
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        bad.append(f'seed={seed} request {idx}: {e}')
+
+            # Two client lanes keep replicas busy so kills land
+            # mid-stream, not between requests.
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True)
+                       for i in range(n_requests)]
+            for lane in range(0, n_requests, 2):
+                batch = threads[lane:lane + 2]
+                for th in batch:
+                    th.start()
+                for th in batch:
+                    th.join(90)
+                    if th.is_alive():
+                        bad.append(f'seed={seed}: client hung')
+            killer.stop()
+            fleet.respawn_dead()
+            stats = fleet.lb.lb_stats()
+            print(f'  seed={seed}: kills={killer.kills} '
+                  f'resumed={done_stats["resumed"]} '
+                  f'failovers={stats["failovers"]} '
+                  f'wall={time.time() - t0:.1f}s '
+                  f'{"FAIL" if bad else "ok"}')
+            failures += bad
+            # Let probes re-admit the respawned replicas.
+            settle = time.time() + 15
+            while time.time() < settle:
+                if not fleet.lb.lb_stats()['breaker_open_now']:
+                    break
+                time.sleep(0.05)
+
+        failures += _drain_exercise(fleet, references)
+        print(f'  lb stats: {fleet.lb.lb_stats()}')
+    finally:
+        fleet.stop()
+    if failures:
+        print('REPLICA CHAOS FAILED:')
+        for f in failures:
+            print(f'  {f}')
+        return 1
+    print('replica chaos: PASS')
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--seeds', type=int, nargs='+', default=[0, 1, 2],
                     help='fault-plan seeds to sweep')
     ap.add_argument('--requests', type=int, default=12)
+    ap.add_argument('--multi-replica', type=int, default=0,
+                    metavar='N',
+                    help='replica-plane sweep with N killable replicas '
+                         'behind the load balancer (0 = engine sweep)')
     args = ap.parse_args()
+    if args.multi_replica:
+        return multi_replica_sweep(args.multi_replica, args.seeds,
+                                   args.requests)
     print(f'chaos smoke: seeds={args.seeds} '
           f'requests/episode={args.requests}')
     eng = build_engine()

@@ -20,10 +20,18 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | 
 # a file lost to a collection error or marker typo fails by name.
 python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_paged_kv.py --require tests/test_faults.py \
-    --require tests/test_radix.py || rc=1
+    --require tests/test_radix.py \
+    --require tests/test_serve_failover.py || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
 # accounting under randomized faults.  Outside the pytest window on
 # purpose — it must not eat durations budget from the suite.
 timeout -k 10 240 env JAX_PLATFORMS=cpu \
     python scripts/chaos_smoke.py || rc=1
+# Replica-plane chaos sweep (fixed seeds): seeded mid-decode replica
+# kills behind the LB; every greedy request must complete
+# byte-identical to the fault-free run, and a draining replica must
+# finish its in-flight stream with zero 5xx at the LB.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python scripts/chaos_smoke.py --multi-replica 3 --seeds 0 1 \
+    --requests 8 || rc=1
 exit "$rc"

@@ -1,0 +1,274 @@
+"""Process-level chaos harness: a killable multi-replica fleet + LB.
+
+The engine-level fault sites (`faults.py`) prove per-request
+containment INSIDE one process.  This module proves the layer above —
+the replica plane: N in-process replicas behind the real
+`SkyTpuLoadBalancer`, with a seeded killer thread that consults a
+`FaultPlan`'s ``replica_kill`` site on a fixed tick and kills live
+replicas mid-decode (listener closed, in-flight client sockets
+severed, serving loop stopped).  Greedy decoding is schedule- and
+replica-independent, so an offline `engine.generate` run on an
+identically-seeded engine is the byte-exact reference every streamed
+answer — including ones resumed across a kill — must match.
+
+In-process rather than subprocess on purpose: a killed replica must
+look EXACTLY like a preempted VM from the network's point of view
+(connection refused on new connects, reset on in-flight ones), which
+`_TrackingHTTPServer.sever_all` delivers, while keeping the harness
+fast enough for tier-1 (one tiny-model compile per replica, no
+process spawn/jax re-import per respawn).
+
+Used by `scripts/chaos_smoke.py --multi-replica N` and
+`tests/test_serve_failover.py`.
+"""
+import socket
+import threading
+import time
+from typing import Callable, List, Optional
+
+from skypilot_tpu import logsys
+from skypilot_tpu.infer.engine import InferenceEngine
+from skypilot_tpu.infer.server import (InferenceServer,
+                                       _BurstTolerantHTTPServer,
+                                       _make_handler)
+from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+
+logger = logsys.init_logger(__name__)
+
+
+class _TrackingHTTPServer(_BurstTolerantHTTPServer):
+    """ThreadingHTTPServer that can sever EVERY open connection.
+
+    `shutdown()` only stops accepting; handler threads keep their
+    sockets and finish politely — useless for simulating preemption.
+    This server tracks accepted client sockets so `sever_all()` can
+    close the listener AND reset the in-flight connections, which is
+    what a killed VM looks like from the LB's side.
+    """
+
+    def __init__(self, *args, **kwargs):
+        self._clients_lock = threading.Lock()
+        self._clients: set = set()
+        super().__init__(*args, **kwargs)
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._clients_lock:
+            self._clients.add(sock)
+        return sock, addr
+
+    def shutdown_request(self, request):
+        with self._clients_lock:
+            self._clients.discard(request)
+        super().shutdown_request(request)
+
+    def sever_all(self) -> None:
+        """Close the listener and hard-reset every open client socket."""
+        try:
+            self.socket.close()
+        except OSError:
+            pass
+        with self._clients_lock:
+            clients = list(self._clients)
+            self._clients.clear()
+        for sock in clients:
+            # shutdown(), not close(): the handler thread's
+            # rfile/wfile hold _io_refs on the socket, so close() from
+            # here only decrements a refcount and the fd — and the
+            # connection — would stay open until the handler exits.
+            # shutdown tears the TCP stream down NOW regardless.
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class KillableReplica:
+    """One in-process replica that can be killed and respawned.
+
+    The port is pinned at construction so the replica keeps its URL
+    identity across kill/respawn — the LB's per-replica breaker state
+    keys on the URL, and recovery (half-open probe succeeding against
+    the respawned process) only makes sense at a stable address.
+    """
+
+    def __init__(self, make_engine: Callable[[], InferenceEngine],
+                 port: int, host: str = '127.0.0.1',
+                 tokenizer: Optional[object] = None):
+        self.make_engine = make_engine
+        self.host = host
+        self.port = port
+        self.tokenizer = tokenizer
+        self.server: Optional[InferenceServer] = None
+        self.httpd: Optional[_TrackingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.alive = False
+        self.kills = 0
+
+    @property
+    def url(self) -> str:
+        return f'http://{self.host}:{self.port}'
+
+    def start(self, ready_timeout: float = 120.0) -> None:
+        assert not self.alive, 'start() on a live replica'
+        engine = self.make_engine()
+        srv = InferenceServer(engine, tokenizer=self.tokenizer)
+        srv.start()
+        if not srv.ready.wait(ready_timeout):
+            raise TimeoutError(
+                f'replica :{self.port} never became ready')
+        httpd = _TrackingHTTPServer((self.host, self.port),
+                                    _make_handler(srv))
+        httpd.daemon_threads = True
+        thread = threading.Thread(target=httpd.serve_forever,
+                                  kwargs={'poll_interval': 0.05},
+                                  daemon=True,
+                                  name=f'replica-{self.port}')
+        thread.start()
+        self.server, self.httpd, self._thread = srv, httpd, thread
+        self.alive = True
+
+    def busy(self) -> bool:
+        """True while a generate request is in flight (the interesting
+        moment to kill)."""
+        return self.alive and self.server is not None and \
+            self.server.gen_inflight > 0
+
+    def kill(self) -> None:
+        """Preempt: RST every connection, stop accepting, stop the
+        engine's serving loop.  From the LB's view this is a dead VM."""
+        if not self.alive:
+            return
+        self.alive = False
+        self.kills += 1
+        httpd, srv = self.httpd, self.server
+        self.httpd, self.server = None, None
+        if httpd is not None:
+            # Stop the accept loop BEFORE closing its socket: a closed
+            # fd inside serve_forever's selector raises in that thread
+            # and shutdown() would then wait on a loop that already
+            # died.  Only after shutdown returns is the listener closed
+            # (connects refuse) and every in-flight connection RST.
+            httpd.shutdown()
+            httpd.sever_all()
+        if srv is not None:
+            srv.stop()
+        logger.info('chaos: killed replica :%d', self.port)
+
+    def respawn(self, ready_timeout: float = 120.0) -> None:
+        """Fresh engine + server on the SAME port (recovered VM)."""
+        if self.alive:
+            return
+        self.start(ready_timeout)
+        logger.info('chaos: respawned replica :%d', self.port)
+
+
+def free_port(host: str = '127.0.0.1') -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class ChaosFleet:
+    """N killable replicas behind a standalone SkyTpuLoadBalancer.
+
+    Standalone = `controller_url=None`: the replica set is seeded
+    directly into the policy and stays FIXED across kills — ejection
+    and re-admission of dead/respawned replicas is exactly the
+    breaker/probe machinery under test, not set management.
+    """
+
+    def __init__(self, make_engine: Callable[[], InferenceEngine],
+                 n_replicas: int, policy_name: str = 'least_load',
+                 host: str = '127.0.0.1'):
+        self.replicas = [
+            KillableReplica(make_engine, free_port(host), host=host)
+            for _ in range(n_replicas)
+        ]
+        self.policy = LoadBalancingPolicy.make(policy_name)
+        self.policy.set_ready_replicas([r.url for r in self.replicas])
+        self.lb = SkyTpuLoadBalancer(None, free_port(host), self.policy)
+        self._lb_thread: Optional[threading.Thread] = None
+
+    @property
+    def lb_url(self) -> str:
+        return f'http://127.0.0.1:{self.lb.port}'
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+        self._lb_thread = threading.Thread(target=self.lb.run,
+                                           daemon=True, name='chaos-lb')
+        self._lb_thread.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(
+                        ('127.0.0.1', self.lb.port), timeout=0.2):
+                    return
+            except OSError:
+                time.sleep(0.05)
+        raise TimeoutError('load balancer never came up')
+
+    def live_replicas(self) -> List[KillableReplica]:
+        return [r for r in self.replicas if r.alive]
+
+    def kill_one(self, prefer_busy: bool = True) -> \
+            Optional[KillableReplica]:
+        """Kill one live replica — busy ones first (mid-decode kills
+        are the case under test) — but NEVER the last live one: with
+        zero replicas every request fails by construction and the run
+        proves nothing about failover."""
+        live = self.live_replicas()
+        if len(live) <= 1:
+            return None
+        busy = [r for r in live if r.busy()] if prefer_busy else []
+        victim = busy[0] if busy else live[0]
+        victim.kill()
+        return victim
+
+    def respawn_dead(self) -> None:
+        for r in self.replicas:
+            if not r.alive:
+                r.respawn()
+
+    def stop(self) -> None:
+        self.lb.stop()
+        for r in self.replicas:
+            r.kill()
+
+
+class SeededKiller(threading.Thread):
+    """Consults the plan's ``replica_kill`` site on a fixed tick and
+    kills per its verdicts.  Determinism note: WHICH consult fires is a
+    pure function of (seed, consult index); which replica dies and
+    where its streams were depends on timing — the assertions
+    (byte-identity of every completed answer) are timing-independent,
+    which is the point.
+    """
+
+    def __init__(self, fleet: ChaosFleet, plan, tick_s: float = 0.05):
+        super().__init__(daemon=True, name='chaos-killer')
+        self.fleet = fleet
+        self.plan = plan
+        self.tick_s = tick_s
+        self.kills = 0
+        # NOT named _stop: that would shadow threading.Thread._stop,
+        # which join() calls internally.
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            if self.plan.check('replica_kill') is not None:
+                if self.fleet.kill_one() is not None:
+                    self.kills += 1
+            self._halt.wait(self.tick_s)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=5)

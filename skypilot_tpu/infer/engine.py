@@ -1472,6 +1472,15 @@ class InferenceEngine:
             'pinned': self._radix.pinned if self._radix else 0,
         }
 
+    @property
+    def serving(self) -> bool:
+        """True while the continuous-batching serving loop is alive
+        (generate_stream's supervisor holds its run region).  False
+        before the loop starts, after a clean stop, and — the case the
+        replica /healthz endpoint exists for — after the supervisor
+        gave up on a crash-looping serve loop."""
+        return self._serving
+
     def stats(self) -> Dict[str, Any]:
         """KV-cache accounting (served by /stats).  Everything lives
         under ONE structured 'kv' section — layout, blocks, bytes,
@@ -1497,6 +1506,7 @@ class InferenceEngine:
             }
             return {
                 'kv': kv,
+                'serving': bool(self._serving),
                 # deprecated aliases of kv.*
                 'kv_layout': 'dense',
                 'kv_bytes_total': total * row_bytes,
@@ -1537,6 +1547,7 @@ class InferenceEngine:
         }
         return {
             'kv': kv,
+            'serving': bool(self._serving),
             # deprecated aliases of kv.*
             'kv_layout': 'paged',
             'block_size': bs_,

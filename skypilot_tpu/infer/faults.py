@@ -49,6 +49,14 @@ Sites (where the engine consults the plan):
                     exercise the supervisor (conditioning on active
                     work makes "hit 1" deterministic with respect to
                     request state instead of racing the idle spin)
+``replica_kill``    PROCESS-level site, consulted by the multi-replica
+                    chaos harness (`infer/chaos.py` killer thread, not
+                    the engine): a firing spec kills one live replica —
+                    listener closed, in-flight client sockets severed,
+                    serving loop stopped — to exercise the load
+                    balancer's circuit breaker and mid-stream failover.
+                    The harness never kills the last live replica, and
+                    caps kills with ``max_fires``
 ==================  =====================================================
 
 Injected dispatch faults are raised HOST-SIDE, before the jitted call:
@@ -74,6 +82,7 @@ SITES = (
     'nonfinite_logits',
     'stall',
     'serve_loop',
+    'replica_kill',
 )
 
 

@@ -48,6 +48,14 @@ from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
                                        resolve_cache_dtype)
 
 
+class DrainingError(Exception):
+    """Request refused because the replica is draining (graceful
+    shutdown): it stopped admitting new generate work and is finishing
+    what it already accepted.  The HTTP layer turns this into a 503
+    with Retry-After and an ``X-SkyTpu-Draining: 1`` header so the load
+    balancer retries elsewhere instead of surfacing the 503."""
+
+
 class AdmissionError(Exception):
     """Request shed at admission (TTFT bound exceeded or queue full)."""
 
@@ -128,6 +136,17 @@ class InferenceServer:
         self._recent_ttfts: 'collections.deque' = collections.deque(
             maxlen=16)
         self.shed_count = 0
+        # Graceful drain (POST /drain, SIGTERM): once draining, new
+        # generate requests are refused with 503 + Retry-After while
+        # the ones already in flight run to completion; `drained` fires
+        # when the last one leaves (or the drain deadline passes).
+        # _gen_inflight counts generate-endpoint HTTP requests between
+        # begin_generate/end_generate — the unit a drain must finish.
+        self.draining = threading.Event()
+        self.drained = threading.Event()
+        self._gen_inflight = 0
+        self.drain_refused = 0          # 503s answered while draining
+        self._on_drained = None         # callback once drain completes
 
     def start(self) -> None:
         self._thread.start()
@@ -229,6 +248,102 @@ class InferenceServer:
         remove from the backlog WITHOUT counting a service completion."""
         with self._adm_lock:
             self._awaiting_first.discard(rid)
+
+    # ------------------------------------------------------ graceful drain
+
+    def begin_generate(self) -> bool:
+        """Admit one generate-endpoint HTTP request into the drain
+        accounting; False = draining (caller answers 503)."""
+        with self._adm_lock:
+            if self.draining.is_set():
+                self.drain_refused += 1
+                return False
+            self._gen_inflight += 1
+            return True
+
+    def end_generate(self) -> None:
+        with self._adm_lock:
+            self._gen_inflight = max(0, self._gen_inflight - 1)
+            done = (self.draining.is_set() and self._gen_inflight == 0
+                    and not self.drained.is_set())
+            if done:
+                self.drained.set()
+        if done:
+            self._fire_on_drained()
+
+    @property
+    def gen_inflight(self) -> int:
+        with self._adm_lock:
+            return self._gen_inflight
+
+    def _fire_on_drained(self) -> None:
+        cb = self._on_drained
+        if cb is not None:
+            # Off-thread: the callback typically shuts the HTTP server
+            # down, which must not deadlock against the handler thread
+            # that delivered the last in-flight completion.
+            threading.Thread(target=cb, daemon=True).start()
+
+    def drain(self, deadline_s: Optional[float] = None) -> None:
+        """Stop admitting generate work (503 + Retry-After); finish
+        what is in flight, then fire `drained` (and _on_drained).  With
+        a deadline, `drained` fires after deadline_s even if stragglers
+        remain — the teardown that follows was going to kill them
+        anyway, and a bound drain beats an unbounded wait on a wedged
+        request.  Idempotent."""
+        with self._adm_lock:
+            already = self.draining.is_set()
+            self.draining.set()
+            empty = self._gen_inflight == 0 and not self.drained.is_set()
+            if empty:
+                self.drained.set()
+        if empty:
+            self._fire_on_drained()
+        if already:
+            return
+        if deadline_s is not None and not self.drained.is_set():
+            def watchdog():
+                if not self.drained.wait(deadline_s):
+                    self.drained.set()
+                    self._fire_on_drained()
+            threading.Thread(target=watchdog, daemon=True).start()
+
+    def undrain(self) -> None:
+        """Cancel a drain (tests; an operator changing their mind
+        before teardown).  Admission resumes immediately."""
+        with self._adm_lock:
+            self.draining.clear()
+            self.drained.clear()
+
+    def health(self) -> Dict[str, object]:
+        """The /healthz readiness document: loop-alive / model-ready /
+        draining, derived from the engine's serving flag + stats().
+
+        status: 'ok' (route traffic here), 'starting' (still
+        compiling), 'draining' (finishing in-flight, admit nothing
+        new), 'dead' (the engine's serving-loop supervisor gave up —
+        the process is up but can never answer another generate)."""
+        model_ready = self.ready.is_set()
+        serving = bool(getattr(self.engine, 'serving', True))
+        # Before ready fires the loop has legitimately not started yet;
+        # only a loop that died AFTER readiness means 'dead'.
+        loop_alive = serving or not model_ready
+        if not model_ready:
+            status = 'starting'
+        elif not serving:
+            status = 'dead'
+        elif self.draining.is_set():
+            status = 'draining'
+        else:
+            status = 'ok'
+        return {
+            'status': status,
+            'model_ready': model_ready,
+            'loop_alive': loop_alive,
+            'draining': self.draining.is_set(),
+            'drained': self.drained.is_set(),
+            'inflight': self.gen_inflight,
+        }
 
     _AUTO_PREFIX_MIN = 64        # shortest head worth caching
     _AUTO_PREFIX_TRACKED = 256   # tracked heads (simple size cap)
@@ -548,6 +663,16 @@ def _make_handler(server: InferenceServer):
                     self._json(200, {'status': 'ok'})
                 else:
                     self._json(503, {'status': 'starting'})
+            elif self.path == '/healthz':
+                # Readiness for the LB's active prober: 200 only while
+                # the replica should receive traffic.  'starting',
+                # 'draining' and 'dead' all answer 503 with the full
+                # state document so the prober can tell them apart.
+                doc = server.health()
+                code = 200 if doc['status'] == 'ok' else 503
+                headers = ({'X-SkyTpu-Draining': '1'}
+                           if doc['draining'] else None)
+                self._json(code, doc, extra_headers=headers)
             elif self.path == '/v1/models':
                 name = server.engine.model_config.name
                 rows = [{'id': name, 'object': 'model', 'created': 0,
@@ -566,6 +691,9 @@ def _make_handler(server: InferenceServer):
                     'queue_depth': server._queue.qsize(),
                     'awaiting_first_token': len(server._awaiting_first),
                     'shed_count': server.shed_count,
+                    'draining': server.draining.is_set(),
+                    'gen_inflight': server.gen_inflight,
+                    'drain_refused': server.drain_refused,
                     'spec': dict(eng.spec_stats),
                     # THE structured KV section: layout, blocks, bytes,
                     # prefix + radix caching (hits/hit_rate/
@@ -1087,6 +1215,9 @@ def _make_handler(server: InferenceServer):
             except (BrokenPipeError, ConnectionResetError):
                 pass
 
+        _GENERATE_PATHS = ('/generate', '/generate_text',
+                           '/v1/completions', '/v1/chat/completions')
+
         def do_POST(self):
             try:
                 n = int(self.headers.get('Content-Length', 0))
@@ -1094,11 +1225,46 @@ def _make_handler(server: InferenceServer):
             except (ValueError, json.JSONDecodeError) as e:
                 self._json(400, {'error': str(e)})
                 return
-            if self.path == '/v1/completions':
-                self._openai_generate(payload, chat=False)
+            if self.path == '/drain':
+                # Graceful drain: stop admitting, finish in-flight up
+                # to deadline_s, advertise via /healthz.  cancel=true
+                # reverses an in-progress drain (tests/operators).
+                if payload.get('cancel'):
+                    server.undrain()
+                    self._json(200, server.health())
+                    return
+                try:
+                    deadline = payload.get('deadline_s')
+                    deadline = (None if deadline is None
+                                else float(deadline))
+                except (TypeError, ValueError) as e:
+                    self._json(400, {'error': f'bad field: {e}'})
+                    return
+                if deadline is not None and deadline <= 0:
+                    self._json(400, {'error': 'deadline_s must be > 0'})
+                    return
+                server.drain(deadline)
+                self._json(200, server.health())
                 return
-            if self.path == '/v1/chat/completions':
-                self._openai_generate(payload, chat=True)
+            if self.path in self._GENERATE_PATHS:
+                # Drain gate: a draining replica admits nothing new.
+                # The 503 carries Retry-After + X-SkyTpu-Draining so
+                # the LB treats it as retry-elsewhere, never a failure.
+                if not server.begin_generate():
+                    self._json(503, {'error': 'replica draining',
+                                     'draining': True},
+                               extra_headers={'Retry-After': '1',
+                                              'X-SkyTpu-Draining': '1'})
+                    return
+                try:
+                    if self.path == '/v1/completions':
+                        self._openai_generate(payload, chat=False)
+                    elif self.path == '/v1/chat/completions':
+                        self._openai_generate(payload, chat=True)
+                    else:
+                        self._native_generate(payload)
+                finally:
+                    server.end_generate()
                 return
             if self.path == '/load_adapter':
                 # Multi-LoRA: load a trained adapter artifact (.npz from
@@ -1167,12 +1333,15 @@ def _make_handler(server: InferenceServer):
                     return
                 self._json(200, {'cached_prefix_len': n})
                 return
+            self._json(404, {'error': 'not found'})
+
+        def _native_generate(self, payload: dict) -> None:
             if self.path == '/generate':
                 tokens = payload.get('tokens')
                 if not isinstance(tokens, list) or not tokens:
                     self._json(400, {'error': '"tokens" list required'})
                     return
-            elif self.path == '/generate_text':
+            else:   # /generate_text
                 if server.tokenizer is None:
                     self._json(400, {'error': 'no tokenizer configured'})
                     return
@@ -1180,9 +1349,6 @@ def _make_handler(server: InferenceServer):
                 if not tokens:
                     self._json(400, {'error': 'empty prompt'})
                     return
-            else:
-                self._json(404, {'error': 'not found'})
-                return
             # Validate types HERE: a malformed field must become a 400,
             # never an exception inside the engine thread.
             try:
@@ -1269,6 +1435,24 @@ def serve(engine: InferenceEngine, host: str = '0.0.0.0', port: int = 8100,
                           auto_prefix=auto_prefix)
     srv.start()
     httpd = _BurstTolerantHTTPServer((host, port), _make_handler(srv))
+    # Graceful drain exit: once a drain (POST /drain or SIGTERM)
+    # finishes its in-flight work, shut the listener down and return —
+    # the process exits cleanly.  (_fire_on_drained already runs the
+    # callback off-thread, so shutdown() cannot deadlock against the
+    # handler thread that delivered the last completion.)
+    srv._on_drained = httpd.shutdown
+
+    def _sigterm(signum, frame):  # pylint: disable=unused-argument
+        # Preemption notice: stop admitting (503 + Retry-After), finish
+        # in-flight up to the drain timeout, then exit.
+        from skypilot_tpu.serve import constants as serve_constants
+        srv.drain(serve_constants.drain_timeout())
+
+    import signal
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass   # not the main thread (embedded/test use): no signal hook
     try:
         httpd.serve_forever()
     finally:

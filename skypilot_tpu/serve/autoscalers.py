@@ -39,6 +39,11 @@ class ReplicaView:
     status: ReplicaStatus
     version: int
     is_spot: bool
+    # Fed from the LB's sync payload (replica_draining/replica_inflight)
+    # so scale-down prefers replicas that are already draining and
+    # avoids killing in-flight work.
+    draining: bool = False
+    inflight: int = 0
 
     @property
     def alive(self) -> bool:
@@ -95,13 +100,19 @@ class Autoscaler:
 
 def _scale_down_order(replicas: List[ReplicaView],
                       latest_version: int) -> List[ReplicaView]:
-    """Prefer terminating old versions, then unready, then newest-launched
-    (parity: sky/serve/autoscalers.py:285,317)."""
+    """Prefer terminating old versions, then already-draining, then
+    unready, then least-loaded, then newest-launched (parity:
+    sky/serve/autoscalers.py:285,317).  Draining/inflight default to
+    False/0, reducing to the classic order when the controller has no
+    LB load data."""
 
     def key(r: ReplicaView):
         return (
             r.version >= latest_version,            # old versions first
+            not r.draining,                         # draining first:
+                                                    # already off rotation
             r.status == ReplicaStatus.READY,        # unready before ready
+            r.inflight,                             # idle before loaded
             -r.replica_id,                          # newest first
         )
 

@@ -1,0 +1,113 @@
+"""Per-replica circuit breaker for the load balancer.
+
+closed -> open -> half-open, the classic shape:
+
+- **closed**: traffic flows; consecutive connection-level failures are
+  counted.  At ``failure_threshold`` the breaker OPENS.
+- **open**: the replica is ejected from routing for a backoff window
+  (exponential in the number of consecutive opens, jittered so a fleet
+  of LBs doesn't re-probe a recovering replica in lockstep).
+- **half-open**: once the window elapses, ``available()`` turns true
+  again — the next probe/request is the trial.  Success closes the
+  breaker (backoff resets); failure re-opens it with a doubled window.
+
+Only CONNECTION-level failures (refused, reset, timeout) count: any
+HTTP response — including a 404 from a replica that doesn't implement
+/healthz — proves a live process, so application-level status never
+opens the breaker.  That keeps the LB safe in front of plain HTTP
+replicas (the e2e tests serve `python3 -m http.server`).
+
+Deterministic by construction: the clock and the jitter RNG are
+injected, so tests drive every transition without a single sleep.
+"""
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class CircuitBreaker:
+
+    CLOSED = 'closed'
+    OPEN = 'open'
+    HALF_OPEN = 'half_open'
+
+    def __init__(self,
+                 failure_threshold: int = 2,
+                 base_backoff_s: float = 1.0,
+                 max_backoff_s: float = 30.0,
+                 jitter_frac: float = 0.2,
+                 now: Callable[[], float] = time.monotonic,
+                 rng: Optional[np.random.Generator] = None):
+        if failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1')
+        self.failure_threshold = failure_threshold
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.jitter_frac = jitter_frac
+        self._now = now
+        self._rng = rng if rng is not None else np.random.default_rng(0)
+        self._lock = threading.Lock()
+        self._failures = 0          # consecutive, while closed
+        self._opens = 0             # consecutive opens (backoff exponent)
+        self._open_until: Optional[float] = None   # None = closed
+        self.open_count = 0         # lifetime opens (LB /lb/stats)
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._open_until is None:
+                return self.CLOSED
+            if self._now() >= self._open_until:
+                return self.HALF_OPEN
+            return self.OPEN
+
+    def available(self) -> bool:
+        """True when the replica may receive traffic: closed, or open
+        with the backoff elapsed (half-open trial)."""
+        with self._lock:
+            return (self._open_until is None or
+                    self._now() >= self._open_until)
+
+    # ----------------------------------------------------------- outcomes
+
+    def record_success(self) -> None:
+        """Any HTTP response (probe or proxied request reached the
+        replica): close the breaker, reset failures and backoff."""
+        with self._lock:
+            self._failures = 0
+            self._opens = 0
+            self._open_until = None
+
+    def record_failure(self) -> None:
+        """A connection-level failure (refused/reset/timeout).  While
+        closed, counts toward the threshold; in half-open, re-opens
+        immediately with a doubled window."""
+        with self._lock:
+            if self._open_until is not None:
+                if self._now() >= self._open_until:
+                    # Half-open trial failed: re-open, doubled window.
+                    self._trip()
+                # Still open: probes/stragglers hitting a known-dead
+                # replica add no information — re-arming here would
+                # double the backoff per PROBE instead of per trial
+                # and inflate open_count.
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._trip()
+
+    def _trip(self) -> None:
+        """(Caller holds the lock.)  Open with exponential backoff +
+        jitter: window = base * 2^opens * (1 +- jitter_frac)."""
+        backoff = min(self.max_backoff_s,
+                      self.base_backoff_s * (2.0 ** self._opens))
+        jitter = 1.0 + self.jitter_frac * (
+            2.0 * float(self._rng.random()) - 1.0)
+        self._open_until = self._now() + backoff * jitter
+        self._opens += 1
+        self._failures = 0
+        self.open_count += 1

@@ -38,6 +38,19 @@ def lb_sync_interval() -> float:
     return _f('SKYTPU_SERVE_LB_SYNC_INTERVAL', 20.0)
 
 
+def lb_health_probe_interval() -> float:
+    """Load balancer's ACTIVE /healthz probe interval.  Much shorter
+    than the controller sync: a dead replica is ejected from routing in
+    probe-time (seconds) instead of controller-sync-time."""
+    return _f('SKYTPU_SERVE_LB_PROBE_INTERVAL', 2.0)
+
+
+def drain_timeout() -> float:
+    """How long a draining replica gets to finish in-flight requests
+    before teardown proceeds anyway."""
+    return _f('SKYTPU_SERVE_DRAIN_TIMEOUT', 60.0)
+
+
 def job_status_interval() -> float:
     return _f('SKYTPU_SERVE_JOB_STATUS_INTERVAL', 30.0)
 

@@ -43,6 +43,11 @@ class ServeController:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._last_probe = 0.0
         self._last_cluster_check = 0.0
+        # Last LB-reported per-replica load view (endpoint-url keyed),
+        # folded into the autoscaler's ReplicaViews each tick.
+        self._lb_lock = threading.Lock()
+        self._lb_inflight: dict = {}
+        self._lb_draining: set = set()
 
     # ----------------------------------------------------------- HTTP API
 
@@ -50,10 +55,22 @@ class ServeController:
         if path == '/controller/load_balancer_sync':
             ts: List[float] = payload.get('request_timestamps', [])
             self.autoscaler.collect_request_information(ts)
+            inflight = payload.get('replica_inflight')
+            draining = payload.get('replica_draining')
+            if isinstance(inflight, dict) or isinstance(draining, list):
+                with self._lb_lock:
+                    if isinstance(inflight, dict):
+                        self._lb_inflight = {
+                            str(k): int(v) for k, v in inflight.items()
+                            if isinstance(v, (int, float))}
+                    if isinstance(draining, list):
+                        self._lb_draining = {str(u) for u in draining}
             return {
                 'ready_replica_urls':
                     serve_state.ready_replica_endpoints(self.service_name)
             }
+        if path == '/controller/state':
+            return self.state_snapshot()
         if path == '/controller/update_service':
             spec = SkyTpuServiceSpec.from_json(payload['spec'])
             task_yaml = payload['task_yaml']
@@ -96,6 +113,30 @@ class ServeController:
             return {'terminated': rid}
         raise KeyError(path)
 
+    def state_snapshot(self) -> dict:
+        """Per-replica failure-counter block for observability: replica
+        identity + probe failure count + the LB-reported load/drain
+        view (matches the LB's /lb/stats on the other side)."""
+        with self._lb_lock:
+            lb_inflight = dict(self._lb_inflight)
+            lb_draining = set(self._lb_draining)
+        replicas = []
+        for r in serve_state.get_replicas(self.service_name):
+            endpoint = r.get('endpoint')
+            replicas.append({
+                'replica_id': r['replica_id'],
+                'status': r['status'],
+                'version': r['version'],
+                'is_spot': bool(r['is_spot']),
+                'endpoint': endpoint,
+                'consecutive_failures': r.get('consecutive_failures', 0),
+                'failure_reason': r.get('failure_reason'),
+                'inflight': lb_inflight.get(endpoint, 0),
+                'draining': endpoint in lb_draining,
+            })
+        return {'service': self.service_name, 'version': self.version,
+                'replicas': replicas}
+
     def _serve_http(self) -> None:
         controller = self
 
@@ -123,6 +164,18 @@ class ServeController:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_GET(self):  # noqa: N802
+                if self.path.split('?', 1)[0] == '/controller/state':
+                    body = json.dumps(controller.state_snapshot()).encode()
+                    self.send_response(200)
+                else:
+                    body = b'{"error": "not found"}'
+                    self.send_response(404)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
         self._httpd = ThreadingHTTPServer(('0.0.0.0', self.port), Handler)
         self._httpd.daemon_threads = True
         self._httpd.serve_forever(poll_interval=0.2)
@@ -140,12 +193,17 @@ class ServeController:
             self._last_cluster_check = now
             self.replica_manager.check_replica_clusters()
 
+        with self._lb_lock:
+            lb_inflight = dict(self._lb_inflight)
+            lb_draining = set(self._lb_draining)
         replicas = [
             autoscalers.ReplicaView(
                 replica_id=r['replica_id'],
                 status=ReplicaStatus(r['status']),
                 version=r['version'],
                 is_spot=bool(r['is_spot']),
+                draining=r.get('endpoint') in lb_draining,
+                inflight=lb_inflight.get(r.get('endpoint'), 0),
             ) for r in serve_state.get_replicas(self.service_name)
         ]
         update_in_progress = any(

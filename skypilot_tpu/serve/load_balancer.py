@@ -4,6 +4,38 @@ Parity: sky/serve/load_balancer.py:22-229 (FastAPI/httpx reverse proxy
 with controller sync + retry across replicas).  Built on stdlib
 ThreadingHTTPServer + http.client so replica responses stream through in
 chunks (LLM serving needs streaming) without extra dependencies.
+
+Replica fault tolerance (the supervisor half of the proxy):
+
+- **Active health probing + circuit breaking.**  A probe thread GETs
+  every known replica's ``/healthz`` on a short interval
+  (`constants.lb_health_probe_interval`).  Connection-level failures
+  trip a per-replica closed→open→half-open breaker (exponential
+  backoff + jitter, `circuit_breaker.CircuitBreaker`), ejecting dead
+  replicas from routing in probe-time instead of controller-sync-time;
+  a later successful probe closes the breaker, re-admitting the
+  replica just as fast.  Any HTTP response proves a live process —
+  only refused/reset/timeout (or an explicit ``status: dead`` healthz
+  document) count against the breaker, so plain HTTP replicas without
+  /healthz keep working.
+- **Drain honoring.**  A replica advertising ``draining`` (via
+  /healthz, or a 503 + ``X-SkyTpu-Draining`` answer) stops receiving
+  new requests while its in-flight work finishes.
+- **Deterministic mid-stream failover.**  A ``/generate`` SSE stream
+  whose replica dies mid-decode is RESUMED on a survivor: the LB
+  reconstructs a continuation request from the prompt plus the tokens
+  already relayed and stitches the survivor's events into the same
+  client stream (greedy decoding makes the replay byte-identical).
+  Sampled (temperature>0) or unbounded (no max_new_tokens) streams are
+  non-resumable: once tokens have been relayed, a replica death fails
+  them FAST with a typed error event instead of a silent truncation.
+- **Deadline budget.**  A request's ``deadline_s`` bounds the replica
+  connection timeout (instead of the blanket 120 s) and decrements
+  across failover attempts, so replay can never exceed the client's
+  original deadline.
+
+``GET /lb/stats`` exports the counters (attempts, failovers, breaker
+opens, drains honored, streams resumed).
 """
 import json
 import socket
@@ -11,12 +43,16 @@ import threading
 import time
 import urllib.parse
 import urllib.request
-from http.client import HTTPConnection
+import zlib
+from http.client import HTTPConnection, HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import Dict, List, Optional
+
+import numpy as np
 
 from skypilot_tpu import logsys
 from skypilot_tpu.serve import constants
+from skypilot_tpu.serve.circuit_breaker import CircuitBreaker
 from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 
 logger = logsys.init_logger(__name__)
@@ -26,12 +62,90 @@ _HOP_BY_HOP = {
     'proxy-authorization', 'te', 'trailers', 'transfer-encoding', 'upgrade'
 }
 _MAX_ATTEMPTS = 3
+_DEFAULT_REPLICA_TIMEOUT = 120.0
+_PROBE_TIMEOUT = 2.0
+
+
+class _ClientGone(Exception):
+    """The downstream client hung up; abandon the whole request."""
+
+
+class _ReplicaHealth:
+    """LB-side view of one replica: breaker + drain flag + load."""
+
+    __slots__ = ('breaker', 'draining', 'outstanding')
+
+    def __init__(self, breaker: CircuitBreaker):
+        self.breaker = breaker
+        self.draining = False
+        self.outstanding = 0
+
+
+class _SSERelay:
+    """One client-facing SSE stream, possibly stitched across replicas.
+
+    Forwards complete `data: ...\\n\\n` events RAW (a stream that never
+    fails over is byte-identical to talking to the replica directly);
+    tracks the token ids relayed so a failover can reconstruct the
+    continuation, and rewrites only the final done event — and only
+    after an actual failover — so the client sees one uninterrupted
+    stream whose `output_tokens` covers the whole generation.
+    """
+
+    def __init__(self, handler: BaseHTTPRequestHandler):
+        self.handler = handler
+        self.headers_sent = False
+        self.streamed: List[int] = []   # token ids relayed to the client
+        self.chunks_forwarded = 0
+        self.resumed = False            # a continuation attempt ran
+
+    def send_headers(self, resp) -> None:
+        if self.headers_sent:
+            return
+        h = self.handler
+        h.send_response(resp.status, resp.reason)
+        for k, v in resp.getheaders():
+            if k.lower() not in _HOP_BY_HOP and \
+                    k.lower() != 'content-length':
+                h.send_header(k, v)
+        # SSE is close-delimited through the proxy.
+        h.send_header('Connection', 'close')
+        h.close_connection = True
+        h.end_headers()
+        self.headers_sent = True
+
+    def forward(self, raw: bytes) -> None:
+        try:
+            self.handler.wfile.write(raw)
+            self.handler.wfile.flush()
+        except (OSError, socket.timeout) as e:
+            raise _ClientGone() from e
+
+    def emit_event(self, payload: dict) -> None:
+        self.forward(b'data: ' + json.dumps(payload).encode() + b'\n\n')
+
+    def emit_error_event(self, message: str, error_class: str) -> None:
+        """Typed terminal event for a stream the LB cannot resume."""
+        try:
+            self.emit_event({
+                'done': True,
+                'error': message,
+                'error_class': error_class,
+                'finish_reason': 'error',
+                'output_tokens': list(self.streamed),
+                'ttft_s': 0.0, 'latency_s': 0.0,
+            })
+        except _ClientGone:
+            pass
 
 
 class SkyTpuLoadBalancer:
 
-    def __init__(self, controller_url: str, port: int,
+    def __init__(self, controller_url: Optional[str], port: int,
                  policy: LoadBalancingPolicy):
+        """controller_url=None: standalone mode (tests, the chaos
+        harness) — no controller sync; the caller seeds the policy's
+        replica set directly."""
         self.controller_url = controller_url
         self.port = port
         self.policy = policy
@@ -39,6 +153,111 @@ class SkyTpuLoadBalancer:
         self._ts_lock = threading.Lock()
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # Per-replica health: breaker + draining + outstanding count.
+        self._health_lock = threading.Lock()
+        self._health: Dict[str, _ReplicaHealth] = {}
+        self._stats_lock = threading.Lock()
+        self._counters = {
+            'requests': 0,
+            'attempts': 0,
+            'failovers': 0,
+            'streams_resumed': 0,
+            'drains_honored': 0,
+            'non_resumable_failures': 0,
+            'deadline_exhausted': 0,
+            'probe_failures': 0,
+        }
+
+    # ----------------------------------------------------- health/breakers
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def _rep(self, url: str) -> _ReplicaHealth:
+        with self._health_lock:
+            h = self._health.get(url)
+            if h is None:
+                # Seed the jitter stream from the URL so a given fleet
+                # lays out backoff deterministically run-over-run.
+                h = _ReplicaHealth(CircuitBreaker(
+                    rng=np.random.default_rng(
+                        zlib.crc32(url.encode()) & 0xffffffff)))
+                self._health[url] = h
+            return h
+
+    def _mark_draining(self, url: str, draining: bool) -> None:
+        h = self._rep(url)
+        with self._health_lock:
+            if draining and not h.draining:
+                self._bump('drains_honored')
+            h.draining = draining
+
+    def _adjust_outstanding(self, url: str, delta: int) -> None:
+        h = self._rep(url)
+        with self._health_lock:
+            h.outstanding = max(0, h.outstanding + delta)
+
+    def _routing_exclude(self, tried) -> set:
+        """Replicas a select must skip: already tried this request,
+        breaker open, or draining."""
+        ex = set(tried)
+        with self._health_lock:
+            for url, h in self._health.items():
+                if h.draining or not h.breaker.available():
+                    ex.add(url)
+        return ex
+
+    def _probe_replica_once(self, url: str) -> None:
+        h = self._rep(url)
+        parsed = urllib.parse.urlsplit(url)
+        conn = HTTPConnection(parsed.hostname, parsed.port,
+                              timeout=_PROBE_TIMEOUT)
+        try:
+            conn.request('GET', '/healthz',
+                         headers={'Host': parsed.netloc,
+                                  'Connection': 'close'})
+            resp = conn.getresponse()
+            body = resp.read()
+            status = resp.status
+        except (OSError, socket.timeout, HTTPException):
+            h.breaker.record_failure()
+            self._bump('probe_failures')
+            return
+        finally:
+            conn.close()
+        doc = None
+        try:
+            doc = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            pass
+        if not isinstance(doc, dict) or 'status' not in doc:
+            # Not a /healthz speaker (404 from a plain HTTP replica):
+            # any response proves the process is alive.
+            h.breaker.record_success()
+            self._mark_draining(url, False)
+            return
+        state = doc.get('status')
+        self._mark_draining(url, bool(doc.get('draining')) or
+                            state == 'draining')
+        if status == 200 or state in ('ok', 'draining'):
+            # 'draining' is alive (it is finishing real work) — the
+            # drain flag, not the breaker, keeps traffic away.
+            h.breaker.record_success()
+        else:
+            # Explicit 'dead' (serving loop gave up) or 'starting':
+            # a live process that cannot serve is ejected like a dead
+            # one, recovering through the same half-open path.
+            h.breaker.record_failure()
+            self._bump('probe_failures')
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            for url in list(self.policy.ready_replicas):
+                if self._stop.is_set():
+                    return
+                self._probe_replica_once(url)
+            self._stop.wait(constants.lb_health_probe_interval())
 
     # ------------------------------------------------------ controller sync
 
@@ -46,7 +265,13 @@ class SkyTpuLoadBalancer:
         with self._ts_lock:
             timestamps, self._request_timestamps = (
                 self._request_timestamps, [])
-        body = json.dumps({'request_timestamps': timestamps}).encode()
+        with self._health_lock:
+            inflight = {u: h.outstanding for u, h in self._health.items()}
+            draining = sorted(u for u, h in self._health.items()
+                              if h.draining)
+        body = json.dumps({'request_timestamps': timestamps,
+                           'replica_inflight': inflight,
+                           'replica_draining': draining}).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',
             data=body, headers={'Content-Type': 'application/json'})
@@ -70,17 +295,34 @@ class SkyTpuLoadBalancer:
         with self._ts_lock:
             self._request_timestamps.append(time.time())
 
+    @staticmethod
+    def _attempt_timeout(remaining: Optional[float]) -> float:
+        """Replica connection timeout for one attempt: the client's
+        remaining deadline budget when one exists, else the blanket
+        default."""
+        if remaining is None:
+            return _DEFAULT_REPLICA_TIMEOUT
+        return max(0.05, min(_DEFAULT_REPLICA_TIMEOUT, remaining))
+
+    @staticmethod
+    def _is_draining_response(resp) -> bool:
+        return (resp.status == 503 and
+                resp.getheader('X-SkyTpu-Draining') is not None)
+
     def _proxy_once(self, handler: BaseHTTPRequestHandler, replica: str,
                     body: Optional[bytes],
-                    forward_shed: bool = True) -> str:
+                    forward_shed: bool = True,
+                    timeout: float = _DEFAULT_REPLICA_TIMEOUT) -> str:
         """Stream one request to one replica.  Returns 'unreachable'
         (retryable: nothing forwarded), 'shed' (replica answered 429 at
         admission and forward_shed is False — nothing forwarded, safe to
-        retry elsewhere since the replica did no work), or 'ok' (a
-        response line has been forwarded; errors past that point are no
-        longer retryable)."""
+        retry elsewhere since the replica did no work), 'draining' (503
+        + X-SkyTpu-Draining: the replica refuses new work — retry
+        elsewhere), or 'ok' (a response line has been forwarded; errors
+        past that point are no longer retryable)."""
         parsed = urllib.parse.urlsplit(replica)
-        conn = HTTPConnection(parsed.hostname, parsed.port, timeout=120)
+        conn = HTTPConnection(parsed.hostname, parsed.port,
+                              timeout=timeout)
         headers = {
             k: v for k, v in handler.headers.items()
             if k.lower() not in _HOP_BY_HOP and k.lower() != 'host'
@@ -97,6 +339,10 @@ class SkyTpuLoadBalancer:
         if resp.status == 429 and not forward_shed:
             conn.close()
             return 'shed'
+        if self._is_draining_response(resp):
+            conn.close()
+            self._mark_draining(replica, True)
+            return 'draining'
         try:
             handler.send_response(resp.status, resp.reason)
             has_length = False
@@ -128,30 +374,296 @@ class SkyTpuLoadBalancer:
             conn.close()
         return 'ok'
 
+    # --------------------------------------------- generate request routing
+
+    @staticmethod
+    def _parse_generate(path: str, command: str,
+                        body: Optional[bytes]) -> Optional[dict]:
+        """Classify a request for the failover-aware generate paths.
+
+        Returns None for anything that is not a native generate POST
+        with a JSON object body (those take the raw passthrough), else
+        a route dict: payload, stream, deadline_s, and `resumable` —
+        True only for token-prompt greedy bounded /generate streams,
+        the combination whose continuation is reconstructible AND
+        byte-deterministic."""
+        if command != 'POST' or path.split('?', 1)[0] not in (
+                '/generate', '/generate_text'):
+            return None
+        try:
+            payload = json.loads(body or b'{}')
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        deadline = payload.get('deadline_s')
+        deadline = (float(deadline)
+                    if isinstance(deadline, (int, float)) and deadline > 0
+                    else None)
+        tokens = payload.get('tokens')
+        max_new = payload.get('max_new_tokens')
+        try:
+            temperature = float(payload.get('temperature', 0.0))
+        except (TypeError, ValueError):
+            temperature = None
+        resumable = (
+            path.split('?', 1)[0] == '/generate' and
+            bool(payload.get('stream')) and
+            temperature == 0.0 and
+            isinstance(tokens, list) and
+            all(isinstance(t, int) for t in tokens) and
+            isinstance(max_new, int) and max_new > 0
+        )
+        return {'payload': payload, 'stream': bool(payload.get('stream')),
+                'deadline_s': deadline, 'resumable': resumable,
+                'path': path}
+
+    @staticmethod
+    def _replica_headers(replica: str) -> Dict[str, str]:
+        parsed = urllib.parse.urlsplit(replica)
+        return {'Host': parsed.netloc, 'Connection': 'close',
+                'Content-Type': 'application/json'}
+
+    def _proxy_buffered_once(self, handler, replica: str, path: str,
+                             payload: dict, timeout: float) -> str:
+        """Non-stream generate: the replica response is FULLY buffered
+        before anything is forwarded, so a replica dying mid-body stays
+        retryable.  Returns 'done' | 'unreachable' | 'broken' | 'shed'
+        | 'draining'."""
+        parsed = urllib.parse.urlsplit(replica)
+        conn = HTTPConnection(parsed.hostname, parsed.port,
+                              timeout=timeout)
+        body = json.dumps(payload).encode()
+        try:
+            conn.request('POST', path, body=body,
+                         headers=self._replica_headers(replica))
+            resp = conn.getresponse()
+        except (OSError, socket.timeout):
+            conn.close()
+            return 'unreachable'
+        try:
+            if resp.status == 429:
+                return 'shed'
+            if self._is_draining_response(resp):
+                self._mark_draining(replica, True)
+                return 'draining'
+            try:
+                data = resp.read()
+            except (OSError, socket.timeout, HTTPException):
+                return 'broken'
+            declared = resp.getheader('Content-Length')
+            if declared is not None and len(data) < int(declared):
+                return 'broken'   # close-truncated body: retry elsewhere
+        finally:
+            conn.close()
+        try:
+            handler.send_response(resp.status, resp.reason)
+            for k, v in resp.getheaders():
+                if k.lower() not in _HOP_BY_HOP and \
+                        k.lower() != 'content-length':
+                    handler.send_header(k, v)
+            handler.send_header('Content-Length', str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data)
+            handler.wfile.flush()
+        except (OSError, socket.timeout):
+            pass   # client went away; nothing left to do
+        return 'done'
+
+    def _proxy_stream_once(self, replica: str, path: str, payload: dict,
+                           relay: _SSERelay, timeout: float) -> str:
+        """One SSE generate attempt against one replica, relaying
+        complete events through `relay`.  Returns 'done' (final event
+        forwarded), 'broken' (stream ended without one — failover
+        material), 'unreachable', 'shed', 'draining', 'failed' (replica
+        rejected a continuation — not retryable), or 'client_gone'."""
+        parsed = urllib.parse.urlsplit(replica)
+        conn = HTTPConnection(parsed.hostname, parsed.port,
+                              timeout=timeout)
+        body = json.dumps(payload).encode()
+        try:
+            conn.request('POST', path, body=body,
+                         headers=self._replica_headers(replica))
+            resp = conn.getresponse()
+        except (OSError, socket.timeout):
+            conn.close()
+            return 'unreachable'
+        try:
+            if resp.status == 429:
+                return 'shed'
+            if self._is_draining_response(resp):
+                self._mark_draining(replica, True)
+                return 'draining'
+            if resp.status != 200:
+                if relay.headers_sent:
+                    # A continuation was rejected (4xx/5xx): the stream
+                    # cannot be resumed here or anywhere.
+                    return 'failed'
+                data = resp.read()
+                try:
+                    relay.handler.send_response(resp.status, resp.reason)
+                    for k, v in resp.getheaders():
+                        if k.lower() not in _HOP_BY_HOP and \
+                                k.lower() != 'content-length':
+                            relay.handler.send_header(k, v)
+                    relay.handler.send_header('Content-Length',
+                                              str(len(data)))
+                    relay.handler.end_headers()
+                    relay.handler.wfile.write(data)
+                except (OSError, socket.timeout):
+                    return 'client_gone'
+                return 'done'
+            relay.send_headers(resp)
+            buf = b''
+            while True:
+                try:
+                    chunk = resp.read1(64 * 1024)
+                except (OSError, socket.timeout, HTTPException):
+                    return 'broken'
+                if not chunk:
+                    # EOF: a trailing partial event (no final \n\n) is
+                    # NOT forwarded — failover re-produces it whole.
+                    return 'broken'
+                buf += chunk
+                while b'\n\n' in buf:
+                    event, buf = buf.split(b'\n\n', 1)
+                    raw = event + b'\n\n'
+                    obj = self._parse_sse_event(event)
+                    if obj is not None and obj.get('done'):
+                        if relay.resumed:
+                            # Stitched stream: the survivor's final
+                            # event covers only its continuation —
+                            # rewrite output_tokens to the whole
+                            # generation the client actually received.
+                            obj['output_tokens'] = list(relay.streamed)
+                            obj['resumed'] = True
+                            relay.emit_event(obj)
+                        else:
+                            relay.forward(raw)
+                        return 'done'
+                    if obj is not None and \
+                            isinstance(obj.get('tokens'), list):
+                        relay.streamed.extend(
+                            int(t) for t in obj['tokens'])
+                    relay.forward(raw)
+                    relay.chunks_forwarded += 1
+        except _ClientGone:
+            return 'client_gone'
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _parse_sse_event(event: bytes) -> Optional[dict]:
+        for line in event.split(b'\n'):
+            if line.startswith(b'data: '):
+                try:
+                    obj = json.loads(line[len(b'data: '):])
+                except (ValueError, UnicodeDecodeError):
+                    return None
+                return obj if isinstance(obj, dict) else None
+        return None
+
+    def _continuation_payload(self, route: dict,
+                              relay: _SSERelay,
+                              remaining: Optional[float]) -> dict:
+        orig = route['payload']
+        cont = dict(orig)
+        cont['tokens'] = list(orig['tokens']) + list(relay.streamed)
+        cont['max_new_tokens'] = orig['max_new_tokens'] - \
+            len(relay.streamed)
+        if remaining is not None:
+            cont['deadline_s'] = remaining
+        return cont
+
+    # ------------------------------------------------------ request handler
+
     def handle_request(self, handler: BaseHTTPRequestHandler) -> None:
+        if handler.path.split('?', 1)[0] == '/lb/stats' and \
+                handler.command == 'GET':
+            self._serve_lb_stats(handler)
+            return
         self._record_request()
+        self._bump('requests')
         length = int(handler.headers.get('Content-Length', 0) or 0)
         body = handler.rfile.read(length) if length else None
+        route = self._parse_generate(handler.path, handler.command, body)
+        if route is None:
+            self._handle_passthrough(handler, body)
+        elif route['stream']:
+            self._handle_stream_generate(handler, route)
+        else:
+            self._handle_buffered_generate(handler, route)
+
+    def _deadline_clock(self, route: Optional[dict]):
+        """Returns remaining() -> Optional[float]: the client's unspent
+        deadline budget, decremented across every attempt."""
+        deadline = route['deadline_s'] if route else None
+        t0 = time.monotonic()
+
+        def remaining() -> Optional[float]:
+            if deadline is None:
+                return None
+            return deadline - (time.monotonic() - t0)
+
+        return remaining
+
+    def _send_json(self, handler, code: int, payload: dict,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        try:
+            msg = json.dumps(payload).encode()
+            handler.send_response(code)
+            handler.send_header('Content-Type', 'application/json')
+            handler.send_header('Content-Length', str(len(msg)))
+            for k, v in (headers or {}).items():
+                handler.send_header(k, v)
+            handler.end_headers()
+            handler.wfile.write(msg)
+        except (OSError, socket.timeout):
+            pass
+
+    def _no_replica_response(self, handler, deadline_spent: bool) -> None:
+        if deadline_spent:
+            self._bump('deadline_exhausted')
+            self._send_json(handler, 504, {
+                'error': 'deadline_s exhausted before any replica '
+                         'completed the request'})
+        else:
+            self._send_json(handler, 503,
+                            {'error': 'no ready replicas'})
+
+    def _handle_passthrough(self, handler, body: Optional[bytes]) -> None:
+        """The original streaming proxy: raw byte relay (OpenAI SSE
+        framing passes through untouched), retry only while nothing has
+        been forwarded."""
         tried = set()
         shed_replica = None
         for _ in range(_MAX_ATTEMPTS):
-            replica = self.policy.select_replica()
-            if replica is None or replica in tried:
+            replica = self.policy.select_replica(
+                exclude=self._routing_exclude(tried))
+            if replica is None:
                 break
             tried.add(replica)
+            self._bump('attempts')
+            self._adjust_outstanding(replica, 1)
             try:
                 outcome = self._proxy_once(handler, replica, body,
                                            forward_shed=False)
                 if outcome == 'ok':
+                    self._rep(replica).breaker.record_success()
                     return
                 if outcome == 'shed':
                     # Admission-shed: the replica did no work — another
                     # replica may have headroom.
+                    self._rep(replica).breaker.record_success()
                     shed_replica = replica
                     continue
+                if outcome == 'draining':
+                    continue
+                self._rep(replica).breaker.record_failure()
                 logger.warning('LB: replica %s unreachable, retrying',
                                replica)
             finally:
+                self._adjust_outstanding(replica, -1)
                 self.policy.request_done(replica)
         if shed_replica is not None:
             # Every candidate shed: surface the 429 (+ Retry-After) to
@@ -163,12 +675,194 @@ class SkyTpuLoadBalancer:
             if self._proxy_once(handler, shed_replica, body,
                                 forward_shed=True) == 'ok':
                 return
-        handler.send_response(503)
-        msg = b'{"error": "no ready replicas"}'
-        handler.send_header('Content-Type', 'application/json')
-        handler.send_header('Content-Length', str(len(msg)))
-        handler.end_headers()
-        handler.wfile.write(msg)
+        self._no_replica_response(handler, deadline_spent=False)
+
+    def _handle_buffered_generate(self, handler, route: dict) -> None:
+        """Non-stream generate: buffered relay makes a replica death at
+        ANY point retryable — nothing reaches the client until the
+        replica's full response is in hand."""
+        remaining = self._deadline_clock(route)
+        tried = set()
+        shed_replica = None
+        had_break = False
+        for _ in range(_MAX_ATTEMPTS):
+            left = remaining()
+            if left is not None and left <= 0:
+                self._no_replica_response(handler, deadline_spent=True)
+                return
+            replica = self.policy.select_replica(
+                exclude=self._routing_exclude(tried))
+            if replica is None:
+                break
+            tried.add(replica)
+            self._bump('attempts')
+            if had_break:
+                self._bump('failovers')
+            self._adjust_outstanding(replica, 1)
+            try:
+                outcome = self._proxy_buffered_once(
+                    handler, replica, route['path'], route['payload'],
+                    timeout=self._attempt_timeout(left))
+            finally:
+                self._adjust_outstanding(replica, -1)
+                self.policy.request_done(replica)
+            if outcome == 'done':
+                self._rep(replica).breaker.record_success()
+                return
+            if outcome == 'shed':
+                self._rep(replica).breaker.record_success()
+                shed_replica = replica
+                continue
+            if outcome == 'draining':
+                continue
+            # unreachable / broken: connection-level failure.
+            self._rep(replica).breaker.record_failure()
+            had_break |= outcome == 'broken'
+            logger.warning('LB: replica %s %s, retrying elsewhere',
+                           replica, outcome)
+        if shed_replica is not None:
+            if self._proxy_once(handler, shed_replica,
+                                json.dumps(route['payload']).encode(),
+                                forward_shed=True) == 'ok':
+                return
+        left = remaining()
+        self._no_replica_response(
+            handler, deadline_spent=left is not None and left <= 0)
+
+    def _handle_stream_generate(self, handler, route: dict) -> None:
+        """SSE generate with mid-stream failover: resumable streams are
+        continued on a survivor byte-identically; non-resumable streams
+        that already relayed tokens fail fast with a typed error."""
+        remaining = self._deadline_clock(route)
+        relay = _SSERelay(handler)
+        payload = route['payload']
+        tried = set()
+        shed_replica = None
+        for _ in range(_MAX_ATTEMPTS):
+            left = remaining()
+            if left is not None and left <= 0:
+                break
+            replica = self.policy.select_replica(
+                exclude=self._routing_exclude(tried))
+            if replica is None:
+                break
+            tried.add(replica)
+            self._bump('attempts')
+            resuming = relay.resumed
+            self._adjust_outstanding(replica, 1)
+            try:
+                outcome = self._proxy_stream_once(
+                    replica, route['path'], payload, relay,
+                    timeout=self._attempt_timeout(left))
+            finally:
+                self._adjust_outstanding(replica, -1)
+                self.policy.request_done(replica)
+            if outcome == 'done':
+                self._rep(replica).breaker.record_success()
+                if resuming:
+                    self._bump('streams_resumed')
+                return
+            if outcome == 'client_gone':
+                return
+            if outcome == 'failed':
+                relay.emit_error_event(
+                    'replica rejected the failover continuation',
+                    'lb_failover')
+                return
+            if outcome == 'shed':
+                self._rep(replica).breaker.record_success()
+                shed_replica = replica
+                continue
+            if outcome == 'draining':
+                continue
+            # unreachable / broken.
+            self._rep(replica).breaker.record_failure()
+            if outcome == 'unreachable':
+                continue
+            # broken: the replica died mid-stream.
+            if relay.chunks_forwarded == 0 and not relay.headers_sent:
+                continue   # nothing reached the client: plain retry
+            if not route['resumable']:
+                if relay.chunks_forwarded == 0:
+                    # Headers out but no tokens: a fresh replay is
+                    # observationally identical for the client.
+                    continue
+                # Tokens already relayed and the continuation is not
+                # reconstructible (sampled / unbounded / text prompt):
+                # fail FAST with a typed error, never a silent
+                # truncation or a diverging replay.
+                self._bump('non_resumable_failures')
+                relay.emit_error_event(
+                    'replica died mid-stream; request is not resumable '
+                    '(requires temperature=0, token prompt and '
+                    'max_new_tokens)', 'non_resumable')
+                return
+            self._bump('failovers')
+            left = remaining()
+            if left is not None and left <= 0:
+                break
+            if len(relay.streamed) >= route['payload']['max_new_tokens']:
+                # Died after the last token but before the final event:
+                # everything was generated — synthesize the terminal.
+                relay.resumed = True
+                try:
+                    relay.emit_event({
+                        'done': True, 'resumed': True,
+                        'output_tokens': list(relay.streamed),
+                        'finish_reason': 'length',
+                        'ttft_s': 0.0, 'latency_s': 0.0})
+                except _ClientGone:
+                    pass
+                self._bump('streams_resumed')
+                return
+            payload = self._continuation_payload(route, relay, left)
+            relay.resumed = True
+            logger.warning('LB: replica %s died mid-stream; resuming '
+                           'at token %d on a survivor', replica,
+                           len(relay.streamed))
+        # No replica finished the stream.
+        left = remaining()
+        if relay.headers_sent:
+            relay.emit_error_event(
+                'deadline_s exhausted during failover'
+                if left is not None and left <= 0 else
+                'no replica available to resume the stream',
+                'lb_failover')
+            return
+        if shed_replica is not None:
+            if self._proxy_once(handler, shed_replica,
+                                json.dumps(route['payload']).encode(),
+                                forward_shed=True) == 'ok':
+                return
+        self._no_replica_response(
+            handler, deadline_spent=left is not None and left <= 0)
+
+    # --------------------------------------------------------------- stats
+
+    def lb_stats(self) -> dict:
+        with self._health_lock:
+            breaker_opens = sum(h.breaker.open_count
+                                for h in self._health.values())
+            open_now = sorted(u for u, h in self._health.items()
+                              if not h.breaker.available())
+            draining = sorted(u for u, h in self._health.items()
+                              if h.draining)
+            outstanding = {u: h.outstanding
+                           for u, h in self._health.items()
+                           if h.outstanding}
+        with self._stats_lock:
+            counters = dict(self._counters)
+        counters.update({
+            'breaker_opens': breaker_opens,
+            'breaker_open_now': open_now,
+            'draining_replicas': draining,
+            'outstanding': outstanding,
+            'ready_replicas': list(self.policy.ready_replicas),
+        })
+        return counters
+
+    def _serve_lb_stats(self, handler) -> None:
+        self._send_json(handler, 200, self.lb_stats())
 
     # -------------------------------------------------------------- server
 
@@ -190,9 +884,13 @@ class SkyTpuLoadBalancer:
             do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _any
             do_HEAD = do_OPTIONS = _any
 
-        sync_thread = threading.Thread(target=self._sync_loop, daemon=True,
-                                       name='lb-sync')
-        sync_thread.start()
+        if self.controller_url is not None:
+            sync_thread = threading.Thread(target=self._sync_loop,
+                                           daemon=True, name='lb-sync')
+            sync_thread.start()
+        probe_thread = threading.Thread(target=self._probe_loop,
+                                        daemon=True, name='lb-probe')
+        probe_thread.start()
         class _Server(ThreadingHTTPServer):
             # Default listen backlog (5) RSTs connections during
             # arrival bursts; user traffic funnels through this port.

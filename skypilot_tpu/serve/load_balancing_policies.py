@@ -6,6 +6,7 @@ least-outstanding-requests policy (the reference only has round-robin).
 """
 import threading
 from typing import Dict, List, Optional
+from typing import Collection
 
 
 class LoadBalancingPolicy:
@@ -40,7 +41,14 @@ class LoadBalancingPolicy:
     def _on_replica_change(self, replicas: List[str]) -> None:
         pass
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Collection[str] = ()) -> Optional[str]:
+        """Pick a ready replica not in ``exclude``.
+
+        ``exclude`` carries the LB's per-request no-go set: replicas
+        already tried this request, replicas whose circuit breaker is
+        open, and draining replicas.  None = every ready replica is
+        excluded (or none are ready)."""
         raise NotImplementedError
 
     def request_done(self, replica: str) -> None:
@@ -59,14 +67,21 @@ class RoundRobinPolicy(LoadBalancingPolicy):
     def _on_replica_change(self, replicas: List[str]) -> None:
         self._index = 0
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Collection[str] = ()) -> Optional[str]:
         with self._lock:
             if not self.ready_replicas:
                 return None
-            replica = self.ready_replicas[self._index %
-                                          len(self.ready_replicas)]
-            self._index += 1
-            return replica
+            # One full lap at most: skip excluded replicas instead of
+            # returning them (the retry loop would otherwise see an
+            # already-tried replica and give up with untried ones left).
+            for _ in range(len(self.ready_replicas)):
+                replica = self.ready_replicas[self._index %
+                                              len(self.ready_replicas)]
+                self._index += 1
+                if replica not in exclude:
+                    return replica
+            return None
 
 
 class LeastLoadPolicy(LoadBalancingPolicy):
@@ -83,11 +98,14 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             r: self._outstanding.get(r, 0) for r in replicas
         }
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Collection[str] = ()) -> Optional[str]:
         with self._lock:
-            if not self.ready_replicas:
+            candidates = [r for r in self.ready_replicas
+                          if r not in exclude]
+            if not candidates:
                 return None
-            replica = min(self.ready_replicas,
+            replica = min(candidates,
                           key=lambda r: self._outstanding.get(r, 0))
             self._outstanding[replica] = (
                 self._outstanding.get(replica, 0) + 1)

@@ -78,7 +78,7 @@ class ReplicaManager:
         launch_future = self._inflight.get(replica_id)
         self._inflight[replica_id] = self._pool.submit(
             self._terminate_replica, replica_id, rec['cluster_name'], purge,
-            final_status, launch_future)
+            final_status, launch_future, rec.get('endpoint'))
         logger.info('[%s] scale_down replica %d', self.service_name,
                     replica_id)
 
@@ -138,14 +138,54 @@ class ReplicaManager:
                 ReplicaStatus.FAILED_PROVISION, str(e),
                 unless=ReplicaStatus.SHUTTING_DOWN)
 
+    def _drain_replica(self, endpoint: str) -> None:
+        """Graceful drain before teardown: ask the replica to stop
+        admitting and wait (bounded) for its in-flight requests to
+        finish, so scale-down never kills work mid-generation.  Any
+        error — replica without /drain, already-dead process — skips
+        straight to teardown."""
+        deadline = constants.drain_timeout()
+        req = urllib.request.Request(
+            endpoint + '/drain',
+            data=json.dumps({'deadline_s': deadline}).encode(),
+            headers={'Content-Type': 'application/json'})
+        try:
+            with urllib.request.urlopen(req, timeout=2) as r:
+                if not 200 <= r.status < 300:
+                    return
+        except (urllib.error.URLError, OSError, ValueError):
+            return
+        t0 = time.time()
+        while time.time() - t0 < deadline:
+            try:
+                with urllib.request.urlopen(endpoint + '/healthz',
+                                            timeout=2) as r:
+                    doc = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                # /healthz answers 503 while draining — the body still
+                # carries the health document.
+                try:
+                    doc = json.loads(e.read())
+                except (ValueError, OSError):
+                    return
+            except (urllib.error.URLError, OSError, ValueError):
+                return   # replica went away: nothing left to wait on
+            if not isinstance(doc, dict) or not doc.get('draining') or \
+                    doc.get('drained') or doc.get('inflight', 0) == 0:
+                return
+            time.sleep(0.2)
+
     def _terminate_replica(self, replica_id: int, cluster: str,
                            purge: bool,
                            final_status: Optional[ReplicaStatus] = None,
                            launch_future: Optional[
-                               concurrent.futures.Future] = None) -> None:
+                               concurrent.futures.Future] = None,
+                           endpoint: Optional[str] = None) -> None:
         from skypilot_tpu import core
         if launch_future is not None:
             concurrent.futures.wait([launch_future])
+        if endpoint:
+            self._drain_replica(endpoint)
         try:
             core.down(cluster, purge=True)
         except Exception as e:  # pylint: disable=broad-except

@@ -1,0 +1,434 @@
+"""Replica-plane fault tolerance: breaker transitions, graceful drain,
+deterministic mid-stream failover (tier-1, CPU, tiny model).
+
+Breaker tests inject the clock — no sleeps.  Fleet tests run one
+module-scoped two-replica `ChaosFleet` (in-process replicas behind the
+real load balancer) with a `stall` fault armed so generations span
+many loop iterations, making "kill mid-stream" deterministic: the
+client kills the busy replica after the first relayed chunk, while
+most of the generation is still ahead.  Greedy decoding is schedule-
+independent, so a fault-free run through the same fleet is the
+byte-exact reference for a resumed stream.
+"""
+import json
+import socket
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.serve.circuit_breaker import CircuitBreaker
+
+PROMPT = [3, 14, 15, 9, 2, 6]
+MAX_NEW = 24
+
+
+# --------------------------------------------------------------- breaker
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(clock, **kw):
+    kw.setdefault('failure_threshold', 2)
+    kw.setdefault('base_backoff_s', 1.0)
+    kw.setdefault('jitter_frac', 0.0)
+    return CircuitBreaker(now=clock, rng=np.random.default_rng(0), **kw)
+
+
+def test_breaker_opens_at_threshold_and_half_opens():
+    clock = _Clock()
+    br = _breaker(clock)
+    assert br.state == CircuitBreaker.CLOSED and br.available()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED   # 1 < threshold
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.available()
+    assert br.open_count == 1
+    clock.t += 0.99
+    assert not br.available()
+    clock.t += 0.02                            # backoff (1s) elapsed
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.available()                      # half-open trial allowed
+
+
+def test_breaker_halfopen_success_closes_and_resets_backoff():
+    clock = _Clock()
+    br = _breaker(clock)
+    br.record_failure()
+    br.record_failure()
+    clock.t += 1.01
+    br.record_success()                        # trial succeeded
+    assert br.state == CircuitBreaker.CLOSED and br.available()
+    # Backoff exponent reset: the next open uses the base window again.
+    br.record_failure()
+    br.record_failure()
+    assert not br.available()
+    clock.t += 1.01
+    assert br.available()
+
+
+def test_breaker_halfopen_failure_reopens_with_doubled_window():
+    clock = _Clock()
+    br = _breaker(clock)
+    br.record_failure()
+    br.record_failure()                        # open #1, window 1s
+    clock.t += 1.01                            # half-open
+    br.record_failure()                        # trial failed: open #2
+    assert br.open_count == 2
+    clock.t += 1.5                             # 2s window now: still open
+    assert not br.available()
+    clock.t += 0.6
+    assert br.available()
+
+
+def test_breaker_ignores_failures_while_open():
+    clock = _Clock()
+    br = _breaker(clock)
+    br.record_failure()
+    br.record_failure()
+    # Probes keep hitting the dead replica while the window runs: the
+    # backoff must double per half-open TRIAL, not per probe.
+    for _ in range(5):
+        br.record_failure()
+    assert br.open_count == 1
+    clock.t += 1.01
+    assert br.available()
+
+
+def test_breaker_jitter_stays_in_band():
+    clock = _Clock()
+    br = _breaker(clock, jitter_frac=0.2)
+    br.record_failure()
+    br.record_failure()
+    clock.t += 1.2                             # > max jittered window
+    assert br.available()
+
+
+# ------------------------------------------------ LB retry bugfix (unit)
+
+
+class _EchoHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({'port': self.server.server_port}).encode()
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_least_load_retry_skips_tried_replica():
+    """Regression: with [dead, live] under LeastLoadPolicy the retry
+    loop used to re-select the dead replica (min outstanding ties break
+    by list order), see it in `tried`, and 503 with a live replica
+    never attempted.  Exclude-based selection must reach the live one."""
+    from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import LeastLoadPolicy
+
+    echo = ThreadingHTTPServer(('127.0.0.1', 0), _EchoHandler)
+    echo.daemon_threads = True
+    threading.Thread(target=echo.serve_forever, daemon=True).start()
+    try:
+        policy = LeastLoadPolicy()
+        dead = 'http://127.0.0.1:1'
+        live = f'http://127.0.0.1:{echo.server_port}'
+        policy.set_ready_replicas([dead, live])
+        lb = SkyTpuLoadBalancer(None, 0, policy)
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                lb.handle_request(self)
+
+        lb_httpd = ThreadingHTTPServer(('127.0.0.1', 0), H)
+        lb_httpd.daemon_threads = True
+        threading.Thread(target=lb_httpd.serve_forever,
+                         daemon=True).start()
+        conn = HTTPConnection('127.0.0.1', lb_httpd.server_port,
+                              timeout=10)
+        conn.request('GET', '/x')
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200
+        assert body['port'] == echo.server_port
+        conn.close()
+        lb_httpd.shutdown()
+    finally:
+        echo.shutdown()
+
+
+def test_deadline_budget_yields_504_not_120s_hang():
+    """deadline_s must bound the replica attempt timeout (not the
+    blanket 120 s) and exhaust across attempts into a 504."""
+    from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import RoundRobinPolicy
+
+    # A black hole: accepts connections, never answers.
+    hole = socket.socket()
+    hole.bind(('127.0.0.1', 0))
+    hole.listen(4)
+    try:
+        policy = RoundRobinPolicy()
+        policy.set_ready_replicas(
+            [f'http://127.0.0.1:{hole.getsockname()[1]}'])
+        lb = SkyTpuLoadBalancer(None, 0, policy)
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                lb.handle_request(self)
+
+        lb_httpd = ThreadingHTTPServer(('127.0.0.1', 0), H)
+        lb_httpd.daemon_threads = True
+        threading.Thread(target=lb_httpd.serve_forever,
+                         daemon=True).start()
+        t0 = time.monotonic()
+        conn = HTTPConnection('127.0.0.1', lb_httpd.server_port,
+                              timeout=30)
+        conn.request('POST', '/generate', body=json.dumps(
+            {'tokens': [1, 2], 'max_new_tokens': 4,
+             'deadline_s': 0.5}).encode())
+        resp = conn.getresponse()
+        elapsed = time.monotonic() - t0
+        assert resp.status == 504, resp.status
+        assert b'deadline' in resp.read()
+        assert elapsed < 10, elapsed   # not the 120 s blanket timeout
+        conn.close()
+        lb_httpd.shutdown()
+    finally:
+        hole.close()
+
+
+# ----------------------------------------------------- fleet (tiny model)
+
+
+@pytest.fixture(scope='module')
+def fleet():
+    import os
+    os.environ['SKYTPU_SERVE_LB_PROBE_INTERVAL'] = '0.2'
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    from skypilot_tpu.infer.engine import InferConfig, InferenceEngine
+    from skypilot_tpu.infer.faults import FaultPlan, FaultSpec
+    from skypilot_tpu.models.llama import LlamaConfig
+
+    mc = LlamaConfig(name='failover-t', vocab_size=101, hidden_size=32,
+                     intermediate_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, max_seq_len=128,
+                     tie_embeddings=True, dtype='float32')
+    cfg = InferConfig(num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=32,
+                      cache_dtype=jnp.float32, decode_steps=4)
+
+    def make_engine():
+        eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+        # Stretch generations across many loop iterations so drain and
+        # mid-stream kills land while work is genuinely in flight.
+        # The stall site only sleeps — token streams are unaffected.
+        eng.arm_faults(FaultPlan(seed=0, specs=[
+            FaultSpec(site='stall', prob=1.0, stall_s=0.05)]))
+        return eng
+
+    fl = ChaosFleet(make_engine, 2)
+    fl.start()
+    yield fl
+    fl.stop()
+
+
+def _read_sse(resp, on_first_event=None):
+    buf, events, fired = b'', [], False
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b'\n\n' in buf:
+            ev, buf = buf.split(b'\n\n', 1)
+            for line in ev.split(b'\n'):
+                if line.startswith(b'data: '):
+                    events.append(json.loads(line[6:]))
+        if events and not fired and on_first_event is not None:
+            fired = True
+            on_first_event()
+    return events
+
+
+def _post_stream(port, payload, timeout=60, on_first_event=None):
+    conn = HTTPConnection('127.0.0.1', port, timeout=timeout)
+    conn.request('POST', '/generate', body=json.dumps(payload).encode(),
+                 headers={'Content-Type': 'application/json'})
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.status
+    try:
+        return _read_sse(resp, on_first_event)
+    finally:
+        conn.close()
+
+
+def _tokens_of(events):
+    return [t for e in events
+            if not e.get('done') and isinstance(e.get('tokens'), list)
+            for t in e['tokens']]
+
+
+def _done_of(events):
+    done = [e for e in events if e.get('done')]
+    assert len(done) == 1, events
+    return done[0]
+
+
+def _reference(fleet):
+    """Fault-free greedy output through the LB — the byte-exact
+    reference every later (faulted) run must reproduce.  Memoized so
+    the tests stay order-independent."""
+    if not hasattr(fleet, 'reference'):
+        events = _post_stream(fleet.lb.port,
+                              {'tokens': PROMPT,
+                               'max_new_tokens': MAX_NEW,
+                               'stream': True})
+        done = _done_of(events)
+        assert done['finish_reason'] in ('length', 'eos')
+        assert _tokens_of(events) == done['output_tokens']
+        assert len(done['output_tokens']) > 0
+        assert 'resumed' not in done
+        fleet.reference = done['output_tokens']
+    return fleet.reference
+
+
+def _wait_fleet_settled(fleet, timeout=30):
+    """Block until both replicas are live and routable again (breakers
+    closed, no draining flags) — probes re-admit a respawned or
+    undrained replica within an interval or two."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        stats = fleet.lb.lb_stats()
+        if len(fleet.live_replicas()) == len(fleet.replicas) and \
+                not stats['breaker_open_now'] and \
+                not stats['draining_replicas']:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f'fleet never settled: {fleet.lb.lb_stats()}')
+
+
+def test_fleet_clean_stream(fleet):
+    assert len(_reference(fleet)) > 0
+
+
+def test_drain_finishes_inflight_with_zero_5xx(fleet):
+    """Drain a replica mid-stream: its in-flight stream completes, new
+    traffic lands on the survivor, and the LB answers zero 5xx."""
+    ref = _reference(fleet)
+    _wait_fleet_settled(fleet)
+    result = {}
+
+    def client():
+        result['events'] = _post_stream(
+            fleet.lb.port, {'tokens': PROMPT, 'max_new_tokens': MAX_NEW,
+                            'stream': True})
+
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.time() + 30
+    busy = None
+    while time.time() < deadline and busy is None:
+        busy = next((r for r in fleet.replicas if r.busy()), None)
+        time.sleep(0.01)
+    assert busy is not None, 'stream never reached a replica'
+    # Drain the replica that is serving the stream.
+    conn = HTTPConnection('127.0.0.1', busy.port, timeout=10)
+    conn.request('POST', '/drain', body=b'{"deadline_s": 30}')
+    resp = conn.getresponse()
+    doc = json.loads(resp.read())
+    assert resp.status == 200 and doc['draining'], doc
+    conn.close()
+    # New traffic during the drain: all 200 at the LB (the draining
+    # replica 503s with X-SkyTpu-Draining; the LB retries elsewhere —
+    # synchronously, no probe needed).
+    for _ in range(4):
+        events = _post_stream(
+            fleet.lb.port, {'tokens': PROMPT, 'max_new_tokens': 6,
+                            'stream': True})
+        assert _done_of(events)['finish_reason'] in ('length', 'eos')
+    t.join(60)
+    assert not t.is_alive()
+    done = _done_of(result['events'])
+    # The in-flight stream finished normally on the draining replica.
+    assert done['output_tokens'] == ref
+    assert busy.server.drained.wait(30)
+    assert busy.server.gen_inflight == 0
+    # Restore for the next test.
+    conn = HTTPConnection('127.0.0.1', busy.port, timeout=10)
+    conn.request('POST', '/drain', body=b'{"cancel": true}')
+    assert conn.getresponse().status == 200
+    conn.close()
+    stats = fleet.lb.lb_stats()
+    assert stats['drains_honored'] >= 1
+
+
+def test_midstream_kill_resumes_byte_identical(fleet):
+    """Kill the serving replica after the first relayed chunk: the LB
+    resumes on the survivor and the stitched stream is byte-identical
+    to the fault-free run."""
+    ref = _reference(fleet)
+    _wait_fleet_settled(fleet)
+    before = fleet.lb.lb_stats()['streams_resumed']
+
+    def kill():
+        victim = fleet.kill_one()      # prefers the busy replica
+        assert victim is not None
+
+    events = _post_stream(fleet.lb.port,
+                          {'tokens': PROMPT, 'max_new_tokens': MAX_NEW,
+                           'stream': True},
+                          on_first_event=kill)
+    done = _done_of(events)
+    assert done.get('resumed') is True
+    assert done['finish_reason'] in ('length', 'eos')
+    assert done['output_tokens'] == ref
+    assert _tokens_of(events) == ref
+    stats = fleet.lb.lb_stats()
+    assert stats['streams_resumed'] == before + 1
+    assert stats['failovers'] >= 1
+    fleet.respawn_dead()
+
+
+def test_midstream_kill_sampled_fails_fast_with_typed_error(fleet):
+    """temperature > 0 is non-resumable: a mid-stream kill must produce
+    a typed terminal error event, never a silent truncation or a
+    diverging replay."""
+    # The respawned replica must be routable again (its breaker may be
+    # open from the previous kill; probes close it).
+    _wait_fleet_settled(fleet)
+
+    def kill():
+        victim = fleet.kill_one()
+        assert victim is not None
+
+    events = _post_stream(fleet.lb.port,
+                          {'tokens': PROMPT, 'max_new_tokens': MAX_NEW,
+                           'stream': True, 'temperature': 0.7},
+                          on_first_event=kill)
+    done = _done_of(events)
+    assert done.get('error_class') == 'non_resumable', done
+    assert done['finish_reason'] == 'error'
+    assert fleet.lb.lb_stats()['non_resumable_failures'] >= 1
+    fleet.respawn_dead()
