@@ -41,6 +41,7 @@ sys.path.insert(0, '.')
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu.infer import (FaultPlan, FaultSpec, InferConfig,
                                 InferenceEngine, Request)
 from skypilot_tpu.models.llama import LlamaConfig
@@ -134,12 +135,22 @@ def episode(eng: InferenceEngine, seed: int, n: int) -> list:
             bad.append(f'BAD finish_reason {res.finish_reason!r} '
                        f'for {res.request_id}')
     if eng._paged:
-        if len(eng._free_blocks) != eng._num_blocks - 1 or \
-                eng._block_refs[0] != 1 or \
-                not (eng._block_refs[1:] == 0).all():
+        # Full conservation law (refcounts == slot tables + radix +
+        # prefixes, free list == zero-ref blocks), then the stricter
+        # drained-pool expectation: nothing in flight may hold blocks.
+        try:
+            sanitizers.check_block_conservation(eng)
+        except sanitizers.BlockLeakError as e:
+            bad.append(f'BLOCK LEAK: {e}')
+        held = eng._num_blocks - 1 - len(eng._free_blocks)
+        radix_held = eng._radix.blocks_held if eng._radix else 0
+        prefix_held = sum(len(e.get('blocks', ()))
+                          for e in eng._prefixes.values())
+        if held != radix_held + prefix_held:
             bad.append(
-                f'BLOCK LEAK: {len(eng._free_blocks)} free of '
-                f'{eng._num_blocks - 1}, refs={eng._block_refs.tolist()}')
+                f'BLOCK LEAK: {held} blocks held at drain but only '
+                f'{radix_held} radix + {prefix_held} prefix expected; '
+                f'refs={eng._block_refs.tolist()}')
     print(f'  seed={seed}: {reasons} wall={time.time() - t0:.1f}s '
           f'fired={plan.stats()["fired"]} '
           f'counters={eng.fault_stats} '

@@ -21,18 +21,35 @@ regression they are.  The checker reports:
 - `--require <file>`: tier-1 files that MUST appear in the log — a new
   test file silently dropped from the window (collection error, bad
   marker, renamed path) fails the guard instead of passing by absence.
+  Required paths are validated against the test files that actually
+  exist on disk (skypilot_tpu.analysis.walker — the same discovery
+  skycheck uses, so __pycache__ artifacts can't satisfy a typo), and a
+  typo'd --require fails loudly instead of failing every run.
+- `--extra-seconds LABEL:SECONDS`: wall time spent by non-pytest tier-1
+  steps that share the CI window (e.g. the skycheck gate) — added to
+  the suite time before the budget verdict so the pytest budget shrinks
+  by exactly what the other steps consumed.
 
 Usage:
     python scripts/check_tier1_budget.py /tmp/_t1.log \
         [--budget 870] [--margin 0.10] [--top 15] \
-        [--require tests/test_radix.py ...]
+        [--require tests/test_radix.py ...] \
+        [--extra-seconds skycheck:2.1]
 
 Exit codes: 0 within budget, 1 over budget (or the run itself timed
-out, which a missing summary line implies), 2 unreadable log.
+out, which a missing summary line implies), 2 unreadable log or bad
+arguments.
 """
 import argparse
+import os
 import re
 import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from skypilot_tpu.analysis.walker import iter_py_files  # noqa: E402
 
 # `1.23s call tests/test_x.py::test_y` rows from --durations=N.
 _DURATION_ROW = re.compile(
@@ -72,7 +89,27 @@ def main(argv=None) -> int:
                     help='test file that must show up in the log '
                          '(repeatable); guards tier-1 files against '
                          'silently dropping out of the window')
+    ap.add_argument('--extra-seconds', action='append', default=[],
+                    metavar='LABEL:SECONDS',
+                    help='non-pytest wall time sharing the window '
+                         '(repeatable), e.g. skycheck:2.1; added to '
+                         'the suite time for the budget verdict')
     args = ap.parse_args(argv)
+    extras = []
+    for spec in args.extra_seconds:
+        label, sep, secs = spec.partition(':')
+        try:
+            extras.append((label, float(secs)))
+        except ValueError:
+            print(f'check_tier1_budget: bad --extra-seconds {spec!r} '
+                  '(want LABEL:SECONDS)')
+            return 2
+    on_disk = set(iter_py_files(_REPO, subdirs=['tests']))
+    unknown = [req for req in args.require if req not in on_disk]
+    if unknown:
+        print('check_tier1_budget: --require path(s) not found on disk '
+              '(typo? renamed?): ' + ', '.join(unknown))
+        return 2
     try:
         with open(args.log, encoding='utf-8', errors='replace') as f:
             text = f.read()
@@ -106,11 +143,18 @@ def main(argv=None) -> int:
         print(f'FAIL: no pytest summary line in {args.log} — the suite '
               f'did not finish inside the {args.budget:.0f}s budget')
         return 1
+    total = wall + sum(secs for _, secs in extras)
+    if extras:
+        spent = ', '.join(f'{label} {secs:.1f}s' for label, secs in extras)
+        print(f'non-pytest tier-1 steps: {spent}')
     limit = args.budget * (1.0 - args.margin)
-    verdict = 'OK' if wall <= limit else 'FAIL'
-    print(f'{verdict}: suite took {wall:.1f}s; budget {args.budget:.0f}s '
+    verdict = 'OK' if total <= limit else 'FAIL'
+    print(f'{verdict}: suite took {wall:.1f}s'
+          + (f' (+{total - wall:.1f}s non-pytest = {total:.1f}s)'
+             if extras else '')
+          + f'; budget {args.budget:.0f}s '
           f'(fail threshold {limit:.0f}s = {args.margin:.0%} headroom)')
-    return 0 if wall <= limit else 1
+    return 0 if total <= limit else 1
 
 
 if __name__ == '__main__':

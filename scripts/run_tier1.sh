@@ -10,18 +10,28 @@ set -o pipefail
 BUDGET="${1:-870}"
 LOG=/tmp/_t1.log
 rm -f "$LOG"
+rc=0
+# Static analysis gate first: new findings (vs skycheck_baseline.txt)
+# fail tier-1 before any pytest time is spent.  Its wall time is
+# charged to the shared window via --extra-seconds below.
+SKYCHECK_T0=$(date +%s.%N)
+timeout -k 5 30 python scripts/skycheck.py \
+    --baseline skycheck_baseline.txt || rc=1
+SKYCHECK_SECS=$(echo "$(date +%s.%N) $SKYCHECK_T0" | awk '{print $1-$2}')
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly --durations=15 2>&1 | tee "$LOG"
-rc=${PIPESTATUS[0]}
+[ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
 # --require: every tier-1 test file must actually reach the window —
 # a file lost to a collection error or marker typo fails by name.
 python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_paged_kv.py --require tests/test_faults.py \
     --require tests/test_radix.py \
-    --require tests/test_serve_failover.py || rc=1
+    --require tests/test_serve_failover.py \
+    --require tests/test_skycheck.py \
+    --extra-seconds "skycheck:$SKYCHECK_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
 # accounting under randomized faults.  Outside the pytest window on
 # purpose — it must not eat durations budget from the suite.

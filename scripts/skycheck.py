@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""skycheck: the repo's static-analysis suite (see skypilot_tpu/analysis).
+
+Runs the lock-discipline, jit-boundary, layering and determinism passes
+over the tree and compares findings against a checked-in baseline:
+
+    python scripts/skycheck.py --baseline skycheck_baseline.txt
+
+Exit status is non-zero iff findings NOT pinned by the baseline exist
+(comparison keys on (path, pass-id, message), so pure line shifts do
+not churn).  Regenerate the baseline after deliberately accepting or
+fixing findings:
+
+    python scripts/skycheck.py --write-baseline skycheck_baseline.txt
+
+``--passes lock,jit,layer,det`` restricts which passes run; ``--all``
+prints baselined findings too.  Runs in well under the 30s tier-1
+budget line it is charged under (see run_tier1.sh).
+"""
+import argparse
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from skypilot_tpu.analysis import determinism  # noqa: E402
+from skypilot_tpu.analysis import jit_boundary  # noqa: E402
+from skypilot_tpu.analysis import layering  # noqa: E402
+from skypilot_tpu.analysis import lock_discipline  # noqa: E402
+from skypilot_tpu.analysis.findings import load_baseline  # noqa: E402
+from skypilot_tpu.analysis.findings import new_findings  # noqa: E402
+from skypilot_tpu.analysis.walker import iter_py_files  # noqa: E402
+
+PASSES = {
+    'lock': lock_discipline.check_file,
+    'jit': jit_boundary.check_file,
+    'layer': layering.check_file,
+    'det': determinism.check_file,
+}
+
+# Where hand-written, annotation-bearing sources live.
+DEFAULT_SUBDIRS = ('skypilot_tpu', 'scripts', 'tests')
+
+
+def run(root, subdirs, pass_names):
+    findings = []
+    checked = 0
+    for rel in iter_py_files(root, subdirs=subdirs):
+        abs_path = os.path.join(root, rel.replace('/', os.sep))
+        try:
+            with open(abs_path, encoding='utf-8') as f:
+                text = f.read()
+        except OSError as e:
+            print(f'skycheck: cannot read {rel}: {e}', file=sys.stderr)
+            continue
+        checked += 1
+        for name in pass_names:
+            findings.extend(PASSES[name](rel, text))
+    return findings, checked
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--root', default=_REPO,
+                    help='repo root to analyze (default: this repo)')
+    ap.add_argument('--baseline', default=None,
+                    help='pinned-findings file; new findings fail')
+    ap.add_argument('--write-baseline', default=None, metavar='FILE',
+                    help='write current findings as the new baseline')
+    ap.add_argument('--passes', default=','.join(PASSES),
+                    help=f'comma list of passes ({",".join(PASSES)})')
+    ap.add_argument('--all', action='store_true',
+                    help='print baselined findings too, not just new')
+    args = ap.parse_args(argv)
+
+    pass_names = [p.strip() for p in args.passes.split(',') if p.strip()]
+    unknown = [p for p in pass_names if p not in PASSES]
+    if unknown:
+        ap.error(f'unknown pass(es): {", ".join(unknown)}')
+
+    t0 = time.monotonic()
+    findings, checked = run(args.root, DEFAULT_SUBDIRS, pass_names)
+    findings.sort()
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        with open(args.write_baseline, 'w', encoding='utf-8') as f:
+            f.write('# skycheck pinned findings -- regenerate with:\n'
+                    '#   python scripts/skycheck.py --write-baseline '
+                    f'{os.path.basename(args.write_baseline)}\n')
+            for fd in findings:
+                f.write(fd.render() + '\n')
+        print(f'skycheck: wrote {len(findings)} finding(s) to '
+              f'{args.write_baseline}')
+        return 0
+
+    baseline = {}
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except ValueError as e:
+            print(f'skycheck: {e}', file=sys.stderr)
+            return 2
+    new, fixed = new_findings(findings, baseline)
+
+    if args.all:
+        for fd in findings:
+            marker = 'NEW ' if fd in new else ''
+            print(f'{marker}{fd.render()}')
+    else:
+        for fd in new:
+            print(fd.render())
+
+    pinned = len(findings) - len(new)
+    print(f'skycheck: {checked} files, {len(findings)} finding(s) '
+          f'({pinned} baselined, {len(new)} new, {fixed} fixed) '
+          f'in {elapsed:.2f}s [{",".join(pass_names)}]')
+    if fixed:
+        print('skycheck: baseline has stale entries - shrink it with '
+              '--write-baseline')
+    return 1 if new else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

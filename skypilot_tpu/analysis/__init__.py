@@ -1,0 +1,31 @@
+"""skycheck: codebase-specific static analysis + runtime sanitizers.
+
+Static passes (driven by ``scripts/skycheck.py``):
+
+- ``lock_discipline`` (LOCK001/LOCK002): fields annotated
+  ``# guarded-by: <lock>`` may only be mutated inside
+  ``with self.<lock>:``; nested acquisition of the same
+  non-reentrant lock is a deadlock.
+- ``jit_boundary`` (JIT001/JIT002): host-device syncs and
+  Python-varying shapes inside functions reachable from the jitted
+  decode/prefill dispatch paths.
+- ``layering`` (LAYER001): the import DAG — ``infer`` never imports
+  ``serve``; ``serve`` never imports ``infer.engine`` internals;
+  ``ops`` imports neither.
+- ``determinism`` (DET001/DET002): bare wall clocks and unseeded RNG
+  in the serve plane and the fault/chaos tooling, outside the
+  injected clock/rng seams.
+
+Runtime sanitizers (``sanitizers``; env-gated, zero overhead off):
+a lock-order checker over the engine/LB/breaker locks and a
+block-leak checker asserting paged-pool refcount conservation.
+
+Findings print as ``path:line: [PASS-ID] message``; a checked-in
+``skycheck_baseline.txt`` pins pre-existing findings so CI fails only
+on regressions (comparison ignores line numbers, so unrelated edits
+don't churn the baseline).
+"""
+from skypilot_tpu.analysis.findings import Finding, load_baseline, new_findings
+from skypilot_tpu.analysis.walker import iter_py_files
+
+__all__ = ['Finding', 'load_baseline', 'new_findings', 'iter_py_files']

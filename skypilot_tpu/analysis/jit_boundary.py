@@ -1,0 +1,183 @@
+"""JIT pass: host-sync hygiene on the jitted dispatch paths.
+
+On TPUs the silent hot-path killers are host-device synchronization
+(``.item()``, ``np.asarray`` on a device array, ``jax.device_get``,
+``block_until_ready``) and recompilation from Python-varying shapes.
+This pass builds a per-class call graph (``self.<meth>()`` edges) from
+configured hot roots — the engine's decode/prefill dispatch methods —
+and inside every reachable method flags:
+
+- JIT001: host-sync calls (``np.asarray``/``np.array``, ``.item()``,
+  ``jax.device_get``, ``.block_until_ready()``).  A known-cold call
+  site (small host-side metadata, error paths) is allowlisted inline
+  with ``# jit-ok: <reason>`` — the reason doubles as documentation of
+  WHY it is cold.
+- JIT002: array constructors (``jnp.zeros/ones/full/empty/arange``,
+  and the ``np`` equivalents feeding device puts) whose shape argument
+  is not a compile-time constant — unbucketed Python-varying shapes
+  recompile per distinct value; route them through a bucketing helper
+  (``_bucket``/``_nb_bucket``/``_suffix_bucket``) first.
+
+The pass is name-based, not type-based — that is the point of the
+allowlist: every ``np.asarray`` on a hot path is either a sync hazard
+or deliberately cold, and the code must say which.
+"""
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from skypilot_tpu.analysis.findings import Finding
+
+_OK_RE = re.compile(r'#\s*jit-ok\b')
+
+PASS_HOST_SYNC = 'JIT001'
+PASS_VARYING_SHAPE = 'JIT002'
+
+# Hot roots per repo-relative path: class -> dispatch-path methods.
+# Reachability closes over self.<method>() calls within the class.
+HOT_ROOTS: Dict[str, Dict[str, List[str]]] = {
+    'skypilot_tpu/infer/engine.py': {
+        'InferenceEngine': [
+            '_step', '_decode_step', '_spec_step', '_chunk_round',
+            '_dispatch_decode', '_maybe_dispatch_ahead',
+            '_consume_window', '_start_batch',
+        ],
+    },
+}
+
+_NP_MODULES = {'np', 'numpy'}
+_CONSTRUCTORS = {'zeros', 'ones', 'full', 'empty', 'arange'}
+_SYNC_METHODS = {'item', 'block_until_ready'}
+
+
+def _callee_self_method(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == 'self':
+        return f.attr
+    return None
+
+
+def _module_attr(node: ast.AST) -> Optional[str]:
+    """'np.asarray' / 'jax.device_get' -> dotted name, else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                      ast.Name):
+        return f'{node.value.id}.{node.attr}'
+    return None
+
+
+def _is_constant_shape(node: ast.AST) -> bool:
+    """Shape args that cannot vary per call: int/None constants,
+    tuples/lists of them, and unary minus on a constant."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_constant_shape(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _is_constant_shape(node.operand)
+    return False
+
+
+class _HotVisitor(ast.NodeVisitor):
+
+    def __init__(self, path: str, lines: List[str], method: str,
+                 findings: List[Finding]):
+        self.path = path
+        self.lines = lines
+        self.method = method
+        self.findings = findings
+
+    def _allowlisted(self, lineno: int) -> bool:
+        return (lineno <= len(self.lines)
+                and _OK_RE.search(self.lines[lineno - 1]) is not None)
+
+    def _add(self, lineno: int, pass_id: str, msg: str) -> None:
+        if not self._allowlisted(lineno):
+            self.findings.append(Finding(self.path, lineno, pass_id,
+                                         msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        dotted = _module_attr(f)
+        where = f"jit-reachable '{self.method}'"
+        if dotted in ('jax.device_get',) or (
+                dotted is not None and
+                dotted.split('.', 1)[0] in _NP_MODULES and
+                dotted.split('.', 1)[1] in ('asarray', 'array')):
+            self._add(node.lineno, PASS_HOST_SYNC,
+                      f'host sync {dotted}(...) inside {where} '
+                      '(device->host copy blocks the dispatch path; '
+                      "mark known-cold sites '# jit-ok: <reason>')")
+        elif isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS \
+                and not isinstance(f.value, ast.Name):
+            # obj.item() / obj.block_until_ready() on a non-module
+            # value (module functions handled above).
+            self._add(node.lineno, PASS_HOST_SYNC,
+                      f'host sync .{f.attr}() inside {where}')
+        elif isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS \
+                and isinstance(f.value, ast.Name) and \
+                f.value.id not in _NP_MODULES and f.value.id != 'jax':
+            self._add(node.lineno, PASS_HOST_SYNC,
+                      f'host sync .{f.attr}() inside {where}')
+        if dotted is not None:
+            mod, attr = dotted.split('.', 1)
+            if (mod in _NP_MODULES or mod == 'jnp') and \
+                    attr in _CONSTRUCTORS and node.args:
+                if not _is_constant_shape(node.args[0]):
+                    self._add(
+                        node.lineno, PASS_VARYING_SHAPE,
+                        f'{dotted}(...) with a Python-varying shape '
+                        f'inside {where} (recompiles per distinct '
+                        'value; bucket the size first)')
+        self.generic_visit(node)
+
+
+def _reachable(cls: ast.ClassDef, roots: Iterable[str]) -> Set[str]:
+    methods = {m.name: m for m in cls.body
+               if isinstance(m, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))}
+    edges: Dict[str, Set[str]] = {}
+    for name, meth in methods.items():
+        callees = set()
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Call):
+                callee = _callee_self_method(node)
+                if callee in methods:
+                    callees.add(callee)
+        edges[name] = callees
+    seen: Set[str] = set()
+    stack = [r for r in roots if r in methods]
+    while stack:
+        cur = stack.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(edges.get(cur, ()) - seen)
+    return seen
+
+
+def check_file(path: str, text: str,
+               roots: Optional[Dict[str, List[str]]] = None
+               ) -> List[Finding]:
+    """``roots``: class -> root methods; defaults to HOT_ROOTS[path]."""
+    if roots is None:
+        roots = HOT_ROOTS.get(path)
+    if not roots:
+        return []
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return []
+    lines = text.splitlines()
+    findings: List[Finding] = []
+    for cls in [n for n in ast.walk(tree)
+                if isinstance(n, ast.ClassDef) and n.name in roots]:
+        hot = _reachable(cls, roots[cls.name])
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    meth.name in hot:
+                visitor = _HotVisitor(path, lines, meth.name, findings)
+                for stmt in meth.body:
+                    visitor.visit(stmt)
+    return findings

@@ -1,0 +1,109 @@
+"""LAYER pass: enforce the package dependency DAG.
+
+The precondition for the engine decomposition (ROADMAP item 5) is that
+the planes stay separable:
+
+- ``skypilot_tpu/infer`` never imports ``skypilot_tpu.serve`` — the
+  engine/replica plane must run without the control plane on the
+  machine.  Declared exemption: ``infer/chaos.py``, the process-level
+  chaos harness whose JOB is to wire killable replicas to the real LB
+  (test-only tooling, not a data-plane dependency).
+- ``skypilot_tpu/serve`` never imports ``skypilot_tpu.infer.engine``
+  internals — the serve plane talks to replicas over HTTP, and any
+  future in-process use goes through the ``skypilot_tpu.infer`` public
+  surface, not engine internals.
+- ``skypilot_tpu/ops`` imports neither — kernels are leaf modules.
+
+Both absolute and relative imports are resolved; module- and
+function-level imports are treated alike (a lazy import is still a
+dependency).
+"""
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.analysis.findings import Finding
+
+PASS_ID = 'LAYER001'
+
+# (source path prefix, forbidden module prefixes, {exempt path: reason})
+Rule = Tuple[str, Sequence[str], Dict[str, str]]
+
+RULES: List[Rule] = [
+    ('skypilot_tpu/infer/', ('skypilot_tpu.serve',), {
+        'skypilot_tpu/infer/chaos.py':
+            'chaos harness drives the real serve plane by design',
+    }),
+    ('skypilot_tpu/serve/', ('skypilot_tpu.infer.engine',), {}),
+    ('skypilot_tpu/ops/', ('skypilot_tpu.infer', 'skypilot_tpu.serve'),
+     {}),
+]
+
+
+def _module_of(path: str) -> str:
+    """'skypilot_tpu/infer/engine.py' -> 'skypilot_tpu.infer.engine'."""
+    mod = path[:-3] if path.endswith('.py') else path
+    if mod.endswith('/__init__'):
+        mod = mod[:-len('/__init__')]
+    return mod.replace('/', '.')
+
+
+def _resolve_relative(path: str, level: int,
+                      module: Optional[str]) -> str:
+    """Absolute module named by ``from <dots><module> import ...``."""
+    parts = _module_of(path).split('.')
+    if not path.endswith('/__init__.py'):
+        parts = parts[:-1]                 # containing package
+    parts = parts[:len(parts) - (level - 1)] if level > 1 else parts
+    if module:
+        parts = parts + module.split('.')
+    return '.'.join(parts)
+
+
+def _imported_modules(tree: ast.AST, path: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node.lineno, alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = _resolve_relative(path, node.level, node.module)
+            else:
+                base = node.module or ''
+            yield node.lineno, base
+            # `from pkg import engine` imports pkg.engine the module —
+            # check one level deeper so renamed-module imports of a
+            # forbidden submodule don't slip through.
+            for alias in node.names:
+                yield node.lineno, f'{base}.{alias.name}'
+
+
+def _violates(mod: str, forbidden: Sequence[str]) -> Optional[str]:
+    for prefix in forbidden:
+        if mod == prefix or mod.startswith(prefix + '.'):
+            return prefix
+    return None
+
+
+def check_file(path: str, text: str,
+               rules: Optional[List[Rule]] = None) -> List[Finding]:
+    rules = RULES if rules is None else rules
+    active = [r for r in rules if path.startswith(r[0])
+              and path not in r[2]]
+    if not active:
+        return []
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return []
+    findings: List[Finding] = []
+    seen = set()
+    for lineno, mod in _imported_modules(tree, path):
+        for src_prefix, forbidden, _ in active:
+            hit = _violates(mod, forbidden)
+            if hit and (lineno, hit) not in seen:
+                seen.add((lineno, hit))
+                findings.append(Finding(
+                    path, lineno, PASS_ID,
+                    f"layering violation: '{src_prefix}' must not "
+                    f"import '{hit}' (import of '{mod}')"))
+    return findings

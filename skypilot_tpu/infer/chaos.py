@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu import logsys
 from skypilot_tpu.infer.engine import InferenceEngine
 from skypilot_tpu.infer.server import (InferenceServer,
@@ -48,7 +49,8 @@ class _TrackingHTTPServer(_BurstTolerantHTTPServer):
     """
 
     def __init__(self, *args, **kwargs):
-        self._clients_lock = threading.Lock()
+        self._clients_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'infer.chaos._clients_lock')
         self._clients: set = set()
         super().__init__(*args, **kwargs)
 
@@ -205,8 +207,8 @@ class ChaosFleet:
         self._lb_thread = threading.Thread(target=self.lb.run,
                                            daemon=True, name='chaos-lb')
         self._lb_thread.start()
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
+        deadline = time.monotonic() + 10  # det-ok: startup wait (harness)
+        while time.monotonic() < deadline:  # det-ok: startup wait
             try:
                 with socket.create_connection(
                         ('127.0.0.1', self.lb.port), timeout=0.2):

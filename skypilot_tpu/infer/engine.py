@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu.infer.radix import RadixTree
 from skypilot_tpu.models.llama import (Llama, LlamaConfig, init_cache,
                                        init_paged_cache)
@@ -541,7 +542,7 @@ class InferenceEngine:
         #   quarantined_batches  unattributed decode failures that failed
         #                        the whole active batch (+ cache rebuild)
         #   nonfinite_lanes      lanes killed by the non-finite logit guard
-        self.fault_stats = {'internal_errors': 0, 'deadline_evictions': 0,
+        self.fault_stats = {'internal_errors': 0, 'deadline_evictions': 0,  # guarded-by: _lock
                             'loop_restarts': 0, 'quarantined_batches': 0,
                             'nonfinite_lanes': 0}
         # Deterministic fault injection (tests/chaos only): an armed
@@ -550,11 +551,11 @@ class InferenceEngine:
         self._faults = None
         # Requests failed from INSIDE the dispatch path (non-finite
         # guard) — drained by _harvest into the normal delivery path.
-        self._pending_failures: List[Tuple[Request, RequestResult]] = []
+        self._pending_failures: List[Tuple[Request, RequestResult]] = []  # guarded-by: _lock
         # Speculation observability: dispatches that ran the verify path,
         # draft tokens offered, draft tokens accepted (acceptance rate =
         # accepted/offered; extra tok/dispatch = accepted/dispatches).
-        self.spec_stats = {'dispatches': 0, 'drafted': 0, 'accepted': 0}
+        self.spec_stats = {'dispatches': 0, 'drafted': 0, 'accepted': 0}  # guarded-by: _lock
         # Adaptive dispatch policy: a verify yields 1+accepted tokens
         # per slot for ONE weight-stream, the windowed decode
         # decode_steps tokens for decode_steps streams — so speculation
@@ -564,15 +565,15 @@ class InferenceEngine:
         # token per active slot, run windowed and only re-probe
         # occasionally (ungrounded traffic must not pay a coincidental
         # draft's 1-token dispatch for the whole batch).
-        self._accept_ema = 0.5
-        self._spec_skips = 0
+        self._accept_ema = 0.5  # guarded-by: _lock
+        self._spec_skips = 0  # guarded-by: _lock
         # Prefix KV cache: token-tuple -> per-layer [(k, v)] rows
-        # ([Hkv, L, D], cache dtype, device-resident), LRU-ordered.
-        self._prefixes: 'collections.OrderedDict[Tuple[int, ...], list]' \
-            = collections.OrderedDict()
+        # ([Hkv, L, D], cache dtype, device-resident), LRU-ordered
+        # (OrderedDict[Tuple[int, ...], list]).
+        self._prefixes = collections.OrderedDict()  # guarded-by: _lock
         # Requests whose prefill reused a cached prefix / prefix tokens
         # skipped (prefill compute saved, in tokens).
-        self.prefix_stats = {'hits': 0, 'tokens_reused': 0}
+        self.prefix_stats = {'hits': 0, 'tokens_reused': 0}  # guarded-by: _lock
         # Multi-LoRA serving: rebuild the config with stacked zero-init
         # adapters (zero-delta init == base model until registered).
         self._adapter_names: Dict[str, int] = {}
@@ -670,10 +671,10 @@ class InferenceEngine:
             # permanently held), a free list, and per-slot block tables
             # (+ allocated counts).  Shared prefix blocks simply carry
             # refcount > 1; freeing a slot decrefs every table entry.
-            self._block_refs = np.zeros((n_blocks,), np.int32)
-            self._tables_np = np.zeros((b, self._max_blocks), np.int32)
-            self._slot_nblocks = np.zeros((b,), np.int32)
-            self.paged_stats = {'deferred': 0, 'prefix_block_hits': 0}
+            self._block_refs = np.zeros((n_blocks,), np.int32)  # guarded-by: _lock
+            self._tables_np = np.zeros((b, self._max_blocks), np.int32)  # guarded-by: _lock
+            self._slot_nblocks = np.zeros((b,), np.int32)  # guarded-by: _lock
+            self.paged_stats = {'deferred': 0, 'prefix_block_hits': 0}  # guarded-by: _lock
         # Automatic radix-tree prefix caching over the pool (None when
         # off).  Must exist before _reset_cache(), which drops the tree
         # on every (re)build.  radix_stats always exists so stats()
@@ -681,26 +682,26 @@ class InferenceEngine:
         self._radix = (RadixTree(self.cfg.kv_block_size)
                        if self._paged and self.cfg.auto_prefix_cache
                        else None)
-        self.radix_stats = {'hits': 0, 'tokens_reused': 0, 'lookups': 0,
+        self.radix_stats = {'hits': 0, 'tokens_reused': 0, 'lookups': 0,  # guarded-by: _lock
                             'inserts': 0, 'evictions': 0}
         self._reset_cache()
         # Requests dequeued but not admissible yet (paged admission
         # control); always present so the serving loop can poll it
         # without caring about the layout.
-        self._deferred: List[Request] = []
-        self._slots: List[Optional[_Slot]] = [None] * b
+        self._deferred: List[Request] = []  # guarded-by: _lock
+        self._slots: List[Optional[_Slot]] = [None] * b  # guarded-by: _lock
         # Request ids cancelled while still PENDING (not yet slotted):
         # generate_stream drops them at dequeue/prefill time.  In-slot
         # cancels free the slot directly (cancel()).  id -> mark time:
         # marks expire (_CANCEL_MARK_TTL_S) so a cancel that raced a
         # natural finish cannot leak forever or poison a later request
         # reusing the same client-supplied id.
-        self._cancelled: Dict[str, float] = {}
+        self._cancelled: Dict[str, float] = {}  # guarded-by: _lock
         # Arrivals snapshot for the window policy (_select_window):
         # generate_stream records the request-queue depth just before
         # each step; 0 outside the serving loop, so offline generate()
         # always runs full windows.
-        self._arrivals_hint = 0
+        self._arrivals_hint = 0  # guarded-by: _lock
         # Decode lookahead state: a dispatched-but-unconsumed window
         # (packed handle, device-side token/length chain, slot
         # snapshot, prefill epoch), plus the serving-loop flag that
@@ -708,15 +709,15 @@ class InferenceEngine:
         # epoch bumps on every prefill so an in-flight window's chain
         # is never extended across a slot recycle.  See
         # _maybe_dispatch_ahead.
-        self._ahead = None
+        self._ahead = None  # guarded-by: _lock
         self._serving = False
-        self._prefill_epoch = 0
+        self._prefill_epoch = 0  # guarded-by: _lock
         # Chunked prefill state: slot -> _ChunkJob for prompts whose KV
         # rows are being written one prefill_chunk per serving gap
         # (_chunk_round).  A chunking slot is reserved (not free) but
         # has no _Slot yet.
-        self._chunking: Dict[int, _ChunkJob] = {}
-        self.chunk_stats = {'rounds': 0, 'chunks': 0, 'requests': 0}
+        self._chunking: Dict[int, _ChunkJob] = {}  # guarded-by: _lock
+        self.chunk_stats = {'rounds': 0, 'chunks': 0, 'requests': 0}  # guarded-by: _lock
         # Phantom-arrival decay (ADVICE r5): consecutive serve-loop
         # dequeue passes that yielded ONLY cancelled requests.  The
         # queue depth then mostly counts tombstones, so the arrivals
@@ -726,18 +727,19 @@ class InferenceEngine:
         self._cancel_only_streak = 0
         # Host mirrors of per-slot decode state (pushed to device each
         # step as small arrays).
-        self._lengths = np.zeros((b,), np.int32)
-        self._last_tokens = np.zeros((b,), np.int32)
-        self._temps = np.zeros((b,), np.float32)
-        self._slot_adapters = np.full((b,), -1, np.int32)
-        self._lock = threading.Lock()
+        self._lengths = np.zeros((b,), np.int32)  # guarded-by: _lock
+        self._last_tokens = np.zeros((b,), np.int32)  # guarded-by: _lock
+        self._temps = np.zeros((b,), np.float32)  # guarded-by: _lock
+        self._slot_adapters = np.full((b,), -1, np.int32)  # guarded-by: _lock
+        self._lock = sanitizers.instrument_lock(threading.Lock(),
+                                                'infer.engine._lock')
         self._jit_fns()   # lazy wrappers; tracing happens (under _ctx)
                           # at the _start_batch/_decode_step call sites
         # Every dispatch's token ids ride the bitcast-packed transfer:
         # verify it is bit-exact on this backend before serving anything.
         _check_bitcast_roundtrip(self.cfg.logprob_topk)
 
-    def _reset_cache(self):
+    def _reset_cache(self):  # locked: _lock
         """(Re)create the device KV cache and, when paged, reset the
         host-side allocator to empty.  Used at construction and by the
         quarantine path after an UNATTRIBUTED dispatch failure: a jitted
@@ -758,7 +760,7 @@ class InferenceEngine:
                                           self.cfg.cache_dtype)
             self._block_refs[:] = 0
             self._block_refs[0] = 1
-            self._free_blocks = list(range(self._num_blocks - 1, 0, -1))
+            self._free_blocks = list(range(self._num_blocks - 1, 0, -1))  # guarded-by: _lock
             self._tables_np[:] = 0
             self._slot_nblocks[:] = 0
             self._prefixes.clear()
@@ -1316,7 +1318,7 @@ class InferenceEngine:
             nb *= 2
         return min(nb, self._max_blocks)
 
-    def _alloc_blocks(self, k: int) -> List[int]:
+    def _alloc_blocks(self, k: int) -> List[int]:  # locked: _lock
         if k > len(self._free_blocks):
             # Admission control reserves worst-case demand up front, so
             # a running slot can never get here; reaching it means the
@@ -1330,19 +1332,19 @@ class InferenceEngine:
             self._block_refs[b] = 1
         return out
 
-    def _deref_block(self, b: int) -> None:
+    def _deref_block(self, b: int) -> None:  # locked: _lock
         if b == 0:
             return
         self._block_refs[b] -= 1
         if self._block_refs[b] == 0:
             self._free_blocks.append(b)
 
-    def _addref_block(self, b: int) -> None:
+    def _addref_block(self, b: int) -> None:  # locked: _lock
         """Refcount bump for a holder OTHER than a slot table (the
         radix tree adopting a finishing slot's prompt blocks)."""
         self._block_refs[b] += 1
 
-    def _evict_radix(self, need: int) -> int:
+    def _evict_radix(self, need: int) -> int:  # locked: _lock
         """Evict unpinned radix LEAVES whose only reference is the
         tree's own (so the deref actually frees a block), LRU-first,
         until `need` blocks freed or nothing evictable remains.
@@ -1352,7 +1354,7 @@ class InferenceEngine:
         self.radix_stats['evictions'] += freed
         return freed
 
-    def _ensure_blocks(self, slot: int, upto: int) -> None:
+    def _ensure_blocks(self, slot: int, upto: int) -> None:  # locked: _lock
         """Grow the slot's table with fresh private blocks so rows
         [0, upto) are resident (no-op when already covered)."""
         need = min(-(-upto // self.cfg.kv_block_size), self._max_blocks)
@@ -1363,7 +1365,7 @@ class InferenceEngine:
         self._tables_np[slot, cur:need] = ids
         self._slot_nblocks[slot] = need
 
-    def _append_shared_blocks(self, slot: int,
+    def _append_shared_blocks(self, slot: int,  # locked: _lock
                               ids: Sequence[int]) -> None:
         """Append a prefix's full blocks to the slot's table by
         REFERENCE (refcount bump) — the copy-free prefix hit."""
@@ -1373,7 +1375,7 @@ class InferenceEngine:
             self._block_refs[b] += 1
         self._slot_nblocks[slot] = cur + len(ids)
 
-    def _free_slot_blocks(self, slot: int) -> None:
+    def _free_slot_blocks(self, slot: int) -> None:  # locked: _lock
         n = int(self._slot_nblocks[slot])
         for b in self._tables_np[slot, :n]:
             self._deref_block(int(b))
@@ -1927,7 +1929,7 @@ class InferenceEngine:
                 return b
         return None
 
-    def _start_prefixed_group(self, group, start: int, sb: int,
+    def _start_prefixed_group(self, group, start: int, sb: int,  # locked: _lock
                               key) -> None:
         """Prefill prefix-matched requests sharing (prefix, start,
         suffix bucket) in lane-batched dispatches — same chunking and
@@ -1993,7 +1995,7 @@ class InferenceEngine:
                         jnp.asarray(temps), rkey,
                         jnp.full((width,), aid, jnp.int32))
             first_np, first_lp_np, tids, tlps = _unpack_head(
-                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+                np.asarray(head), self.cfg.logprob_topk)  # jit-ok: ONE transfer per prefill
             top_np = (tids, tlps)
             now = time.time()
             for i, (req, slot, submit_time, n, _, max_new) in \
@@ -2012,7 +2014,7 @@ class InferenceEngine:
             self.prefix_stats['hits'] += p
             self.prefix_stats['tokens_reused'] += start * p
 
-    def _start_prefixed_group_paged(self, group, start: int, sb: int,
+    def _start_prefixed_group_paged(self, group, start: int, sb: int,  # locked: _lock
                                     key) -> None:
         """Copy-free prefix reuse: each matched slot's table gets the
         prefix's full blocks by REFERENCE (refcount bump — N slots
@@ -2078,7 +2080,7 @@ class InferenceEngine:
                     jnp.asarray(temps), rkey,
                     jnp.full((width,), aid, jnp.int32), False)
             first_np, first_lp_np, tids, tlps = _unpack_head(
-                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+                np.asarray(head), self.cfg.logprob_topk)  # jit-ok: ONE transfer per prefill
             top_np = (tids, tlps)
             now = time.time()
             for i, (req, slot, submit_time, n, _, max_new) in \
@@ -2097,7 +2099,7 @@ class InferenceEngine:
             self.prefix_stats['hits'] += p
             self.prefix_stats['tokens_reused'] += start * p
 
-    def _start_radix_group_paged(self, group, sb: int,
+    def _start_radix_group_paged(self, group, sb: int,  # locked: _lock
                                  gen: int) -> None:
         """Start radix-matched requests sharing a suffix bucket: each
         slot's table gets its matched blocks by REFERENCE (refcount
@@ -2161,7 +2163,7 @@ class InferenceEngine:
                     self.cache, tables, jnp.asarray(temps), rkey,
                     jnp.asarray(aids), False)
             first_np, first_lp_np, tids, tlps = _unpack_head(
-                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+                np.asarray(head), self.cfg.logprob_topk)  # jit-ok: ONE transfer per prefill
             top_np = (tids, tlps)
             now = time.time()
             for i, (it, start, _) in enumerate(chunk):
@@ -2178,7 +2180,7 @@ class InferenceEngine:
                 self._temps[slot] = req.temperature
                 self._slot_adapters[slot] = self._adapter_id(req)
 
-    def _start_batch(self, items) -> None:
+    def _start_batch(self, items) -> None:  # locked: _lock
         """Prefill validated requests in batched dispatches.
 
         Bumps the prefill epoch FIRST: an in-flight lookahead window's
@@ -2334,7 +2336,7 @@ class InferenceEngine:
                              key, jnp.asarray(aids), want_plp)
                 topk = self.cfg.logprob_topk
                 first_np, first_lp_np, tids, tlps = _unpack_head(
-                    np.asarray(head), topk)              # ONE transfer
+                    np.asarray(head), topk)  # jit-ok: ONE transfer per prefill
                 top_np = (tids, tlps)
                 if want_plp:
                     pbuf = np.asarray(prompt_packed)     # [P, S-1, 1+2k]
@@ -2363,7 +2365,7 @@ class InferenceEngine:
                     self._temps[slot] = req.temperature
                     self._slot_adapters[slot] = self._adapter_id(req)
 
-    def _chunk_round(self) -> bool:
+    def _chunk_round(self) -> bool:  # locked: _lock
         """Advance EVERY in-progress chunked prefill by one chunk in a
         single full-width dispatch; activate slots whose final chunk
         landed.  Returns True when a dispatch happened (the serving
@@ -2461,7 +2463,7 @@ class InferenceEngine:
                                   job.req.adapter)
         if finals:
             first_np, first_lp_np, tids, tlps = _unpack_head(
-                np.asarray(head), self.cfg.logprob_topk)  # ONE transfer
+                np.asarray(head), self.cfg.logprob_topk)  # jit-ok: ONE transfer per prefill
             now = time.time()
             for slot, job in finals:
                 del self._chunking[slot]
@@ -2479,7 +2481,7 @@ class InferenceEngine:
                 self._slot_adapters[slot] = job.aid
         return True
 
-    def _radix_adopt(self, slot: int, tokens: Sequence[int],
+    def _radix_adopt(self, slot: int, tokens: Sequence[int],  # locked: _lock
                      rows: int, adapter: Optional[str]) -> None:
         """Insert the slot's full PROMPT blocks (rows [0, rows) of
         `tokens`, whole blocks only) into the radix tree by reference.
@@ -2512,7 +2514,7 @@ class InferenceEngine:
                 except Exception:  # noqa: BLE001
                     pass
 
-    def _finish_slot(self, i: int, reason: str,
+    def _finish_slot(self, i: int, reason: str,  # locked: _lock
                      error: Optional[str] = None,
                      error_class: Optional[str] = None,
                      ) -> Tuple[Request, RequestResult]:
@@ -2561,7 +2563,7 @@ class InferenceEngine:
 
     # ----------------------------------------------------- containment
 
-    def _fail_slot(self, i: int,
+    def _fail_slot(self, i: int,  # locked: _lock
                    error: str) -> Tuple[Request, RequestResult]:
         """Fail ONE active slot's request with error_class='internal':
         slot + paged blocks freed (_finish_slot owns that discipline),
@@ -2570,7 +2572,7 @@ class InferenceEngine:
         return self._finish_slot(i, 'error', error=error,
                                  error_class='internal')
 
-    def _fail_chunk_job(self, slot: int, reason: str,
+    def _fail_chunk_job(self, slot: int, reason: str,  # locked: _lock
                         error: Optional[str] = None,
                         ) -> Tuple[Request, RequestResult]:
         """Terminate a part-prefilled chunk job (reason 'error' or
@@ -2598,7 +2600,7 @@ class InferenceEngine:
             error_class='internal' if error is not None else None)
         return job.req, res
 
-    def _contain_failure(self, exc: BaseException,
+    def _contain_failure(self, exc: BaseException,  # locked: _lock
                          phase: str) -> List[Tuple[Request,
                                                    RequestResult]]:
         """Step-level containment for a decode-phase dispatch failure
@@ -2663,7 +2665,7 @@ class InferenceEngine:
             return min(2, steps)
         return steps
 
-    def _decode_step(self, steps: Optional[int] = None) -> None:
+    def _decode_step(self, steps: Optional[int] = None) -> None:  # locked: _lock
         """One decode window for every active slot: consume a pending
         lookahead dispatch if one exists, else dispatch fresh from the
         host mirrors; optionally dispatch the NEXT window from the
@@ -2739,7 +2741,7 @@ class InferenceEngine:
                 jnp.asarray(self._slot_adapters), steps)
         return packed, (last, lens)
 
-    def _maybe_dispatch_ahead(self, chain, snap,
+    def _maybe_dispatch_ahead(self, chain, snap,  # locked: _lock
                               in_flight_steps: int = 0) -> None:
         """Decode lookahead: dispatch the NEXT full window now, feeding
         the previous dispatch's DEVICE-side final tokens/lengths, so it
@@ -2803,10 +2805,11 @@ class InferenceEngine:
         self._ahead = ((packed, (last, lens), snap,
                         self._prefill_epoch))
 
-    def _consume_window(self, packed, snap=None) -> None:
+    def _consume_window(self, packed, snap=None) -> None:  # locked: _lock
         # ONE device->host transfer for the whole window (pack_head).
         toks_np, lps_np, gtoks_np, glps_np = _unpack_head(
-            np.asarray(packed), self.cfg.logprob_topk)       # [K, B...]
+            np.asarray(packed),  # jit-ok: ONE transfer per window
+            self.cfg.logprob_topk)                           # [K, B...]
         sp = self._fault('nonfinite_logits')
         if sp is not None:
             # Poison one lane's logprobs AFTER the transfer: exercises
@@ -2816,7 +2819,7 @@ class InferenceEngine:
             if lane is None:
                 lane = next((i for i, s in enumerate(self._slots)
                              if s is not None), 0)
-            lps_np = np.array(lps_np)        # the unpack view is read-only
+            lps_np = np.array(lps_np)  # jit-ok: fault-injection path only
             lps_np[:, lane] = np.nan
         bad: List[int] = []
         for i, s in enumerate(self._slots):
@@ -2857,7 +2860,7 @@ class InferenceEngine:
             self._pending_failures.append(self._fail_slot(
                 i, 'non-finite logits in decode window (lane killed)'))
 
-    def _spec_step(self) -> None:
+    def _spec_step(self) -> None:  # locked: _lock
         """One speculative-decode dispatch: draft with prompt-lookup,
         verify [B, 1+D] in one forward, accept the agreed prefix plus
         the model's own next token (so even zero acceptance yields one
@@ -2932,7 +2935,8 @@ class InferenceEngine:
                     jnp.asarray(self._lengths), jnp.asarray(self._temps),
                     key, jnp.asarray(self._slot_adapters))
         preds_np, preds_lp_np, g_toks_np, g_lps_np = _unpack_head(
-            np.asarray(packed), self.cfg.logprob_topk)       # [B, K...]
+            np.asarray(packed),  # jit-ok: ONE transfer per verify
+            self.cfg.logprob_topk)                           # [B, K...]
         self.spec_stats['dispatches'] += 1
         accepted_before = self.spec_stats['accepted']
         bad: List[int] = []
@@ -3018,7 +3022,7 @@ class InferenceEngine:
         with self._lock:
             self._cancelled.pop(request_id, None)
 
-    def _prune_cancel_marks(self) -> None:
+    def _prune_cancel_marks(self) -> None:  # locked: _lock
         now = time.time()
         stale = [rid for rid, ts in self._cancelled.items()
                  if now - ts > self._CANCEL_MARK_TTL_S]
@@ -3034,7 +3038,7 @@ class InferenceEngine:
         else:
             self._decode_step()
 
-    def _harvest(self) -> List[Tuple[Request, RequestResult]]:
+    def _harvest(self) -> List[Tuple[Request, RequestResult]]:  # locked: _lock
         done = []
         if self._pending_failures:
             # Lanes killed inside the dispatch path (non-finite guard):
@@ -3191,8 +3195,8 @@ class InferenceEngine:
                                      stop_event, idle_sleep)
                     return
                 except Exception as e:  # pylint: disable=broad-except
-                    self.fault_stats['loop_restarts'] += 1
                     with self._lock:
+                        self.fault_stats['loop_restarts'] += 1
                         self._ahead = None
                         for _, res in self._fail_all_inflight(
                                 f'serving loop died: {e!r}'):
@@ -3215,8 +3219,9 @@ class InferenceEngine:
             # hint is 0 outside the serving loop).  A pending lookahead
             # dies with the loop (its requests are abandoned anyway).
             self._serving = False
-            self._ahead = None
-            self._arrivals_hint = 0
+            with self._lock:
+                self._ahead = None
+                self._arrivals_hint = 0
 
     def _fail_all_inflight(self, msg: str) -> List[Tuple[Request,
                                                          RequestResult]]:
@@ -3318,8 +3323,9 @@ class InferenceEngine:
                     if not admissible:
                         # Put it back at the head and stop dequeuing:
                         # it is admitted first once blocks free up.
-                        self._deferred.insert(0, req)
-                        self.paged_stats['deferred'] += 1
+                        with self._lock:
+                            self._deferred.insert(0, req)
+                            self.paged_stats['deferred'] += 1
                         break
                 if (req.request_id is not None and
                         req.request_id in self._cancelled):
@@ -3350,8 +3356,8 @@ class InferenceEngine:
                     # Expired while queued: never spend a prefill on it.
                     # (Without arrival_time the deadline clock starts
                     # at the submit_time below; _harvest enforces it.)
-                    self.fault_stats['deadline_evictions'] += 1
                     with self._lock:
+                        self.fault_stats['deadline_evictions'] += 1
                         result_cb(RequestResult(
                             request_id=req.request_id,
                             prompt_tokens=list(req.tokens),
@@ -3487,6 +3493,10 @@ class InferenceEngine:
                         result_cb(res)
                     moved = True
             if not moved:
+                # Quiesce point: nothing in flight moved this pass, so
+                # the block pool's refcounts must balance exactly
+                # (no-op unless SKYTPU_BLOCK_SANITIZER/SKYTPU_SANITIZERS).
+                sanitizers.maybe_check_block_conservation(self)
                 time.sleep(idle_sleep)
 
     def warmup_decode(self, tokens: Sequence[int]) -> None:
@@ -3501,12 +3511,12 @@ class InferenceEngine:
         self.generate([Request(tokens=list(tokens), max_new_tokens=2)])
         if (self.cfg.adaptive_decode_window and self.cfg.decode_steps > 2
                 and self.cfg.num_slots >= 2):
-            self._arrivals_hint = 1      # force the short-window variant
+            self._arrivals_hint = 1  # lock-ok: warmup, pre-serving
             try:
                 self.generate([Request(tokens=list(tokens),
                                        max_new_tokens=2)])
             finally:
-                self._arrivals_hint = 0
+                self._arrivals_hint = 0  # lock-ok: warmup, pre-serving
         if self.cfg.prefill_chunk:
             # Compile the chunk kernel too: one [B, C] dispatch shape
             # covers every chunk round, so a single over-bucket warmup
@@ -3528,7 +3538,7 @@ class InferenceEngine:
         stats = dict(self.spec_stats)
         rep = ([7, 8] * (prompt_len // 2 + 1))[:max(prompt_len, 4)]
         self.generate([Request(tokens=rep, max_new_tokens=4)])
-        self._accept_ema = ema            # warmup must not bias policy
+        self._accept_ema = ema  # lock-ok: warmup must not bias policy
         self.spec_stats.update(stats)
 
     def benchmark_serving(self, num_requests: int = 64,

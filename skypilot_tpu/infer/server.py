@@ -43,6 +43,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
                                        Request, RequestResult,
                                        resolve_cache_dtype)
@@ -117,7 +118,8 @@ class InferenceServer:
         # else.  Registration runs in a background thread (one device
         # forward + possible compile) so no request waits on it.
         self.auto_prefix = auto_prefix
-        self._auto_lock = threading.Lock()
+        self._auto_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'infer.server._auto_lock')
         self._auto_counts: Dict[tuple, int] = {}
         self._auto_inflight: set = set()
         self._auto_failed: set = set()
@@ -130,7 +132,8 @@ class InferenceServer:
         self._thread = threading.Thread(target=self._run, daemon=True)
         # Admission bookkeeping: requests admitted but first-token-less,
         # and the observed TTFTs of recent completions.
-        self._adm_lock = threading.Lock()
+        self._adm_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'infer.server._adm_lock')
         self._awaiting_first: set = set()
         import collections
         self._recent_ttfts: 'collections.deque' = collections.deque(
@@ -1444,9 +1447,12 @@ def serve(engine: InferenceEngine, host: str = '0.0.0.0', port: int = 8100,
 
     def _sigterm(signum, frame):  # pylint: disable=unused-argument
         # Preemption notice: stop admitting (503 + Retry-After), finish
-        # in-flight up to the drain timeout, then exit.
-        from skypilot_tpu.serve import constants as serve_constants
-        srv.drain(serve_constants.drain_timeout())
+        # in-flight up to the drain timeout, then exit.  The env knob
+        # (not serve.constants) is the contract here: the replica plane
+        # must not import the control plane (skycheck LAYER001), and
+        # SKYTPU_SERVE_DRAIN_TIMEOUT is what the controller exports.
+        srv.drain(float(os.environ.get('SKYTPU_SERVE_DRAIN_TIMEOUT',
+                                       60.0)))
 
     import signal
     try:

@@ -132,7 +132,7 @@ class RequestRateAutoscaler(Autoscaler):
 
     # Test hook: timestamps are wall-clock; tests inject fake ones.
     def _now(self) -> float:
-        return time.time()
+        return time.time()  # det-ok: this IS the clock seam tests patch
 
     def collect_request_information(
             self, request_timestamps: List[float]) -> None:

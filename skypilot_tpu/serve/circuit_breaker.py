@@ -26,6 +26,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from skypilot_tpu.analysis import sanitizers
+
 
 class CircuitBreaker:
 
@@ -48,11 +50,12 @@ class CircuitBreaker:
         self.jitter_frac = jitter_frac
         self._now = now
         self._rng = rng if rng is not None else np.random.default_rng(0)
-        self._lock = threading.Lock()
-        self._failures = 0          # consecutive, while closed
-        self._opens = 0             # consecutive opens (backoff exponent)
-        self._open_until: Optional[float] = None   # None = closed
-        self.open_count = 0         # lifetime opens (LB /lb/stats)
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.circuit_breaker._lock')
+        self._failures = 0  # guarded-by: _lock (consecutive, while closed)
+        self._opens = 0  # guarded-by: _lock (consecutive opens = backoff exp)
+        self._open_until: Optional[float] = None  # guarded-by: _lock
+        self.open_count = 0  # guarded-by: _lock (lifetime opens)
 
     # ------------------------------------------------------------- state
 
@@ -100,7 +103,7 @@ class CircuitBreaker:
             if self._failures >= self.failure_threshold:
                 self._trip()
 
-    def _trip(self) -> None:
+    def _trip(self) -> None:  # locked: _lock
         """(Caller holds the lock.)  Open with exponential backoff +
         jitter: window = base * 2^opens * (1 +- jitter_frac)."""
         backoff = min(self.max_backoff_s,

@@ -12,6 +12,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu import logsys
 from skypilot_tpu.serve import autoscalers, constants, serve_state
 from skypilot_tpu.serve.autoscalers import DecisionOperator
@@ -45,7 +46,8 @@ class ServeController:
         self._last_cluster_check = 0.0
         # Last LB-reported per-replica load view (endpoint-url keyed),
         # folded into the autoscaler's ReplicaViews each tick.
-        self._lb_lock = threading.Lock()
+        self._lb_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.controller._lb_lock')
         self._lb_inflight: dict = {}
         self._lb_draining: set = set()
 
@@ -184,7 +186,7 @@ class ServeController:
 
     def run_once(self) -> None:
         """One control tick: probe, reconcile clusters, autoscale."""
-        now = time.time()
+        now = time.time()  # det-ok: probe pacing; tests drive run_once()
         if now - self._last_probe >= constants.probe_interval():
             self._last_probe = now
             self.replica_manager.probe_all()

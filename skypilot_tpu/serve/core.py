@@ -164,7 +164,7 @@ def update(task: Task, service_name: str,
     spec = _validate_service_task(task)
     local_yaml = _dump_task_yaml(task)
     remote_yaml = (f'~/.skytpu/serve/tasks/{service_name}-'
-                   f'v{int(time.time())}.yaml')
+                   f'v{int(time.time())}.yaml')  # det-ok: filename stamp
     handle = _controller_handle()
     if handle is None:
         raise exceptions.ClusterNotUpError(

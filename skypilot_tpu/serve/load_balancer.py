@@ -46,10 +46,11 @@ import urllib.request
 import zlib
 from http.client import HTTPConnection, HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu import logsys
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve.circuit_breaker import CircuitBreaker
@@ -142,22 +143,29 @@ class _SSERelay:
 class SkyTpuLoadBalancer:
 
     def __init__(self, controller_url: Optional[str], port: int,
-                 policy: LoadBalancingPolicy):
+                 policy: LoadBalancingPolicy,
+                 clock: Callable[[], float] = time.monotonic):
         """controller_url=None: standalone mode (tests, the chaos
         harness) — no controller sync; the caller seeds the policy's
-        replica set directly."""
+        replica set directly.  ``clock``: monotonic-seconds source for
+        the per-request deadline budget (injectable so failover-budget
+        tests replay deterministically)."""
         self.controller_url = controller_url
+        self._clock = clock
         self.port = port
         self.policy = policy
-        self._request_timestamps: List[float] = []
-        self._ts_lock = threading.Lock()
+        self._request_timestamps: List[float] = []  # guarded-by: _ts_lock
+        self._ts_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.load_balancer._ts_lock')
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         # Per-replica health: breaker + draining + outstanding count.
-        self._health_lock = threading.Lock()
-        self._health: Dict[str, _ReplicaHealth] = {}
-        self._stats_lock = threading.Lock()
-        self._counters = {
+        self._health_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.load_balancer._health_lock')
+        self._health: Dict[str, _ReplicaHealth] = {}  # guarded-by: _health_lock
+        self._stats_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.load_balancer._stats_lock')
+        self._counters = {  # guarded-by: _stats_lock
             'requests': 0,
             'attempts': 0,
             'failovers': 0,
@@ -293,7 +301,8 @@ class SkyTpuLoadBalancer:
 
     def _record_request(self) -> None:
         with self._ts_lock:
-            self._request_timestamps.append(time.time())
+            self._request_timestamps.append(
+                time.time())  # det-ok: wall-clock QPS feed (autoscaler)
 
     @staticmethod
     def _attempt_timeout(remaining: Optional[float]) -> float:
@@ -598,12 +607,12 @@ class SkyTpuLoadBalancer:
         """Returns remaining() -> Optional[float]: the client's unspent
         deadline budget, decremented across every attempt."""
         deadline = route['deadline_s'] if route else None
-        t0 = time.monotonic()
+        t0 = self._clock()
 
         def remaining() -> Optional[float]:
             if deadline is None:
                 return None
-            return deadline - (time.monotonic() - t0)
+            return deadline - (self._clock() - t0)
 
         return remaining
 

@@ -8,6 +8,8 @@ import threading
 from typing import Dict, List, Optional
 from typing import Collection
 
+from skypilot_tpu.analysis import sanitizers
+
 
 class LoadBalancingPolicy:
     """Tracks ready replicas and picks one per request."""
@@ -29,8 +31,9 @@ class LoadBalancingPolicy:
                 f'available: {sorted(cls._REGISTRY)}') from None
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self.ready_replicas: List[str] = []
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.lb_policy._lock')
+        self.ready_replicas: List[str] = []  # guarded-by: _lock
 
     def set_ready_replicas(self, replicas: List[str]) -> None:
         with self._lock:
@@ -38,7 +41,7 @@ class LoadBalancingPolicy:
                 self._on_replica_change(replicas)
             self.ready_replicas = list(replicas)
 
-    def _on_replica_change(self, replicas: List[str]) -> None:
+    def _on_replica_change(self, replicas: List[str]) -> None:  # locked: _lock
         pass
 
     def select_replica(self,
@@ -62,9 +65,9 @@ class RoundRobinPolicy(LoadBalancingPolicy):
 
     def __init__(self):
         super().__init__()
-        self._index = 0
+        self._index = 0  # guarded-by: _lock
 
-    def _on_replica_change(self, replicas: List[str]) -> None:
+    def _on_replica_change(self, replicas: List[str]) -> None:  # locked: _lock
         self._index = 0
 
     def select_replica(self,
@@ -91,9 +94,9 @@ class LeastLoadPolicy(LoadBalancingPolicy):
 
     def __init__(self):
         super().__init__()
-        self._outstanding: Dict[str, int] = {}
+        self._outstanding: Dict[str, int] = {}  # guarded-by: _lock
 
-    def _on_replica_change(self, replicas: List[str]) -> None:
+    def _on_replica_change(self, replicas: List[str]) -> None:  # locked: _lock
         self._outstanding = {
             r: self._outstanding.get(r, 0) for r in replicas
         }

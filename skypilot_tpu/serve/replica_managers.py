@@ -155,8 +155,8 @@ class ReplicaManager:
                     return
         except (urllib.error.URLError, OSError, ValueError):
             return
-        t0 = time.time()
-        while time.time() - t0 < deadline:
+        t0 = time.time()  # det-ok: teardown-drain wait (harness-side)
+        while time.time() - t0 < deadline:  # det-ok: same wait loop
             try:
                 with urllib.request.urlopen(endpoint + '/healthz',
                                             timeout=2) as r:
@@ -245,7 +245,7 @@ class ReplicaManager:
 
         Parity: the _replica_prober loop (replica_managers.py:1030).
         """
-        now = time.time()
+        now = time.time()  # det-ok: probe bookkeeping; tests drive ticks
         for rec in serve_state.get_replicas(self.service_name):
             status = ReplicaStatus(rec['status'])
             if status not in (ReplicaStatus.STARTING, ReplicaStatus.READY,

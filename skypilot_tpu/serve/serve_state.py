@@ -115,7 +115,7 @@ def add_service(name: str, controller_port: int, lb_port: int,
                 'controller_pid, created_at) VALUES (?,?,?,?,?,?,?,?,?)',
                 (name, ServiceStatus.CONTROLLER_INIT.value, controller_port,
                  lb_port, policy, spec_json, task_yaml, controller_pid,
-                 time.time()))
+                 time.time()))  # det-ok: created_at DB stamp
         return True
     except sqlite3.IntegrityError:
         return False
@@ -166,7 +166,8 @@ def add_replica(service_name: str, replica_id: int, version: int,
             'status, version, cluster_name, is_spot, launched_at, '
             'consecutive_failures) VALUES (?,?,?,?,?,?,?,0)',
             (service_name, replica_id, ReplicaStatus.PROVISIONING.value,
-             version, cluster_name, int(is_spot), time.time()))
+             version, cluster_name, int(is_spot),
+             time.time()))  # det-ok: launched_at DB stamp
 
 
 def remove_replica(service_name: str, replica_id: int) -> None:
@@ -186,7 +187,7 @@ def set_replica_status(service_name: str, replica_id: int,
     SHUTTING_DOWN).  Returns True iff a row was updated."""
     fields: Dict[str, Any] = {'status': status.value}
     if status == ReplicaStatus.READY:
-        fields['ready_at'] = time.time()
+        fields['ready_at'] = time.time()  # det-ok: ready_at DB stamp
         fields['consecutive_failures'] = 0
     if failure_reason is not None:
         fields['failure_reason'] = failure_reason[:2000]

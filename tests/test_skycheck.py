@@ -1,0 +1,463 @@
+"""skycheck: fixture snippets per static pass (exact finding IDs, plus
+no-false-positive clean fixtures), baseline semantics, the shared
+walker, the driver CLI, and the runtime sanitizers."""
+import collections
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.analysis import (determinism, jit_boundary, layering,
+                                   lock_discipline, sanitizers)
+from skypilot_tpu.analysis.findings import (Finding, load_baseline,
+                                            new_findings)
+from skypilot_tpu.analysis.walker import iter_py_files
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ids(findings):
+    return [f.pass_id for f in findings]
+
+
+# ------------------------------------------------------- lock discipline
+
+LOCK_PATH = 'skypilot_tpu/infer/fixture.py'
+
+
+def test_lock_guarded_mutation_off_lock_flagged():
+    src = textwrap.dedent('''\
+        class E:
+            def __init__(self):
+                self._stats = {}  # guarded-by: _lock
+                self._lock = threading.Lock()
+
+            def bump(self):
+                self._stats['x'] = 1
+    ''')
+    found = lock_discipline.check_file(LOCK_PATH, src)
+    assert _ids(found) == ['LOCK001']
+    assert found[0].line == 7
+    assert "'_stats'" in found[0].message
+
+
+def test_lock_mutation_under_lock_clean():
+    src = textwrap.dedent('''\
+        class E:
+            def __init__(self):
+                self._stats = {}  # guarded-by: _lock
+                self._lock = threading.Lock()
+
+            def bump(self):
+                with self._lock:
+                    self._stats['x'] = 1
+                    self._stats['y'] += 1
+    ''')
+    assert lock_discipline.check_file(LOCK_PATH, src) == []
+
+
+def test_lock_locked_annotation_trusts_caller():
+    src = textwrap.dedent('''\
+        class E:
+            def __init__(self):
+                self._refs = []  # guarded-by: _lock
+
+            def _helper(self):  # locked: _lock
+                self._refs = [1]
+                del self._refs[0]
+    ''')
+    assert lock_discipline.check_file(LOCK_PATH, src) == []
+
+
+def test_lock_ok_suppression_and_tuple_targets():
+    src = textwrap.dedent('''\
+        class E:
+            def __init__(self):
+                self._a = 0  # guarded-by: _lock
+                self._b = 0  # guarded-by: _lock
+
+            def reset(self):
+                self._a = 1  # lock-ok: single-writer benign race
+                self._a, self._b = 0, 0
+    ''')
+    found = lock_discipline.check_file(LOCK_PATH, src)
+    # The annotated line is suppressed; the tuple unpack flags BOTH.
+    assert _ids(found) == ['LOCK001', 'LOCK001']
+    assert {f.line for f in found} == {8}
+
+
+def test_lock_nested_acquisition_is_lock002():
+    src = textwrap.dedent('''\
+        class E:
+            def __init__(self):
+                self._a = 0  # guarded-by: _lock
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        self._a = 1
+
+            def helper(self):  # locked: _lock
+                with self._lock:
+                    pass
+    ''')
+    found = lock_discipline.check_file(LOCK_PATH, src)
+    assert _ids(found) == ['LOCK002', 'LOCK002']
+
+
+def test_lock_init_exempt():
+    src = textwrap.dedent('''\
+        class E:
+            def __init__(self):
+                self._a = 0  # guarded-by: _lock
+                self._a = 1
+    ''')
+    assert lock_discipline.check_file(LOCK_PATH, src) == []
+
+
+# --------------------------------------------------------- jit boundary
+
+JIT_PATH = 'skypilot_tpu/infer/fixture.py'
+JIT_ROOTS = {'E': ['_step']}
+
+
+def test_jit_host_sync_flagged_and_allowlisted():
+    src = textwrap.dedent('''\
+        class E:
+            def _step(self):
+                x = np.asarray(self.dev)
+                y = np.asarray(self.dev)  # jit-ok: cold error path
+                z = self.head.item()
+
+            def _cold(self):
+                return np.asarray(self.dev)
+    ''')
+    found = jit_boundary.check_file(JIT_PATH, src, roots=JIT_ROOTS)
+    assert _ids(found) == ['JIT001', 'JIT001']
+    assert [f.line for f in found] == [3, 5]
+
+
+def test_jit_reachability_via_self_calls():
+    src = textwrap.dedent('''\
+        class E:
+            def _step(self):
+                self._inner()
+
+            def _inner(self):
+                jax.device_get(self.dev)
+    ''')
+    found = jit_boundary.check_file(JIT_PATH, src, roots=JIT_ROOTS)
+    assert _ids(found) == ['JIT001']
+    assert found[0].line == 6
+
+
+def test_jit_varying_shape_flagged_constant_clean():
+    src = textwrap.dedent('''\
+        class E:
+            def _step(self, n):
+                a = np.zeros((4, 8), np.int32)
+                b = jnp.zeros((n, 8), jnp.int32)
+                c = np.full((n,), -1)
+    ''')
+    found = jit_boundary.check_file(JIT_PATH, src, roots=JIT_ROOTS)
+    assert _ids(found) == ['JIT002', 'JIT002']
+    assert [f.line for f in found] == [4, 5]
+
+
+def test_jit_no_roots_for_path_clean():
+    src = 'class E:\n    def _step(self):\n        x = np.asarray(1)\n'
+    assert jit_boundary.check_file('tests/fixture.py', src) == []
+
+
+# -------------------------------------------------------------- layering
+
+def test_layer_infer_must_not_import_serve():
+    src = ('import skypilot_tpu.serve.load_balancer\n'
+           'from skypilot_tpu.serve import constants\n')
+    found = layering.check_file('skypilot_tpu/infer/fixture.py', src)
+    assert _ids(found) == ['LAYER001', 'LAYER001']
+
+
+def test_layer_chaos_exemption():
+    src = 'from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer\n'
+    assert layering.check_file('skypilot_tpu/infer/chaos.py', src) == []
+
+
+def test_layer_serve_must_not_import_engine_internals():
+    bad = 'from skypilot_tpu.infer.engine import InferenceEngine\n'
+    ok = 'from skypilot_tpu.infer import InferConfig\n'
+    assert _ids(layering.check_file('skypilot_tpu/serve/fixture.py',
+                                    bad)) == ['LAYER001']
+    assert layering.check_file('skypilot_tpu/serve/fixture.py', ok) == []
+
+
+def test_layer_relative_import_resolved():
+    src = 'from ..infer import engine\n'
+    found = layering.check_file('skypilot_tpu/serve/fixture.py', src)
+    assert _ids(found) == ['LAYER001']
+
+
+def test_layer_ops_is_a_leaf():
+    src = 'from skypilot_tpu.infer import engine\n'
+    found = layering.check_file('skypilot_tpu/ops/fixture.py', src)
+    assert _ids(found) == ['LAYER001']
+    assert layering.check_file('skypilot_tpu/ops/fixture.py',
+                               'import numpy as np\n') == []
+
+
+# ----------------------------------------------------------- determinism
+
+DET_PATH = 'skypilot_tpu/serve/fixture.py'
+
+
+def test_det_bare_clock_flagged_and_allowlisted():
+    src = textwrap.dedent('''\
+        def f():
+            a = time.time()
+            b = time.monotonic()  # det-ok: wall-clock DB stamp
+    ''')
+    found = determinism.check_file(DET_PATH, src)
+    assert _ids(found) == ['DET001']
+    assert found[0].line == 2
+
+
+def test_det_ambient_random_flagged_seeded_clean():
+    src = textwrap.dedent('''\
+        def f():
+            a = random.random()
+            rng = random.Random(0)
+            b = np.random.default_rng()
+            c = np.random.default_rng(42)
+            d = np.random.uniform()
+    ''')
+    found = determinism.check_file(DET_PATH, src)
+    assert _ids(found) == ['DET002', 'DET002', 'DET002']
+    assert [f.line for f in found] == [2, 4, 6]
+
+
+def test_det_out_of_scope_path_clean():
+    src = 'def f():\n    return time.time()\n'
+    assert determinism.check_file('skypilot_tpu/infer/engine.py',
+                                  src) == []
+    assert determinism.check_file('skypilot_tpu/infer/faults.py',
+                                  src) != []
+
+
+# ------------------------------------------------- findings + baseline
+
+def test_baseline_is_line_insensitive(tmp_path):
+    base = tmp_path / 'base.txt'
+    base.write_text('# comment\n'
+                    'a.py:10: [LOCK001] msg\n')
+    pinned = load_baseline(str(base))
+    shifted = [Finding('a.py', 99, 'LOCK001', 'msg')]
+    new, fixed = new_findings(shifted, pinned)
+    assert new == [] and fixed == 0
+
+
+def test_baseline_counts_are_multisets(tmp_path):
+    base = tmp_path / 'base.txt'
+    base.write_text('a.py:1: [DET001] msg\n')
+    twice = [Finding('a.py', 1, 'DET001', 'msg'),
+             Finding('a.py', 50, 'DET001', 'msg')]
+    new, fixed = new_findings(twice, load_baseline(str(base)))
+    assert len(new) == 1
+    none, fixed = new_findings([], load_baseline(str(base)))
+    assert none == [] and fixed == 1
+
+
+def test_baseline_malformed_line_raises(tmp_path):
+    base = tmp_path / 'base.txt'
+    base.write_text('not a finding\n')
+    with pytest.raises(ValueError):
+        load_baseline(str(base))
+
+
+def test_baseline_missing_file_is_empty():
+    assert load_baseline('/nonexistent/skycheck.txt') == {}
+
+
+# ------------------------------------------------------------ walker
+
+def test_walker_skips_generated_dirs(tmp_path):
+    (tmp_path / 'pkg').mkdir()
+    (tmp_path / 'pkg' / 'a.py').write_text('')
+    (tmp_path / 'pkg' / '__pycache__').mkdir()
+    (tmp_path / 'pkg' / '__pycache__' / 'a.cpython-311.pyc').write_text('')
+    (tmp_path / 'pkg' / '__pycache__' / 'b.py').write_text('')
+    (tmp_path / '.git').mkdir()
+    (tmp_path / '.git' / 'c.py').write_text('')
+    (tmp_path / 'x.egg-info').mkdir()
+    (tmp_path / 'x.egg-info' / 'd.py').write_text('')
+    assert list(iter_py_files(str(tmp_path))) == ['pkg/a.py']
+
+
+def test_walker_subdirs(tmp_path):
+    for d in ('inc', 'exc'):
+        (tmp_path / d).mkdir()
+        (tmp_path / d / 'm.py').write_text('')
+    assert list(iter_py_files(str(tmp_path),
+                              subdirs=['inc'])) == ['inc/m.py']
+
+
+# ------------------------------------------------------- driver CLI
+
+def _run_skycheck(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, 'scripts', 'skycheck.py'),
+         *args],
+        capture_output=True, text=True)
+
+
+def test_repo_is_clean_against_checked_in_baseline():
+    r = _run_skycheck('--baseline',
+                      os.path.join(REPO, 'skycheck_baseline.txt'))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_driver_fails_on_fresh_violation(tmp_path):
+    pkg = tmp_path / 'skypilot_tpu' / 'serve'
+    pkg.mkdir(parents=True)
+    (pkg / 'bad.py').write_text(
+        'from skypilot_tpu.infer.engine import InferenceEngine\n'
+        'import time\n'
+        'def f():\n'
+        '    return time.time()\n')
+    r = _run_skycheck('--root', str(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert '[LAYER001]' in r.stdout and '[DET001]' in r.stdout
+    # ... and the same findings pinned by a baseline exit 0.
+    base = tmp_path / 'base.txt'
+    r = _run_skycheck('--root', str(tmp_path),
+                      '--write-baseline', str(base))
+    assert r.returncode == 0
+    r = _run_skycheck('--root', str(tmp_path), '--baseline', str(base))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------------- sanitizers
+
+@pytest.fixture(autouse=True)
+def _clean_lock_graph():
+    sanitizers.reset_lock_order()
+    yield
+    sanitizers.reset_lock_order()
+
+
+def test_lock_sanitizer_gate_off_returns_raw(monkeypatch):
+    monkeypatch.delenv('SKYTPU_LOCK_SANITIZER', raising=False)
+    monkeypatch.delenv('SKYTPU_SANITIZERS', raising=False)
+    raw = threading.Lock()
+    assert sanitizers.instrument_lock(raw, 'x') is raw
+
+
+def test_lock_sanitizer_detects_abba(monkeypatch):
+    monkeypatch.setenv('SKYTPU_LOCK_SANITIZER', '1')
+    a = sanitizers.instrument_lock(threading.Lock(), 'A')
+    b = sanitizers.instrument_lock(threading.Lock(), 'B')
+    with a:
+        with b:
+            pass
+    caught = []
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except sanitizers.LockOrderError as e:
+            caught.append(e)
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    assert caught and 'inversion' in str(caught[0])
+    # The violating acquisition was rolled back: both locks are free.
+    assert not a.locked() and not b.locked()
+
+
+def test_lock_sanitizer_detects_self_reacquire(monkeypatch):
+    monkeypatch.setenv('SKYTPU_SANITIZERS', '1')
+    c = sanitizers.instrument_lock(threading.Lock(), 'C')
+    with pytest.raises(sanitizers.LockOrderError):
+        with c:
+            with c:
+                pass
+    assert not c.locked()
+
+
+class _FakePagedEngine:
+    """Just enough allocator state for the conservation law."""
+
+    def __init__(self, n_blocks=6, slots=2, max_blocks=4):
+        self._paged = True
+        self._lock = threading.Lock()
+        self._num_blocks = n_blocks
+        self._block_refs = np.zeros((n_blocks,), np.int32)
+        self._block_refs[0] = 1
+        self._tables_np = np.zeros((slots, max_blocks), np.int32)
+        self._slot_nblocks = np.zeros((slots,), np.int32)
+        self._free_blocks = list(range(n_blocks - 1, 0, -1))
+        self._prefixes = collections.OrderedDict()
+        self._radix = None
+
+    def alloc(self, slot, blocks):
+        for j, b in enumerate(blocks):
+            self._free_blocks.remove(b)
+            self._block_refs[b] = 1
+            self._tables_np[slot, j] = b
+        self._slot_nblocks[slot] = len(blocks)
+
+
+def test_block_sanitizer_clean_pool():
+    eng = _FakePagedEngine()
+    stats = sanitizers.check_block_conservation(eng)
+    assert stats == {'blocks': 5, 'free': 5, 'slot_refs': 0,
+                     'radix_refs': 0, 'prefix_refs': 0}
+    eng.alloc(0, [1, 2])
+    stats = sanitizers.check_block_conservation(eng)
+    assert stats['slot_refs'] == 2 and stats['free'] == 3
+
+
+def test_block_sanitizer_detects_leak_and_phantom():
+    eng = _FakePagedEngine()
+    eng.alloc(0, [1, 2])
+    # Leak: drop the slot's table without freeing its blocks.
+    eng._slot_nblocks[0] = 0
+    with pytest.raises(sanitizers.BlockLeakError) as ei:
+        sanitizers.check_block_conservation(eng)
+    assert 'refcount' in str(ei.value)
+
+    eng = _FakePagedEngine()
+    eng._free_blocks.append(3)      # duplicate free-list entry
+    with pytest.raises(sanitizers.BlockLeakError) as ei:
+        sanitizers.check_block_conservation(eng)
+    assert 'duplicates' in str(ei.value)
+
+
+def test_block_sanitizer_counts_prefix_and_dense_noop():
+    eng = _FakePagedEngine()
+    eng._free_blocks.remove(4)
+    eng._block_refs[4] = 1
+    eng._prefixes[('p',)] = {'blocks': [4], 'len': 8}
+    stats = sanitizers.check_block_conservation(eng)
+    assert stats['prefix_refs'] == 1
+
+    class Dense:
+        _paged = False
+    assert sanitizers.check_block_conservation(Dense()) is None
+
+
+def test_maybe_check_is_gated(monkeypatch):
+    eng = _FakePagedEngine()
+    eng._block_refs[3] = 7          # corrupt
+    monkeypatch.delenv('SKYTPU_BLOCK_SANITIZER', raising=False)
+    monkeypatch.delenv('SKYTPU_SANITIZERS', raising=False)
+    sanitizers.maybe_check_block_conservation(eng)   # gate off: no-op
+    monkeypatch.setenv('SKYTPU_BLOCK_SANITIZER', '1')
+    with pytest.raises(sanitizers.BlockLeakError):
+        sanitizers.maybe_check_block_conservation(eng)
