@@ -266,13 +266,15 @@ def _drain_exercise(fleet, references) -> list:
     return bad
 
 
-def multi_replica_sweep(n_replicas: int, seeds, n_requests: int) -> int:
+def multi_replica_sweep(n_replicas: int, seeds, n_requests: int,
+                        policy_name: str = 'least_load') -> int:
     from skypilot_tpu.infer.chaos import ChaosFleet, SeededKiller
 
     os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
     print(f'replica chaos: {n_replicas} replicas seeds={seeds} '
-          f'requests/episode={n_requests}')
-    fleet = ChaosFleet(_replica_engine, n_replicas)
+          f'requests/episode={n_requests} policy={policy_name}')
+    fleet = ChaosFleet(_replica_engine, n_replicas,
+                       policy_name=policy_name)
     fleet.start()
     failures = []
     try:
@@ -362,10 +364,13 @@ def main() -> int:
                     metavar='N',
                     help='replica-plane sweep with N killable replicas '
                          'behind the load balancer (0 = engine sweep)')
+    ap.add_argument('--policy', default='least_load',
+                    help='LB policy for --multi-replica (byte-identity '
+                         'must hold under ANY routing policy)')
     args = ap.parse_args()
     if args.multi_replica:
         return multi_replica_sweep(args.multi_replica, args.seeds,
-                                   args.requests)
+                                   args.requests, args.policy)
     print(f'chaos smoke: seeds={args.seeds} '
           f'requests/episode={args.requests}')
     eng = build_engine()

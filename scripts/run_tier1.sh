@@ -31,6 +31,7 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_radix.py \
     --require tests/test_serve_failover.py \
     --require tests/test_skycheck.py \
+    --require tests/test_lb_affinity.py \
     --extra-seconds "skycheck:$SKYCHECK_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
 # accounting under randomized faults.  Outside the pytest window on
@@ -40,8 +41,10 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu \
 # Replica-plane chaos sweep (fixed seeds): seeded mid-decode replica
 # kills behind the LB; every greedy request must complete
 # byte-identical to the fault-free run, and a draining replica must
-# finish its in-flight stream with zero 5xx at the LB.
+# finish its in-flight stream with zero 5xx at the LB.  Runs under
+# prefix_affinity routing: byte-identity + failover must hold under
+# the affinity policy too (least_load is covered by the pytest suite).
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/chaos_smoke.py --multi-replica 3 --seeds 0 1 \
-    --requests 8 || rc=1
+    --requests 8 --policy prefix_affinity || rc=1
 exit "$rc"

@@ -626,9 +626,17 @@ def serve():
 @click.argument('entrypoint', nargs=-1, required=True)
 @click.option('--service-name', '-n', default=None)
 @_resource_flags(include_name=False)
+@click.option('--lb-policy', default=None,
+              type=click.Choice(['round_robin', 'least_load',
+                                 'prefix_affinity']),
+              help='Load-balancing policy (overrides the service '
+                   'spec). prefix_affinity routes prompts sharing a '
+                   'leading token-block prefix to the same replica so '
+                   'the fleet approximates one radix prefix cache.')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_up(entrypoint, service_name, workdir, cloud, tpus, cpus,
-             memory, use_spot, region, zone, num_nodes, env, yes):
+             memory, use_spot, region, zone, num_nodes, env, lb_policy,
+             yes):
     """Bring up a service from a task YAML with a `service:` section."""
     from skypilot_tpu import serve as serve_lib
     task = _make_task(entrypoint, None, workdir, cloud, tpus, cpus, memory,
@@ -636,7 +644,8 @@ def serve_up(entrypoint, service_name, workdir, cloud, tpus, cpus,
     if not yes:
         click.confirm(f'Bring up service {service_name or task.name!r}?',
                       default=True, abort=True)
-    svc_name, endpoint = serve_lib.up(task, service_name)
+    svc_name, endpoint = serve_lib.up(task, service_name,
+                                      policy=lb_policy)
     click.echo(f'Service {svc_name!r} is initializing; endpoint: '
                f'{endpoint}')
 

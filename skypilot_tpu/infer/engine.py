@@ -1483,6 +1483,37 @@ class InferenceEngine:
         gave up on a crash-looping serve loop."""
         return self._serving
 
+    def kv_health(self) -> Dict[str, Any]:
+        """Cheap KV/prefix-cache summary for the /healthz payload.
+
+        The serve-plane LB probes /healthz on a short interval from a
+        routing-critical thread, so unlike stats() this avoids the
+        numpy refcount scans: counters only.  prefix_affinity routing
+        reads block_size (route-key run length), occupancy (cache-full
+        load penalty) and radix.hit_rate (affinity load-bound boost)."""
+        rs = self.radix_stats
+        lookups = rs['lookups']
+        radix = {
+            'enabled': self._radix is not None,
+            'hits': rs['hits'],
+            'lookups': lookups,
+            'hit_rate': (rs['hits'] / lookups) if lookups else 0.0,
+            'nodes': self._radix.nodes if self._radix else 0,
+            'evictions': rs['evictions'],
+        }
+        if not self._paged:
+            return {'layout': 'dense', 'occupancy': 0.0, 'radix': radix}
+        usable = self._num_blocks - 1
+        free = len(self._free_blocks)
+        return {
+            'layout': 'paged',
+            'block_size': self.cfg.kv_block_size,
+            'blocks_total': usable,
+            'blocks_free': free,
+            'occupancy': ((usable - free) / usable) if usable else 0.0,
+            'radix': radix,
+        }
+
     def stats(self) -> Dict[str, Any]:
         """KV-cache accounting (served by /stats).  Everything lives
         under ONE structured 'kv' section — layout, blocks, bytes,

@@ -339,7 +339,7 @@ class InferenceServer:
             status = 'draining'
         else:
             status = 'ok'
-        return {
+        doc: Dict[str, object] = {
             'status': status,
             'model_ready': model_ready,
             'loop_alive': loop_alive,
@@ -347,6 +347,17 @@ class InferenceServer:
             'drained': self.drained.is_set(),
             'inflight': self.gen_inflight,
         }
+        # KV/radix summary for affinity-aware LB routing: kv_health()
+        # is counters-only (this document is probed on a short
+        # interval).  Guarded so plain engines without it stay probe-
+        # compatible.
+        kv_health = getattr(self.engine, 'kv_health', None)
+        if model_ready and callable(kv_health):
+            try:
+                doc['kv'] = kv_health()
+            except Exception:  # pylint: disable=broad-except
+                pass   # health must never 500 over a stats race
+        return doc
 
     _AUTO_PREFIX_MIN = 64        # shortest head worth caching
     _AUTO_PREFIX_TRACKED = 256   # tracked heads (simple size cap)

@@ -72,3 +72,64 @@ def up_wait_timeout() -> float:
 # QPS window the autoscaler evaluates over.
 def qps_window_seconds() -> float:
     return _f('SKYTPU_SERVE_QPS_WINDOW', 60.0)
+
+
+# ------------------------- prefix-affinity routing (--lb-policy
+# prefix_affinity) knobs.  Read once at policy construction.
+
+def affinity_vnodes() -> int:
+    """Virtual nodes per replica on the consistent-hash ring.  More
+    vnodes = smoother key distribution, slower ring rebuilds."""
+    return int(_f('SKYTPU_SERVE_AFFINITY_VNODES', 64))
+
+
+def affinity_route_blocks() -> int:
+    """How many leading kv_block_size-token runs feed the route key.
+    Prompts sharing at least this many leading blocks hash to the same
+    replica; the default (4 blocks = 64 tokens at block size 16) covers
+    typical shared system prompts without splitting them."""
+    return int(_f('SKYTPU_SERVE_AFFINITY_ROUTE_BLOCKS', 4))
+
+
+def affinity_track_blocks() -> int:
+    """Per-prefix residency tracking depth (blocks).  Deeper tracking
+    lets failover pick the survivor with the longest cached prefix at
+    finer granularity; memory is one map entry per depth."""
+    return int(_f('SKYTPU_SERVE_AFFINITY_TRACK_BLOCKS', 16))
+
+
+def affinity_block_size() -> int:
+    """Fallback token-run length for the route key until a replica
+    /healthz reports its real kv_block_size."""
+    return int(_f('SKYTPU_SERVE_AFFINITY_BLOCK_SIZE', 16))
+
+
+def affinity_load_factor() -> float:
+    """Bounded-load consistent hashing factor: the ring owner is taken
+    only while its outstanding count stays under
+    factor * mean_outstanding + slack (Mirrokni et al.'s consistent
+    hashing with bounded loads, plus an absolute slack so tiny fleets
+    don't thrash)."""
+    return _f('SKYTPU_SERVE_AFFINITY_LOAD_FACTOR', 1.25)
+
+
+def affinity_load_slack() -> float:
+    return _f('SKYTPU_SERVE_AFFINITY_LOAD_SLACK', 2.0)
+
+
+def affinity_hit_rate_weight() -> float:
+    """How much the fleet's observed radix hit rate raises the load
+    bound: affinity is worth more imbalance when it is actually paying
+    off (effective factor = load_factor + weight * fleet_hit_rate)."""
+    return _f('SKYTPU_SERVE_AFFINITY_HIT_WEIGHT', 0.5)
+
+
+def affinity_occupancy_high() -> float:
+    """KV pool occupancy at which a replica is considered cache-full:
+    routing new prefixes there would thrash its radix tree, so its
+    effective load gets affinity_occupancy_penalty added."""
+    return _f('SKYTPU_SERVE_AFFINITY_OCC_HIGH', 0.9)
+
+
+def affinity_occupancy_penalty() -> float:
+    return _f('SKYTPU_SERVE_AFFINITY_OCC_PENALTY', 2.0)

@@ -48,8 +48,11 @@ class ServeController:
         # folded into the autoscaler's ReplicaViews each tick.
         self._lb_lock = sanitizers.instrument_lock(
             threading.Lock(), 'serve.controller._lb_lock')
-        self._lb_inflight: dict = {}
-        self._lb_draining: set = set()
+        self._lb_inflight: dict = {}  # guarded-by: _lb_lock
+        self._lb_draining: set = set()  # guarded-by: _lb_lock
+        # Per-replica prefix-affinity routing counters ({url: {'hits',
+        # 'spills'}}), shipped by the LB when its policy exports them.
+        self._lb_affinity: dict = {}  # guarded-by: _lb_lock
 
     # ----------------------------------------------------------- HTTP API
 
@@ -59,7 +62,9 @@ class ServeController:
             self.autoscaler.collect_request_information(ts)
             inflight = payload.get('replica_inflight')
             draining = payload.get('replica_draining')
-            if isinstance(inflight, dict) or isinstance(draining, list):
+            affinity = payload.get('replica_affinity')
+            if isinstance(inflight, dict) or isinstance(draining, list) \
+                    or isinstance(affinity, dict):
                 with self._lb_lock:
                     if isinstance(inflight, dict):
                         self._lb_inflight = {
@@ -67,6 +72,10 @@ class ServeController:
                             if isinstance(v, (int, float))}
                     if isinstance(draining, list):
                         self._lb_draining = {str(u) for u in draining}
+                    if isinstance(affinity, dict):
+                        self._lb_affinity = {
+                            str(k): v for k, v in affinity.items()
+                            if isinstance(v, dict)}
             return {
                 'ready_replica_urls':
                     serve_state.ready_replica_endpoints(self.service_name)
@@ -122,6 +131,7 @@ class ServeController:
         with self._lb_lock:
             lb_inflight = dict(self._lb_inflight)
             lb_draining = set(self._lb_draining)
+            lb_affinity = dict(self._lb_affinity)
         replicas = []
         for r in serve_state.get_replicas(self.service_name):
             endpoint = r.get('endpoint')
@@ -135,6 +145,7 @@ class ServeController:
                 'failure_reason': r.get('failure_reason'),
                 'inflight': lb_inflight.get(endpoint, 0),
                 'draining': endpoint in lb_draining,
+                'affinity': lb_affinity.get(endpoint),
             })
         return {'service': self.service_name, 'version': self.version,
                 'replicas': replicas}

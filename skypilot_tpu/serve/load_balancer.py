@@ -54,7 +54,8 @@ from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu import logsys
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve.circuit_breaker import CircuitBreaker
-from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
+                                                        RequestContext)
 
 logger = logsys.init_logger(__name__)
 
@@ -245,6 +246,10 @@ class SkyTpuLoadBalancer:
             h.breaker.record_success()
             self._mark_draining(url, False)
             return
+        # Affinity-aware policies read kv/radix counters out of the
+        # healthz document (hit rate raises the load bound, near-full
+        # occupancy penalizes the replica).
+        self.policy.observe_replica(url, doc)
         state = doc.get('status')
         self._mark_draining(url, bool(doc.get('draining')) or
                             state == 'draining')
@@ -279,7 +284,10 @@ class SkyTpuLoadBalancer:
                               if h.draining)
         body = json.dumps({'request_timestamps': timestamps,
                            'replica_inflight': inflight,
-                           'replica_draining': draining}).encode()
+                           'replica_draining': draining,
+                           'replica_affinity':
+                               self.policy.stats().get('per_replica', {}),
+                           }).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',
             data=body, headers={'Content-Type': 'application/json'})
@@ -342,7 +350,10 @@ class SkyTpuLoadBalancer:
             conn.request(handler.command, handler.path, body=body,
                          headers=headers)
             resp = conn.getresponse()
-        except (OSError, socket.timeout):
+        except (OSError, socket.timeout, HTTPException):
+            # HTTPException covers a replica killed mid-status-line
+            # (BadStatusLine): nothing was forwarded, so it is as
+            # retryable as a refused connection.
             conn.close()
             return 'unreachable'
         if resp.status == 429 and not forward_shed:
@@ -423,9 +434,14 @@ class SkyTpuLoadBalancer:
             all(isinstance(t, int) for t in tokens) and
             isinstance(max_new, int) and max_new > 0
         )
+        adapter = payload.get('adapter')
+        context = RequestContext(
+            tokens=(list(tokens) if isinstance(tokens, list) and
+                    all(isinstance(t, int) for t in tokens) else None),
+            adapter=adapter if isinstance(adapter, str) else None)
         return {'payload': payload, 'stream': bool(payload.get('stream')),
                 'deadline_s': deadline, 'resumable': resumable,
-                'path': path}
+                'path': path, 'context': context}
 
     @staticmethod
     def _replica_headers(replica: str) -> Dict[str, str]:
@@ -447,7 +463,7 @@ class SkyTpuLoadBalancer:
             conn.request('POST', path, body=body,
                          headers=self._replica_headers(replica))
             resp = conn.getresponse()
-        except (OSError, socket.timeout):
+        except (OSError, socket.timeout, HTTPException):
             conn.close()
             return 'unreachable'
         try:
@@ -494,7 +510,7 @@ class SkyTpuLoadBalancer:
             conn.request('POST', path, body=body,
                          headers=self._replica_headers(replica))
             resp = conn.getresponse()
-        except (OSError, socket.timeout):
+        except (OSError, socket.timeout, HTTPException):
             conn.close()
             return 'unreachable'
         try:
@@ -649,6 +665,8 @@ class SkyTpuLoadBalancer:
         for _ in range(_MAX_ATTEMPTS):
             replica = self.policy.select_replica(
                 exclude=self._routing_exclude(tried))
+            # Passthrough traffic carries no parsed token prompt, so no
+            # RequestContext: affinity policies fall back to load-only.
             if replica is None:
                 break
             tried.add(replica)
@@ -700,7 +718,8 @@ class SkyTpuLoadBalancer:
                 self._no_replica_response(handler, deadline_spent=True)
                 return
             replica = self.policy.select_replica(
-                exclude=self._routing_exclude(tried))
+                exclude=self._routing_exclude(tried),
+                context=route.get('context'))
             if replica is None:
                 break
             tried.add(replica)
@@ -752,7 +771,8 @@ class SkyTpuLoadBalancer:
             if left is not None and left <= 0:
                 break
             replica = self.policy.select_replica(
-                exclude=self._routing_exclude(tried))
+                exclude=self._routing_exclude(tried),
+                context=route.get('context'))
             if replica is None:
                 break
             tried.add(replica)
@@ -867,6 +887,7 @@ class SkyTpuLoadBalancer:
             'draining_replicas': draining,
             'outstanding': outstanding,
             'ready_replicas': list(self.policy.ready_replicas),
+            'policy': self.policy.stats(),
         })
         return counters
 

@@ -2,13 +2,42 @@
 
 Parity: sky/serve/load_balancing_policies.py:22,47 — pluggable policy with
 a ready-replica set pushed from the controller sync; we also ship a
-least-outstanding-requests policy (the reference only has round-robin).
+least-outstanding-requests policy (the reference only has round-robin)
+and a prefix-affinity policy that makes N replicas approximate ONE
+logical radix cache (see :class:`PrefixAffinityPolicy`).
 """
+import bisect
+import hashlib
 import threading
+from dataclasses import dataclass
+from collections import OrderedDict
 from typing import Dict, List, Optional
 from typing import Collection
 
 from skypilot_tpu.analysis import sanitizers
+from skypilot_tpu.serve import constants
+
+
+@dataclass
+class RequestContext:
+    """What the LB knows about a request at routing time.
+
+    ``tokens``: the native /generate token prompt when present (None
+    for text prompts and passthrough traffic — affinity policies fall
+    back to load-only selection).  ``adapter``: the LoRA adapter the
+    request names; prefix KV is adapter-dependent, so the route key
+    includes it exactly like ``infer/radix.py``'s per-adapter roots.
+    """
+    tokens: Optional[List[int]] = None
+    adapter: Optional[str] = None
+
+
+def _h64(data: bytes) -> int:
+    """Stable 64-bit hash (ring points + route keys).  blake2b, not
+    hash(): Python's string hashing is salted per-process and the ring
+    layout must be identical across LB restarts."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          'big')
 
 
 class LoadBalancingPolicy:
@@ -45,17 +74,31 @@ class LoadBalancingPolicy:
         pass
 
     def select_replica(self,
-                       exclude: Collection[str] = ()) -> Optional[str]:
+                       exclude: Collection[str] = (),
+                       context: Optional[RequestContext] = None
+                       ) -> Optional[str]:
         """Pick a ready replica not in ``exclude``.
 
         ``exclude`` carries the LB's per-request no-go set: replicas
         already tried this request, replicas whose circuit breaker is
         open, and draining replicas.  None = every ready replica is
-        excluded (or none are ready)."""
+        excluded (or none are ready).  ``context`` carries what the LB
+        parsed out of the request body (token prompt, adapter) —
+        affinity-aware policies route on it, the others ignore it.
+        """
         raise NotImplementedError
 
     def request_done(self, replica: str) -> None:
         """Called when a proxied request finishes (success or not)."""
+
+    def observe_replica(self, replica: str, health_doc: dict) -> None:
+        """Probe-thread feed: the replica's parsed /healthz document
+        (which carries the engine's kv/radix counters).  Default: no-op.
+        """
+
+    def stats(self) -> dict:
+        """Policy-specific counters for GET /lb/stats."""
+        return {'name': self.NAME}
 
 
 class RoundRobinPolicy(LoadBalancingPolicy):
@@ -71,7 +114,9 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         self._index = 0
 
     def select_replica(self,
-                       exclude: Collection[str] = ()) -> Optional[str]:
+                       exclude: Collection[str] = (),
+                       context: Optional[RequestContext] = None
+                       ) -> Optional[str]:
         with self._lock:
             if not self.ready_replicas:
                 return None
@@ -102,7 +147,9 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         }
 
     def select_replica(self,
-                       exclude: Collection[str] = ()) -> Optional[str]:
+                       exclude: Collection[str] = (),
+                       context: Optional[RequestContext] = None
+                       ) -> Optional[str]:
         with self._lock:
             candidates = [r for r in self.ready_replicas
                           if r not in exclude]
@@ -119,6 +166,273 @@ class LeastLoadPolicy(LoadBalancingPolicy):
             if replica in self._outstanding:
                 self._outstanding[replica] = max(
                     0, self._outstanding[replica] - 1)
+
+
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """Route so the replica fleet approximates ONE logical radix cache.
+
+    Each replica grows a private radix tree (``infer/radix.py``) keyed
+    on ``kv_block_size``-token runs per adapter; blind balancing makes
+    a prefix hot on replica A a cold full-prefill on replica B, so the
+    fleet hit rate decays like 1/N.  This policy routes by the SAME
+    key the tree uses:
+
+    - **Route key** — a chain hash over the prompt's leading
+      block-aligned token runs under the request's adapter, capped at
+      ``affinity_route_blocks`` runs, so every prompt sharing that
+      lead lands on the same replica.
+    - **Consistent hashing** — the key is looked up on a vnode ring
+      over the ready set, so replica join/leave/eject moves only
+      ~1/N of the key space (the other replicas' warm prefixes stay
+      put).
+    - **Bounded load** — the ring owner is used only while its
+      outstanding count stays under
+      ``factor * mean_outstanding + slack`` (consistent hashing with
+      bounded loads); the factor grows with the fleet's observed radix
+      hit rate (affinity is worth more imbalance when it's paying off)
+      and a replica whose KV pool occupancy is near-full carries a
+      load penalty (new prefixes would thrash its tree).  Both signals
+      arrive through the LB's /healthz probe (``observe_replica``).
+    - **Spill + failover** — when the owner is excluded (dead breaker,
+      draining, already tried this request) or over the bound, the
+      pick prefers the candidate with the LONGEST recorded cached
+      prefix for this prompt (ring order, then load, break ties), so a
+      mid-stream failover resume re-prefills only the suffix on the
+      warmest survivor.
+
+    Residency is tracked optimistically at select time: routing a
+    prompt to a replica is what populates that replica's radix tree,
+    so the per-depth chain-hash map is the LB-side shadow of the
+    fleet's trees (bounded LRU; it is a hint, never a correctness
+    input — greedy output is replica-independent).
+    """
+
+    NAME = 'prefix_affinity'
+
+    _SEEN_CAP = 4096             # tracked (prefix-depth, holders) entries
+
+    def __init__(self):
+        super().__init__()
+        self._vnodes = max(1, constants.affinity_vnodes())
+        self._route_blocks = max(1, constants.affinity_route_blocks())
+        self._track_blocks = max(self._route_blocks,
+                                 constants.affinity_track_blocks())
+        self._load_factor = constants.affinity_load_factor()
+        self._load_slack = constants.affinity_load_slack()
+        self._hit_rate_weight = constants.affinity_hit_rate_weight()
+        self._occ_high = constants.affinity_occupancy_high()
+        self._occ_penalty = constants.affinity_occupancy_penalty()
+        self._block_size = max(1, constants.affinity_block_size())  # guarded-by: _lock
+        self._ring: List[int] = []          # guarded-by: _lock
+        self._ring_urls: List[str] = []     # guarded-by: _lock
+        self._kv: Dict[str, dict] = {}      # guarded-by: _lock
+        # chain-hash -> {replica: last-route tick}; LRU-bounded.
+        self._seen: 'OrderedDict[int, Dict[str, int]]' = OrderedDict()  # guarded-by: _lock
+        self._tick = 0                      # guarded-by: _lock
+        self._affinity: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
+        self._keyed = 0                     # guarded-by: _lock
+        self._blind = 0                     # guarded-by: _lock
+
+    # ------------------------------------------------------------- ring
+
+    def _on_replica_change(self, replicas: List[str]) -> None:  # locked: _lock
+        super()._on_replica_change(replicas)
+        points = []
+        for url in replicas:
+            for v in range(self._vnodes):
+                points.append((_h64(f'{url}#{v}'.encode()), url))
+        points.sort()
+        self._ring = [p for p, _ in points]
+        self._ring_urls = [u for _, u in points]
+        self._affinity = {
+            u: self._affinity.get(u, {'hits': 0, 'spills': 0})
+            for u in replicas
+        }
+
+    def _ring_owner(self, key: int) -> Optional[str]:  # locked: _lock
+        if not self._ring:
+            return None
+        i = bisect.bisect_right(self._ring, key) % len(self._ring)
+        return self._ring_urls[i]
+
+    def _ring_order(self, key: int) -> Dict[str, int]:  # locked: _lock
+        """url -> position walking clockwise from ``key`` (owner = 0)."""
+        order: Dict[str, int] = {}
+        n = len(self._ring)
+        if not n:
+            return order
+        start = bisect.bisect_right(self._ring, key)
+        for step in range(n):
+            url = self._ring_urls[(start + step) % n]
+            if url not in order:
+                order[url] = len(order)
+        return order
+
+    # ------------------------------------------------------------- keys
+
+    def _context_chain(self, context: Optional[RequestContext]
+                       ) -> List[int]:  # locked: _lock
+        """Chain hashes of the prompt's leading block runs (depth i's
+        hash covers runs 0..i), capped at the tracking depth.  Empty
+        when the request carries no usable token prompt."""
+        if context is None or not context.tokens:
+            return []
+        bs = self._block_size
+        tokens = context.tokens
+        depth = min(len(tokens) // bs, self._track_blocks)
+        if depth < 1:
+            return []
+        h = _h64(repr(context.adapter).encode())
+        chain = []
+        try:
+            for i in range(depth):
+                run = ','.join(
+                    str(int(t)) for t in tokens[i * bs:(i + 1) * bs])
+                h = _h64(h.to_bytes(8, 'big') + run.encode())
+                chain.append(h)
+        except (TypeError, ValueError):
+            return []           # non-integer tokens: route blind
+        return chain
+
+    def _route_key(self, chain: List[int]) -> int:  # locked: _lock
+        return chain[min(len(chain), self._route_blocks) - 1]
+
+    def owner_of(self, context: Optional[RequestContext]
+                 ) -> Optional[str]:
+        """The ring owner for a context among the current ready set —
+        pure introspection (no load input, no counter side effects) for
+        tests and operators."""
+        with self._lock:
+            chain = self._context_chain(context)
+            if not chain:
+                return None
+            return self._ring_owner(self._route_key(chain))
+
+    # ----------------------------------------------------------- load
+
+    def _eff_load(self, url: str) -> float:  # locked: _lock
+        occ = (self._kv.get(url) or {}).get('occupancy')
+        penalty = (self._occ_penalty
+                   if isinstance(occ, (int, float)) and
+                   occ >= self._occ_high else 0.0)
+        return self._outstanding.get(url, 0) + penalty
+
+    def _load_bound(self, candidates: List[str]) -> float:  # locked: _lock
+        total = sum(self._outstanding.get(c, 0) for c in candidates)
+        rates = []
+        for c in candidates:
+            radix = (self._kv.get(c) or {}).get('radix')
+            if isinstance(radix, dict) and \
+                    isinstance(radix.get('hit_rate'), (int, float)):
+                rates.append(float(radix['hit_rate']))
+        fleet_hit = sum(rates) / len(rates) if rates else 0.0
+        factor = self._load_factor + self._hit_rate_weight * fleet_hit
+        return factor * (total + 1) / len(candidates) + self._load_slack
+
+    # ------------------------------------------------------- residency
+
+    def _seen_depth(self, chain: List[int], url: str) -> int:  # locked: _lock
+        depth = 0
+        for i, h in enumerate(chain):
+            holders = self._seen.get(h)
+            if holders is None or url not in holders:
+                break
+            depth = i + 1
+        return depth
+
+    def _record_seen(self, chain: List[int], url: str) -> None:  # locked: _lock
+        self._tick += 1
+        for h in chain:
+            holders = self._seen.get(h)
+            if holders is None:
+                holders = self._seen[h] = {}
+            else:
+                self._seen.move_to_end(h)
+            holders[url] = self._tick
+        while len(self._seen) > self._SEEN_CAP:
+            self._seen.popitem(last=False)
+
+    # ------------------------------------------------------- selection
+
+    def select_replica(self,
+                       exclude: Collection[str] = (),
+                       context: Optional[RequestContext] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            candidates = [r for r in self.ready_replicas
+                          if r not in exclude]
+            if not candidates:
+                return None
+            chain = self._context_chain(context)
+            if not chain:
+                # No token prompt to key on: plain least-load (with the
+                # occupancy penalty, so blind traffic also avoids
+                # cache-full replicas).
+                self._blind += 1
+                chosen = min(candidates, key=self._eff_load)
+                self._outstanding[chosen] = (
+                    self._outstanding.get(chosen, 0) + 1)
+                return chosen
+            self._keyed += 1
+            key = self._route_key(chain)
+            owner = self._ring_owner(key)
+            bound = self._load_bound(candidates)
+            if owner is not None and owner not in exclude and \
+                    self._eff_load(owner) < bound:
+                chosen = owner
+            else:
+                # Owner dead/draining/tried or over the bound: prefer
+                # the survivor holding the LONGEST cached prefix for
+                # this prompt (failover resumes re-prefill only the
+                # suffix there), then ring order (deterministic spill
+                # target), then load.
+                order = self._ring_order(key)
+                ranked = sorted(
+                    candidates,
+                    key=lambda u: (-self._seen_depth(chain, u),
+                                   order.get(u, len(order)),
+                                   self._eff_load(u)))
+                under = [u for u in ranked if self._eff_load(u) < bound]
+                chosen = under[0] if under else min(
+                    candidates, key=self._eff_load)
+            self._record_seen(chain, chosen)
+            counters = self._affinity.setdefault(
+                chosen, {'hits': 0, 'spills': 0})
+            counters['hits' if chosen == owner else 'spills'] += 1
+            self._outstanding[chosen] = (
+                self._outstanding.get(chosen, 0) + 1)
+            return chosen
+
+    # ----------------------------------------------------- health feed
+
+    def observe_replica(self, replica: str, health_doc: dict) -> None:
+        kv = health_doc.get('kv') if isinstance(health_doc, dict) else None
+        if not isinstance(kv, dict):
+            return
+        with self._lock:
+            bs = kv.get('block_size')
+            if isinstance(bs, int) and bs > 0 and bs != self._block_size:
+                # The fleet's real block size: route keys hashed under
+                # the old run length no longer match anything.
+                self._block_size = bs
+                self._seen.clear()
+            self._kv[replica] = kv
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                'name': self.NAME,
+                'keyed': self._keyed,
+                'blind': self._blind,
+                'affinity_hits': sum(c['hits']
+                                     for c in self._affinity.values()),
+                'affinity_spills': sum(c['spills']
+                                       for c in self._affinity.values()),
+                'per_replica': {u: dict(c)
+                                for u, c in self._affinity.items()},
+                'tracked_prefixes': len(self._seen),
+                'block_size': self._block_size,
+            }
 
 
 DEFAULT_POLICY = RoundRobinPolicy.NAME

@@ -1,0 +1,490 @@
+"""Prefix-affinity routing: consistent-hash stability, affinity-vs-load
+tiebreak, failover-prefers-longest-prefix, inflight accounting on every
+LB exit path, and byte-identity of greedy streams across policies
+(tier-1, CPU; the fleet test uses the tiny model).
+
+The unit half drives `PrefixAffinityPolicy` directly — no sockets, no
+jax: the ring, the seen-prefix map and the bounded-load spill are pure
+data structures.  The accounting half runs the real load balancer
+against dead ports / black holes / an exploding client so every exit
+path (retry exhaustion, deadline 504, client disconnect) is asserted
+to leave the policy's outstanding counters at zero — the affinity
+tiebreak reads those counts, so a leak would permanently skew routing.
+"""
+import io
+import json
+import socket
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu.serve.load_balancing_policies import (
+    LeastLoadPolicy, LoadBalancingPolicy, PrefixAffinityPolicy,
+    RequestContext, RoundRobinPolicy)
+
+URLS = [f'http://10.0.0.{i}:8080' for i in range(1, 4)]
+
+
+def _ctx(i: int, n_tokens: int = 32, adapter=None) -> RequestContext:
+    return RequestContext(
+        tokens=[(i * 7 + j * 13) % 97 for j in range(n_tokens)],
+        adapter=adapter)
+
+
+def _policy(urls=URLS) -> PrefixAffinityPolicy:
+    p = LoadBalancingPolicy.make('prefix_affinity')
+    p.set_ready_replicas(list(urls))
+    return p
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_same_prefix_routes_together():
+    p = _policy()
+    base = _ctx(5, 64)
+    picks = set()
+    for tail in range(4):
+        # Same 4 leading blocks (64 tokens), different continuations.
+        ctx = RequestContext(tokens=list(base.tokens) + [tail] * 8)
+        r = p.select_replica(context=ctx)
+        picks.add(r)
+        p.request_done(r)
+    assert len(picks) == 1
+    assert picks == {p.owner_of(base)}
+
+
+def test_adapter_partitions_the_key_space():
+    p = _policy()
+    tokens = _ctx(1, 64).tokens
+    owners = {p.owner_of(RequestContext(tokens=tokens, adapter=a))
+              for a in (None, 'lora-a', 'lora-b', 'lora-c', 'lora-d',
+                        'lora-e', 'lora-f', 'lora-g')}
+    # Same tokens under different adapters are different KV content:
+    # they must not all pile on one replica.
+    assert len(owners) > 1
+
+
+def test_round_robin_and_least_load_accept_context():
+    for name in ('round_robin', 'least_load'):
+        p = LoadBalancingPolicy.make(name)
+        p.set_ready_replicas(list(URLS))
+        r = p.select_replica(context=_ctx(0))
+        assert r in URLS
+        p.request_done(r)
+
+
+def test_blind_fallback_without_token_prompt():
+    p = _policy()
+    for ctx in (None, RequestContext(), RequestContext(tokens=[1, 2, 3])):
+        r = p.select_replica(context=ctx)
+        assert r in URLS
+        p.request_done(r)
+    st = p.stats()
+    assert st['blind'] == 3 and st['keyed'] == 0
+
+
+# ---------------------------------------------- consistent-hash stability
+
+
+def test_ring_stability_on_replica_leave():
+    p = _policy()
+    contexts = [_ctx(i) for i in range(200)]
+    before = {i: p.owner_of(c) for i, c in enumerate(contexts)}
+    assert len(set(before.values())) == 3   # all replicas own keys
+    removed = URLS[1]
+    p.set_ready_replicas([u for u in URLS if u != removed])
+    for i, c in enumerate(contexts):
+        after = p.owner_of(c)
+        if before[i] != removed:
+            # Survivor-owned keys must NOT move (their warm radix
+            # prefixes stay warm through the eject).
+            assert after == before[i]
+        else:
+            assert after != removed
+
+
+def test_ring_stability_on_replica_join():
+    p = _policy()
+    contexts = [_ctx(i) for i in range(200)]
+    before = {i: p.owner_of(c) for i, c in enumerate(contexts)}
+    new = 'http://10.0.0.9:8080'
+    p.set_ready_replicas(URLS + [new])
+    moved = 0
+    for i, c in enumerate(contexts):
+        after = p.owner_of(c)
+        if after != before[i]:
+            moved += 1
+            # Keys only move TO the joiner, never between incumbents.
+            assert after == new
+    # Expected movement ~1/4 of the key space; bound it well under a
+    # rehash-everything policy's ~3/4.
+    assert 0 < moved < 0.45 * len(contexts)
+
+
+def test_block_size_change_resets_tracked_prefixes():
+    p = _policy()
+    r = p.select_replica(context=_ctx(0))
+    p.request_done(r)
+    assert p.stats()['tracked_prefixes'] > 0
+    p.observe_replica(URLS[0], {'kv': {'block_size': 8}})
+    st = p.stats()
+    assert st['tracked_prefixes'] == 0 and st['block_size'] == 8
+
+
+# ------------------------------------------------- affinity-vs-load spill
+
+
+def test_overloaded_owner_spills_to_ring_successor():
+    p = _policy()
+    ctx = _ctx(3, 64)
+    owner = p.owner_of(ctx)
+    with p._lock:
+        p._outstanding[owner] = 50    # way over any bound
+    spill = p.select_replica(context=ctx)
+    assert spill != owner and spill in URLS
+    st = p.stats()
+    assert st['per_replica'][spill]['spills'] == 1
+    assert st['affinity_hits'] == 0
+    p.request_done(spill)
+    with p._lock:
+        p._outstanding[owner] = 0
+    # Owner back under the bound: affinity resumes.
+    again = p.select_replica(context=ctx)
+    assert again == owner
+    p.request_done(again)
+
+
+def test_occupancy_penalty_diverts_new_prefixes(monkeypatch):
+    # Zero slack so the penalty alone pushes the owner over the bound.
+    monkeypatch.setenv('SKYTPU_SERVE_AFFINITY_LOAD_SLACK', '0')
+    monkeypatch.setenv('SKYTPU_SERVE_AFFINITY_OCC_PENALTY', '5')
+    p = _policy()
+    ctx = _ctx(7, 64)
+    owner = p.owner_of(ctx)
+    p.observe_replica(owner, {'kv': {'occupancy': 0.97,
+                                     'radix': {'hit_rate': 0.0}}})
+    pick = p.select_replica(context=ctx)
+    assert pick != owner
+    p.request_done(pick)
+    # Occupancy back to normal: the owner is routable again.
+    p.observe_replica(owner, {'kv': {'occupancy': 0.1,
+                                     'radix': {'hit_rate': 0.0}}})
+    pick = p.select_replica(context=ctx)
+    assert pick == owner
+    p.request_done(pick)
+
+
+def test_hit_rate_raises_the_load_bound(monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_AFFINITY_LOAD_SLACK', '0')
+    monkeypatch.setenv('SKYTPU_SERVE_AFFINITY_HIT_WEIGHT', '3.0')
+    p = _policy()
+    ctx = _ctx(9, 64)
+    owner = p.owner_of(ctx)
+    # Load the owner to exactly the zero-hit-rate bound's edge:
+    # factor 1.25 * (2+1)/3 = 1.25 -> eff_load 2 >= bound -> spill.
+    with p._lock:
+        p._outstanding[owner] = 2
+    pick = p.select_replica(context=ctx)
+    assert pick != owner
+    p.request_done(pick)
+    with p._lock:
+        for u in URLS:
+            p._outstanding[u] = 0
+        p._outstanding[owner] = 2
+    # A paying-off fleet cache (hit rate 1.0) raises the factor to
+    # 1.25 + 3.0 -> bound 4.25: the same load now stays on the owner.
+    for u in URLS:
+        p.observe_replica(u, {'kv': {'occupancy': 0.1,
+                                     'radix': {'hit_rate': 1.0}}})
+    assert p.select_replica(context=ctx) == owner
+    p.request_done(owner)
+
+
+# ------------------------------------------- failover prefers warm prefix
+
+
+def test_failover_prefers_longest_cached_prefix():
+    p = _policy()
+    full = _ctx(11, 8 * 16)            # 8 blocks deep
+    owner = p.owner_of(full)
+    others = [u for u in URLS if u != owner]
+    deep, shallow = others
+    prefix = lambda k: RequestContext(tokens=full.tokens[:k * 16])
+    # `deep` served 4 leading blocks of this prompt before; `shallow`
+    # only 2 (prefix chains are prefix-consistent, so these selects
+    # record exactly that residency).
+    assert p.select_replica(exclude={owner, shallow},
+                            context=prefix(4)) == deep
+    p.request_done(deep)
+    assert p.select_replica(exclude={owner, deep},
+                            context=prefix(2)) == shallow
+    p.request_done(shallow)
+    # Owner dies mid-stream: the resume must land on the survivor with
+    # the LONGEST recorded prefix — regardless of ring order or load.
+    with p._lock:
+        p._outstanding[deep] = 1       # even slightly busier
+    pick = p.select_replica(exclude={owner}, context=full)
+    assert pick == deep
+    p.request_done(pick)
+
+
+# --------------------------------------- inflight accounting (exit paths)
+
+
+def _zero_outstanding(policy, lb) -> None:
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with policy._lock:
+            left = dict(policy._outstanding)
+        if not any(left.values()):
+            break
+        time.sleep(0.02)
+    with policy._lock:
+        assert not any(policy._outstanding.values()), policy._outstanding
+    with lb._health_lock:
+        assert not any(h.outstanding for h in lb._health.values())
+
+
+def _lb_server(lb):
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _any(self):
+            try:
+                lb.handle_request(self)
+            except (OSError, socket.timeout):
+                pass
+        do_GET = do_POST = _any
+
+    httpd = ThreadingHTTPServer(('127.0.0.1', 0), H)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_outstanding_zero_after_retry_exhaustion_over_dead_replicas():
+    from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+
+    policy = _policy(['http://127.0.0.1:1', 'http://127.0.0.1:2'])
+    lb = SkyTpuLoadBalancer(None, 0, policy)
+    httpd = _lb_server(lb)
+    try:
+        conn = HTTPConnection('127.0.0.1', httpd.server_port, timeout=30)
+        conn.request('POST', '/generate', body=json.dumps(
+            {'tokens': list(range(32)), 'max_new_tokens': 4}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 503, resp.status
+        resp.read()
+        conn.close()
+        _zero_outstanding(policy, lb)
+        # /lb/stats exports the policy block.
+        conn = HTTPConnection('127.0.0.1', httpd.server_port, timeout=10)
+        conn.request('GET', '/lb/stats')
+        stats = json.loads(conn.getresponse().read())
+        conn.close()
+        assert stats['policy']['name'] == 'prefix_affinity'
+        assert stats['policy']['keyed'] >= 1
+    finally:
+        httpd.shutdown()
+
+
+def test_outstanding_zero_after_deadline_504():
+    from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+
+    hole = socket.socket()          # accepts, never answers
+    hole.bind(('127.0.0.1', 0))
+    hole.listen(4)
+    policy = _policy([f'http://127.0.0.1:{hole.getsockname()[1]}'])
+    lb = SkyTpuLoadBalancer(None, 0, policy)
+    httpd = _lb_server(lb)
+    try:
+        conn = HTTPConnection('127.0.0.1', httpd.server_port, timeout=30)
+        conn.request('POST', '/generate', body=json.dumps(
+            {'tokens': list(range(32)), 'max_new_tokens': 4,
+             'deadline_s': 0.4}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 504, resp.status
+        resp.read()
+        conn.close()
+        _zero_outstanding(policy, lb)
+    finally:
+        httpd.shutdown()
+        hole.close()
+
+
+class _SSEStub(BaseHTTPRequestHandler):
+    """Replica stub: streams token events forever (until the client —
+    the LB — goes away).  Lets the client-disconnect path be driven
+    deterministically."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get('Content-Length', 0) or 0))
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/event-stream')
+        self.end_headers()
+        try:
+            for i in range(200):
+                self.wfile.write(
+                    b'data: {"tokens": [%d]}\n\n' % (i % 50))
+                self.wfile.flush()
+                time.sleep(0.005)
+        except (OSError, socket.timeout):
+            pass
+
+
+class _ExplodingWfile:
+    """A client that hung up: every write fails."""
+
+    def write(self, data):
+        raise OSError(104, 'Connection reset by peer')
+
+    def flush(self):
+        pass
+
+
+class _FakeHandler:
+    """Just enough BaseHTTPRequestHandler surface for handle_request,
+    with a dead client socket."""
+    command = 'POST'
+
+    def __init__(self, body: bytes):
+        self.path = '/generate'
+        self.headers = {'Content-Length': str(len(body))}
+        self.rfile = io.BytesIO(body)
+        self.wfile = _ExplodingWfile()
+        self.close_connection = False
+
+    def send_response(self, *a):
+        pass
+
+    def send_header(self, *a):
+        pass
+
+    def end_headers(self):
+        pass
+
+
+def test_outstanding_zero_after_client_disconnect_midstream():
+    from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+
+    stub = ThreadingHTTPServer(('127.0.0.1', 0), _SSEStub)
+    stub.daemon_threads = True
+    threading.Thread(target=stub.serve_forever, daemon=True).start()
+    policy = _policy([f'http://127.0.0.1:{stub.server_port}'])
+    lb = SkyTpuLoadBalancer(None, 0, policy)
+    try:
+        body = json.dumps({'tokens': list(range(32)),
+                           'max_new_tokens': 100, 'stream': True}).encode()
+        lb.handle_request(_FakeHandler(body))
+        _zero_outstanding(policy, lb)
+    finally:
+        stub.shutdown()
+
+
+# --------------------------------------- fleet: byte-identity (tiny model)
+
+
+@pytest.fixture(scope='module')
+def fleet():
+    import os
+    os.environ['SKYTPU_SERVE_LB_PROBE_INTERVAL'] = '0.2'
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    from skypilot_tpu.infer.engine import InferConfig, InferenceEngine
+    from skypilot_tpu.models.llama import LlamaConfig
+
+    mc = LlamaConfig(name='affinity-t', vocab_size=101, hidden_size=32,
+                     intermediate_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, max_seq_len=128,
+                     tie_embeddings=True, dtype='float32')
+    cfg = InferConfig(num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=16,
+                      cache_dtype=jnp.float32, decode_steps=4)
+
+    def make_engine():
+        return InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+
+    fl = ChaosFleet(make_engine, 2)
+    fl.start()
+    yield fl
+    fl.stop()
+
+
+def _post_stream(port, payload, timeout=60):
+    conn = HTTPConnection('127.0.0.1', port, timeout=timeout)
+    conn.request('POST', '/generate', body=json.dumps(payload).encode(),
+                 headers={'Content-Type': 'application/json'})
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.status
+    try:
+        buf, events = b'', []
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b'\n\n' in buf:
+                ev, buf = buf.split(b'\n\n', 1)
+                for line in ev.split(b'\n'):
+                    if line.startswith(b'data: '):
+                        events.append(json.loads(line[6:]))
+        return events
+    finally:
+        conn.close()
+
+
+def _done_of(events):
+    done = [e for e in events if e.get('done')]
+    assert len(done) == 1, events
+    return done[0]
+
+
+def _prompts():
+    # 16-token shared head (one route block) + distinct tails: the
+    # affinity policy keys them; routing must not change the tokens.
+    head = [(3 * j) % 97 + 1 for j in range(16)]
+    return [head + [(11 * i + j) % 97 + 1 for j in range(8)]
+            for i in range(3)]
+
+
+def test_greedy_streams_byte_identical_across_policies(fleet):
+    orig = fleet.lb.policy
+    refs = []
+    for prompt in _prompts():
+        done = _done_of(_post_stream(
+            fleet.lb.port, {'tokens': prompt, 'max_new_tokens': 8,
+                            'stream': True}))
+        assert done['finish_reason'] in ('length', 'eos')
+        refs.append(done['output_tokens'])
+    affinity = LoadBalancingPolicy.make('prefix_affinity')
+    affinity.set_ready_replicas(list(orig.ready_replicas))
+    fleet.lb.policy = affinity
+    try:
+        for prompt, ref in zip(_prompts(), refs):
+            done = _done_of(_post_stream(
+                fleet.lb.port, {'tokens': prompt, 'max_new_tokens': 8,
+                                'stream': True}))
+            assert done['output_tokens'] == ref
+        st = affinity.stats()
+        assert st['keyed'] == 3 and st['affinity_hits'] >= 1
+        # The probe thread feeds /healthz kv docs into the policy.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with affinity._lock:
+                if affinity._kv:
+                    break
+            time.sleep(0.05)
+        with affinity._lock:
+            assert affinity._kv, 'probe never fed observe_replica'
+    finally:
+        fleet.lb.policy = orig
