@@ -482,6 +482,87 @@ def bench_lb_affinity(n_replicas_sweep=(1, 2, 4, 8), groups: int = 31,
             'rows': rows}
 
 
+def bench_qos_scheduler(backlog: int = 2000, reps: int = 3):
+    """Scheduler-level QoS microbench (no jax, no engines): replay a
+    synthetic 2x-overload trace through the real FifoScheduler and
+    WfqScheduler objects.  Three numbers: (a) interactive jump-ahead —
+    queue positions an interactive arrival waits behind when it lands
+    on a full batch backlog (FIFO: the whole backlog; WFQ strict
+    priority: 0); (b) admission share under saturation for tenants
+    with 3:1:1 weights — WFQ tracks the weights while FIFO hands the
+    flooding tenant the share of its arrival rate; (c) raw push+pop
+    throughput so the WFQ virtual-time bookkeeping is shown to be
+    noise next to a single prefill."""
+    import time
+    from types import SimpleNamespace
+
+    from skypilot_tpu.infer.qos import WfqScheduler
+    from skypilot_tpu.infer.scheduler import FifoScheduler
+
+    def req(tenant, priority='batch', cost=128):
+        return SimpleNamespace(tokens=[1] * (cost - 1), max_new_tokens=1,
+                               priority=priority, tenant_id=tenant)
+
+    def make_wfq():
+        return WfqScheduler(weights={'gold': 3.0, 'silver': 1.0,
+                                     'bronze': 1.0})
+
+    # (a) jump-ahead: backlog batch requests queued, then 1 interactive.
+    jump = {}
+    for name, sched in (('fifo', FifoScheduler()), ('wfq', make_wfq())):
+        for i in range(backlog):
+            sched.push(req('bronze'))
+        sched.push(req('gold', priority='interactive'))
+        pos = 0
+        while True:
+            r = sched.pop()
+            if r.priority == 'interactive':
+                break
+            pos += 1
+        jump[name] = pos
+    # (b) saturation fairness: bronze floods 2x the arrival rate of
+    # gold/silver (the overload), scheduler drains a fixed admission
+    # window; share of admitted cost per tenant.
+    share = {}
+    for name, sched in (('fifo', FifoScheduler()), ('wfq', make_wfq())):
+        order = []
+        for i in range(backlog):
+            order.append(req('gold'))
+            order.append(req('silver'))
+            order.append(req('bronze'))
+            order.append(req('bronze'))
+        for r in order:
+            sched.push(r)
+        admitted = {}
+        for _ in range(backlog):          # drain 1/4 of the backlog
+            r = sched.pop()
+            admitted[r.tenant_id] = admitted.get(r.tenant_id, 0) + 1
+        total = sum(admitted.values())
+        share[name] = {t: round(n / total, 3)
+                       for t, n in sorted(admitted.items())}
+    # (c) push+pop throughput.
+    thr = {}
+    for name, make in (('fifo', FifoScheduler), ('wfq', make_wfq)):
+        best = 0.0
+        for _ in range(reps):
+            sched = make()
+            t0 = time.perf_counter()
+            for i in range(backlog):
+                sched.push(req(('gold', 'silver', 'bronze')[i % 3]))
+            while sched.pop() is not None:
+                pass
+            dt = time.perf_counter() - t0
+            best = max(best, 2 * backlog / dt)
+        thr[name] = round(best)
+    return {
+        'backlog': backlog,
+        'weights': {'gold': 3.0, 'silver': 1.0, 'bronze': 1.0},
+        'interactive_waits_behind': jump,
+        'admission_share_bronze_floods_2x': share,
+        'push_pop_ops_per_s': thr,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--out', default=None)
@@ -489,7 +570,24 @@ def main():
     ap.add_argument('--prefill-chunk', type=int, default=64,
                     help='chunk size for the chunked-prefill TTFT '
                          'comparison (0 skips it)')
+    ap.add_argument('--qos-only', action='store_true',
+                    help='run only the model-free qos scheduler '
+                         'section (no jax; CPU-friendly) and merge it '
+                         'into --out')
     args = ap.parse_args()
+    if args.qos_only:
+        qos = bench_qos_scheduler()
+        print(json.dumps(qos))
+        if args.out:
+            try:
+                doc = json.load(open(args.out))
+            except (FileNotFoundError, ValueError):
+                doc = {}
+            doc['qos_scheduler'] = qos
+            with open(args.out, 'w') as f:
+                json.dump(doc, f, indent=2)
+            print(f'wrote {args.out}')
+        return
     result = {
         'description':
             'r3 serving-feature microbenchmarks on one v5e chip '
@@ -517,6 +615,8 @@ def main():
     print(json.dumps(result['radix_prefix_cache']))
     result['lb_affinity'] = bench_lb_affinity()
     print(json.dumps(result['lb_affinity']))
+    result['qos_scheduler'] = bench_qos_scheduler()
+    print(json.dumps(result['qos_scheduler']))
     if args.out:
         with open(args.out, 'w') as f:
             json.dump(result, f, indent=2)

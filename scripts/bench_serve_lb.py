@@ -505,6 +505,219 @@ def run_affinity_bench(out: str, n_replicas: int = 3, groups: int = 8,
     print(f'wrote {out}')
 
 
+# ------------------------------------------------------ qos section
+
+
+def _qos_stream(port: int, tokens, max_new: int, priority: str,
+                tenant: str):
+    """(ttft_s, output_tokens) for one prioritized stream via the LB."""
+    conn = HTTPConnection('127.0.0.1', port, timeout=300)
+    t0 = time.time()
+    try:
+        conn.request('POST', '/generate',
+                     body=json.dumps({'tokens': tokens,
+                                      'max_new_tokens': max_new,
+                                      'stream': True,
+                                      'priority': priority,
+                                      'tenant_id': tenant}).encode(),
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f'HTTP {resp.status}')
+        buf, ttft, done = b'', None, None
+        while done is None:
+            chunk = resp.read1(65536)
+            if not chunk:
+                raise RuntimeError('stream ended without done event')
+            buf += chunk
+            while b'\n\n' in buf and done is None:
+                ev, buf = buf.split(b'\n\n', 1)
+                for line in ev.split(b'\n'):
+                    if line.startswith(b'data: '):
+                        msg = json.loads(line[6:])
+                        if msg.get('done'):
+                            done = msg
+                        elif ttft is None and msg.get('tokens'):
+                            ttft = time.time() - t0
+        if done.get('finish_reason') not in ('length', 'eos'):
+            raise RuntimeError(f'finish_reason={done.get("finish_reason")}'
+                               f' error={done.get("error")!r}')
+        return ttft if ttft is not None else time.time() - t0, \
+            done['output_tokens']
+    finally:
+        conn.close()
+
+
+def _batch_prompt(lane: int, seq: int, n: int = 96):
+    return [(lane * 131 + seq * 37 + 5 * j) % 97 + 1 for j in range(n)]
+
+
+def _interactive_prompt(i: int, n: int = 12):
+    return [(i * 41 + 7 * j) % 97 + 1 for j in range(n)]
+
+
+def run_qos_bench(out: str, interactive_n: int = 128,
+                  batch_lanes: int = 4) -> None:
+    """2x-overload QoS protection bench: one replica (2 decode slots,
+    chunked prefill + radix), `batch_lanes` closed-loop batch-tenant
+    lanes keeping 2x the slot count outstanding, and an open-loop
+    interactive tenant measuring TTFT through the LB.
+
+    Three arms: `uncontended` (interactive alone, the SLO floor),
+    `fifo` (QoS off — interactive queues behind the flood), `qos`
+    (WFQ + priority + chunk-boundary preemption).  The claim under
+    measurement: interactive p99 TTFT under overload stays within
+    1.5x uncontended while batch absorbs the queueing; and QoS only
+    ever reorders — every completed greedy stream is byte-identical
+    across arms."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import InferConfig
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    from skypilot_tpu.infer.engine import InferenceEngine
+    from skypilot_tpu.models.llama import LlamaConfig
+
+    os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    mc = LlamaConfig(name='qos-bench', vocab_size=101, hidden_size=64,
+                     intermediate_size=128, num_layers=2, num_heads=4,
+                     num_kv_heads=2, max_seq_len=256,
+                     tie_embeddings=True, dtype='float32')
+
+    def cfg(qos: bool) -> InferConfig:
+        # Largest bucket 32 so the 96-token batch prompts take the
+        # chunked path — that is what makes them preemptible.
+        return InferConfig(num_slots=2, max_cache_len=192,
+                           prefill_buckets=(16, 32), max_new_tokens=16,
+                           cache_dtype=jnp.float32, decode_steps=4,
+                           kv_block_size=16, kv_blocks=160,
+                           prefill_chunk=16, auto_prefix_cache=True,
+                           qos=qos)
+
+    def run_arm(name: str, qos: bool, flood: bool):
+        fleet = ChaosFleet(
+            lambda: InferenceEngine(mc, cfg(qos),
+                                    rng=jax.random.PRNGKey(0)),
+            1)
+        fleet.start()
+        try:
+            port = fleet.lb.port
+            # Warm every jit path the measurement hits (chunk rounds,
+            # both monolithic buckets, decode) INCLUDING the qos-only
+            # resume path: a parked job resumes as a radix suffix-only
+            # prefill, so prefix-sharing warm prompts compile each
+            # residual class (16 -> bucket16, 32 -> bucket32, 64 ->
+            # chunked) before any compile can land in a measured TTFT.
+            warm = [89] * 96
+            _qos_stream(port, warm, 16, 'batch', 'warm')
+            _qos_stream(port, warm[:80] + [23] * 16, 4, 'batch', 'warm')
+            _qos_stream(port, warm[:64] + [29] * 32, 4, 'batch', 'warm')
+            _qos_stream(port, warm[:32] + [31] * 64, 4, 'batch', 'warm')
+            _qos_stream(port, [88] * 24, 4, 'interactive', 'warm')
+            _qos_stream(port, [88] * 12, 4, 'interactive', 'warm')
+            _qos_stream(port, [87] * 12, 4, 'interactive', 'warm')
+            stop = threading.Event()
+            batch_out, batch_err = {}, []
+
+            def lane(lane_id: int):
+                seq = 0
+                while not stop.is_set():
+                    key = (lane_id, seq)
+                    try:
+                        _, toks = _qos_stream(
+                            port, _batch_prompt(lane_id, seq), 8,
+                            'batch', 'bulk')
+                        batch_out[key] = toks
+                    except Exception as e:  # pylint: disable=broad-except
+                        batch_err.append(f'{key}: {e}')
+                        return
+                    seq += 1
+
+            lanes = []
+            if flood:
+                lanes = [threading.Thread(target=lane, args=(i,),
+                                          daemon=True)
+                         for i in range(batch_lanes)]
+                for t in lanes:
+                    t.start()
+                time.sleep(0.5)       # flood reaches steady overload
+            ttfts, inter_out = [], {}
+            for i in range(interactive_n):
+                ttft, toks = _qos_stream(port, _interactive_prompt(i),
+                                         4, 'interactive', 'live')
+                ttfts.append(ttft)
+                inter_out[i] = toks
+                time.sleep(0.05)
+            stop.set()
+            for t in lanes:
+                t.join(timeout=120)
+            if batch_err:
+                raise RuntimeError(f'batch lane failed: {batch_err[:3]}')
+            eng = fleet.replicas[0].server.engine
+            vals = sorted(ttfts)
+            row = {
+                'arm': name,
+                'interactive_requests': interactive_n,
+                'batch_completed': len(batch_out),
+                'ttft_p50_s': statistics.median(vals),
+                'ttft_p95_s': vals[min(len(vals) - 1,
+                                       int(len(vals) * 0.95))],
+                'ttft_p99_s': vals[min(len(vals) - 1,
+                                       int(len(vals) * 0.99))],
+                'preemptions': eng.qos_stats['preemptions'],
+                'sheds': eng.qos_stats['sheds'],
+            }
+            print(json.dumps(row), flush=True)
+            return row, inter_out, batch_out
+        finally:
+            fleet.stop()
+
+    rows, inter_outs, batch_outs = {}, {}, {}
+    for name, qos, flood in [('uncontended', True, False),
+                             ('fifo', False, True),
+                             ('qos', True, True)]:
+        print(f'-- qos arm={name}', flush=True)
+        rows[name], inter_outs[name], batch_outs[name] = run_arm(
+            name, qos, flood)
+    # QoS reorders, never rewrites: greedy outputs byte-identical
+    # across arms (interactive everywhere; batch on the common keys
+    # the closed-loop lanes reached in both overload arms).
+    for name in ('fifo', 'qos'):
+        if inter_outs[name] != inter_outs['uncontended']:
+            raise RuntimeError(
+                f'interactive outputs diverged: {name} vs uncontended')
+    common = set(batch_outs['fifo']) & set(batch_outs['qos'])
+    for key in common:
+        if batch_outs['fifo'][key] != batch_outs['qos'][key]:
+            raise RuntimeError(f'batch outputs diverged at {key}')
+    summary = {
+        'overload': f'{2}x (closed-loop batch lanes = 2x decode slots)',
+        'interactive_p99_vs_uncontended_fifo':
+            rows['fifo']['ttft_p99_s'] / rows['uncontended']['ttft_p99_s'],
+        'interactive_p99_vs_uncontended_qos':
+            rows['qos']['ttft_p99_s'] / rows['uncontended']['ttft_p99_s'],
+        'within_1_5x':
+            rows['qos']['ttft_p99_s'] <=
+            1.5 * rows['uncontended']['ttft_p99_s'],
+        'batch_absorbed_queueing':
+            rows['qos']['batch_completed'] > 0,
+        'outputs_byte_identical': True,
+        'batch_keys_compared': len(common),
+    }
+    print(json.dumps(summary), flush=True)
+    try:
+        doc = json.load(open(out))
+    except (FileNotFoundError, ValueError):
+        doc = {}
+    doc['qos'] = {'rows': list(rows.values()), 'summary': summary,
+                  'model': 'tiny-cpu',
+                  'measured_at': 'load_balancer_endpoint'}
+    json.dump(doc, open(out, 'w'), indent=2)
+    print(f'wrote {out}')
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--qps', action='append', type=float, default=[])
@@ -538,10 +751,22 @@ def main() -> None:
     parser.add_argument('--affinity-replicas', type=int, default=3)
     parser.add_argument('--affinity-groups', type=int, default=8)
     parser.add_argument('--affinity-per-group', type=int, default=6)
+    parser.add_argument('--qos', action='store_true',
+                        help='run the 2x-overload QoS protection '
+                             'section (in-process fleet, CPU-friendly)')
+    parser.add_argument('--qos-interactive', type=int, default=128,
+                        help='interactive sample count (p99 needs '
+                             'enough draws to not be the single max)')
+    parser.add_argument('--qos-batch-lanes', type=int, default=4)
     args = parser.parse_args()
     if args.failover:
         run_failover_bench(args.failover_iters,
                            args.out or 'BENCH_SERVE_r06.json')
+        return
+    if args.qos:
+        run_qos_bench(args.out or 'BENCH_SERVE_r08.json',
+                      interactive_n=args.qos_interactive,
+                      batch_lanes=args.qos_batch_lanes)
         return
     if args.affinity:
         run_affinity_bench(args.out or 'BENCH_SERVE_r07.json',

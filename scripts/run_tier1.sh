@@ -32,6 +32,7 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_serve_failover.py \
     --require tests/test_skycheck.py \
     --require tests/test_lb_affinity.py \
+    --require tests/test_qos.py \
     --extra-seconds "skycheck:$SKYCHECK_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
 # accounting under randomized faults.  Outside the pytest window on

@@ -633,14 +633,42 @@ def serve():
                    'spec). prefix_affinity routes prompts sharing a '
                    'leading token-block prefix to the same replica so '
                    'the fleet approximates one radix prefix cache.')
+@click.option('--qos-policy', default=None,
+              type=click.Choice(['off', 'tenant_rate']),
+              help='LB-edge QoS (overrides the service spec): '
+                   'tenant_rate enforces per-tenant token-bucket rate '
+                   'limits at the load balancer (SKYTPU_SERVE_QOS_* '
+                   'knobs set the rates); over-rate tenants get a '
+                   'typed 429 + Retry-After.')
+@click.option('--slo-ttft-ms', default=None, type=float,
+              help='Autoscale to a latency SLO instead of QPS: keep '
+                   'the fleet\'s worst per-replica TTFT p95 under this '
+                   'many milliseconds (requires max_replicas in the '
+                   'service spec; mutually exclusive with '
+                   'target_qps_per_replica).')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_up(entrypoint, service_name, workdir, cloud, tpus, cpus,
              memory, use_spot, region, zone, num_nodes, env, lb_policy,
-             yes):
+             qos_policy, slo_ttft_ms, yes):
     """Bring up a service from a task YAML with a `service:` section."""
+    import dataclasses as _dc
     from skypilot_tpu import serve as serve_lib
     task = _make_task(entrypoint, None, workdir, cloud, tpus, cpus, memory,
                       use_spot, region, zone, num_nodes, env)
+    if (qos_policy is not None or slo_ttft_ms is not None) and \
+            task.service is None:
+        raise click.UsageError(
+            '--qos-policy/--slo-ttft-ms require a task with a '
+            '`service:` section')
+    if qos_policy is not None or slo_ttft_ms is not None:
+        # dataclasses.replace re-runs spec validation (e.g. slo_ttft_ms
+        # requires max_replicas) before anything launches.
+        overrides = {}
+        if qos_policy is not None:
+            overrides['qos_policy'] = qos_policy
+        if slo_ttft_ms is not None:
+            overrides['slo_ttft_ms'] = slo_ttft_ms
+        task.service = _dc.replace(task.service, **overrides)
     if not yes:
         click.confirm(f'Bring up service {service_name or task.name!r}?',
                       default=True, abort=True)
@@ -977,6 +1005,15 @@ def infer():
                    'twice registers itself as a resident prefix '
                    '(bucket-quantized lengths; vLLM-APC analog). '
                    'Explicit POST /cache_prefix always works.')
+@click.option('--qos', is_flag=True, default=False,
+              help='QoS admission: per-tenant weighted-fair queueing '
+                   '(tenant_id field), strict interactive>batch '
+                   'priority with preemption at chunked-prefill '
+                   'boundaries, and deadline-driven shedding of work '
+                   'projected to miss its deadline_s.')
+@click.option('--qos-tenant-weights', default=None,
+              help='WFQ tenant weights, e.g. "teamA=3,teamB=1" '
+                   '(unlisted tenants weigh 1.0; needs --qos).')
 @click.pass_context
 def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 tokenizer, eos_id, decode_steps, hf_model, cache_dtype,
@@ -984,7 +1021,7 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                 prefills_per_gap, platform, max_ttft, max_queue,
                 draft_len, ngram_max, max_prefixes, lora_rank,
                 lora_max_adapters, adapter_dir, adaptive_window,
-                decode_lookahead, auto_prefix):
+                decode_lookahead, auto_prefix, qos, qos_tenant_weights):
     """Start the HTTP inference server on this host."""
     from skypilot_tpu.infer import server as infer_server
     knobs = _apply_infer_profile(ctx, profile, {
@@ -1013,7 +1050,8 @@ def infer_serve(ctx, model, port, host, num_slots, max_cache_len,
                      adapter_dir=adapter_dir,
                      adaptive_window=adaptive_window,
                      decode_lookahead=decode_lookahead,
-                     auto_prefix=auto_prefix)
+                     auto_prefix=auto_prefix, qos=qos,
+                     qos_tenant_weights=qos_tenant_weights)
 
 
 @infer.command('bench')

@@ -60,6 +60,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.analysis import sanitizers
+from skypilot_tpu.infer import qos as qos_mod
+from skypilot_tpu.infer import scheduler as scheduler_mod
 from skypilot_tpu.infer.radix import RadixTree
 from skypilot_tpu.models.llama import (Llama, LlamaConfig, init_cache,
                                        init_paged_cache)
@@ -228,6 +230,19 @@ class InferConfig:
     # resets the window, so long runs are bounded by per-completion
     # gaps, not total wall time.
     run_stall_timeout_s: float = 120.0
+    # QoS serving (infer/qos.py): replace FIFO admission with priority
+    # classes (interactive > batch) + per-tenant weighted-fair
+    # queueing, let interactive arrivals preempt part-prefilled batch
+    # prompts at chunked-prefill boundaries (paged + radix only:
+    # parked blocks stay refcounted in the tree, resume is a
+    # suffix-only prefill), and shed queued work whose projected
+    # (queue + prefill + decode) time cannot meet its deadline_s —
+    # typed rejection at dequeue, not a timeout.  Offline generate()
+    # is unaffected (no queue, no scheduler).
+    qos: bool = False
+    # Per-tenant WFQ weights: Request.tenant_id -> relative share
+    # (default 1.0 for unlisted tenants).  Read only when qos=True.
+    qos_tenant_weights: Optional[Dict[str, float]] = None
 
 
 @dataclasses.dataclass
@@ -259,6 +274,17 @@ class Request:
     # paged blocks freed, finish_reason='deadline', partial output
     # returned — so a client that stopped caring never holds a lane.
     deadline_s: Optional[float] = None
+    # QoS class: 'interactive' (the default when None) or 'batch' —
+    # see infer/qos.PRIORITY_CLASSES.  Unknown values are rejected as
+    # client errors.  Ordering only matters when InferConfig.qos is
+    # on; batch prompts may additionally be preempted mid-prefill and
+    # resumed later (the stream is unaffected: nothing has been
+    # emitted before the first token).
+    priority: Optional[str] = None
+    # Fair-queueing key: requests sharing a tenant_id share one WFQ
+    # lane (weighted by InferConfig.qos_tenant_weights); None rides
+    # the shared default lane.  Also the LB's rate-limit key.
+    tenant_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -270,7 +296,7 @@ class RequestResult:
     latency_s: float              # arrival/submit -> last token
     finish_reason: str            # 'eos' | 'length' | 'error' | 'deadline'
     error: Optional[str] = None
-    error_class: Optional[str] = None   # 'client' | 'internal'
+    error_class: Optional[str] = None   # 'client' | 'internal' | 'shed'
     # log P(token | context) for each generated token (always present
     # on success — computed on-device next to sampling, cost is one
     # logsumexp the softmax path needs anyway).
@@ -689,6 +715,28 @@ class InferenceEngine:
         # control); always present so the serving loop can poll it
         # without caring about the layout.
         self._deferred: List[Request] = []  # guarded-by: _lock
+        # Admission-order seam (infer/scheduler.py): the serving loop
+        # drains its client queue into this scheduler and admits in
+        # whatever order it yields — strict FIFO by default, priority
+        # classes + per-tenant WFQ when cfg.qos is on (infer/qos.py).
+        # The scheduler carries its own lock (stats() reads cross-
+        # thread); it never calls back into the engine.
+        self._sched: scheduler_mod.Scheduler = (
+            qos_mod.WfqScheduler(
+                weights=self.cfg.qos_tenant_weights,
+                cost_fn=lambda r: len(r.tokens) + self._max_new(r))
+            if self.cfg.qos else scheduler_mod.FifoScheduler())
+        # QoS observability (stats()['qos'], /stats):
+        #   preemptions  batch chunk jobs parked for interactive work
+        #   sheds        typed deadline rejections at dequeue
+        self.qos_stats = {'preemptions': 0, 'sheds': 0}  # guarded-by: _lock
+        # Per-tenant admitted/shed counters (bounded: overflow tenants
+        # beyond _MAX_TENANT_ROWS fold into one row).
+        self._tenant_qos: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
+        # Observed service rate (tokens/s per request, EWMA) feeding
+        # the deadline-projection shed bound; fed in _finish_slot
+        # under _lock.
+        self._svc_estimator = qos_mod.ServiceEstimator()
         self._slots: List[Optional[_Slot]] = [None] * b  # guarded-by: _lock
         # Request ids cancelled while still PENDING (not yet slotted):
         # generate_stream drops them at dequeue/prefill time.  In-slot
@@ -1545,6 +1593,7 @@ class InferenceEngine:
                 'kv_bytes_total': total * row_bytes,
                 'kv_bytes_resident': total * row_bytes,
                 'faults': dict(self.fault_stats),
+                'qos': self._qos_section(),
             }
         bs_ = self.cfg.kv_block_size
         block_bytes = bs_ * row_bytes
@@ -1596,7 +1645,37 @@ class InferenceEngine:
             'admission_deferred': self.paged_stats['deferred'],
             'prefix_block_hits': self.paged_stats['prefix_block_hits'],
             'faults': dict(self.fault_stats),
+            'qos': self._qos_section(),
         }
+
+    def _qos_section(self) -> Dict[str, Any]:
+        """stats()['qos']: scheduler depths, preemption/shed counters,
+        per-tenant admitted/shed, and the shed bound's rate estimate.
+        Lock-free reads like the rest of stats() (counters race
+        benignly; the scheduler snapshots under its own lock)."""
+        return {
+            'enabled': bool(self.cfg.qos),
+            'scheduler': self._sched.stats(),
+            'preemptions': self.qos_stats['preemptions'],
+            'sheds': self.qos_stats['sheds'],
+            'service_rate_tokens_per_s': self._svc_estimator.rate(),
+            'tenants': {t: dict(c)
+                        for t, c in list(self._tenant_qos.items())},
+        }
+
+    # Per-tenant counter rows are bounded: a scraper with unbounded
+    # distinct tenant ids must not grow engine memory without limit.
+    _MAX_TENANT_ROWS = 256
+
+    def _tenant_row(self, tenant: Optional[str]) -> Dict[str, int]:  # locked: _lock
+        t = tenant or qos_mod.DEFAULT_TENANT
+        row = self._tenant_qos.get(t)
+        if row is None:
+            if len(self._tenant_qos) >= self._MAX_TENANT_ROWS:
+                t = '_overflow'
+            row = self._tenant_qos.setdefault(
+                t, {'admitted': 0, 'shed': 0})
+        return row
 
     # ---------------------------------------------------------- schedule
 
@@ -1653,6 +1732,11 @@ class InferenceEngine:
         if req.deadline_s is not None and req.deadline_s <= 0:
             raise ValueError(
                 f'deadline_s must be > 0 (got {req.deadline_s})')
+        if req.priority is not None and \
+                req.priority not in qos_mod.PRIORITY_CLASSES:
+            raise ValueError(
+                f'unknown priority {req.priority!r}; expected one of '
+                f'{list(qos_mod.PRIORITY_CLASSES)}')
         try:
             bucket: Optional[int] = self._bucket(n)
         except ValueError:
@@ -2558,6 +2642,12 @@ class InferenceEngine:
             except Exception:  # noqa: BLE001
                 pass
         now = time.time()
+        if reason in ('eos', 'length'):
+            # Clean finishes feed the QoS shed bound's service-rate
+            # EWMA (error/deadline durations would skew it short).
+            self._svc_estimator.observe(
+                len(s.request.tokens) + len(s.generated),
+                now - s.submit_time)
         res = RequestResult(
             request_id=s.request.request_id,
             prompt_tokens=list(s.request.tokens),
@@ -2630,6 +2720,83 @@ class InferenceEngine:
             error=error,
             error_class='internal' if error is not None else None)
         return job.req, res
+
+    # ------------------------------------------------------------- qos
+
+    def _park_chunk_job(self, slot: int) -> Request:  # locked: _lock
+        """Preempt a part-prefilled prompt at its chunk boundary: the
+        reserved slot frees NOW for higher-priority work; the rows
+        already written survive as refcounted radix blocks (adopted at
+        every chunk boundary — the adopt here is an idempotent catch-up
+        for a job parked before its first boundary insert), so resuming
+        is a suffix-only prefill, not lost work.  Nothing has streamed
+        (a chunk job has no _Slot yet), so the client sees one
+        uninterrupted stream whenever the request finally runs.
+        Returns the request for the caller to requeue."""
+        job = self._chunking.pop(slot)
+        if self._radix is not None and job.done > 0:
+            self._radix_adopt(slot, job.req.tokens, job.done,
+                              job.req.adapter)
+        self._lengths[slot] = 0
+        self._temps[slot] = 0.0
+        self._slot_adapters[slot] = -1
+        self._free_slot_blocks(slot)
+        self.qos_stats['preemptions'] += 1
+        return job.req
+
+    def _maybe_preempt_for(self, exclude) -> Optional[int]:
+        """Serving-loop preemption hook: every slot is taken, but an
+        INTERACTIVE request is waiting and some BATCH prompt is only
+        part-prefilled — park the batch job with the most prefill
+        still ahead of it and hand its slot over.  Gated on
+        paged + radix (that is what makes park/resume nearly free) and
+        on no in-flight lookahead window (its dead-row writes for the
+        parked lane would land in blocks the pool has already
+        recycled — the same hazard _chunk_round waits out)."""
+        if not (self.cfg.qos and self._paged and
+                self._radix is not None and self.cfg.prefill_chunk):
+            return None
+        if self._deferred or not self._sched.waiting('interactive'):
+            # Deferred head-of-line work is admitted first regardless
+            # of class — preempting for it would be a no-op.
+            return None
+        victim = None
+        with self._lock:
+            if self._ahead is not None:
+                return None
+            remaining = -1
+            for slot, job in self._chunking.items():
+                if slot in exclude or \
+                        qos_mod.classify(job.req) != 'batch':
+                    continue
+                if job.n - job.done > remaining:
+                    victim, remaining = slot, job.n - job.done
+            if victim is None:
+                return None
+            req = self._park_chunk_job(victim)
+        self._sched.requeue(req)
+        return victim
+
+    def _shed_request(self, req: Request, elapsed: float, reason: str,
+                      result_cb) -> None:
+        """Typed QoS shed at dequeue — ONE shape for both triggers
+        (deadline already expired in queue; projected completion
+        cannot meet the deadline).  finish_reason stays 'deadline'
+        (the historical eviction shape dashboards and tests pin) and
+        the historical deadline_evictions counter still ticks; the
+        reason text plus error_class='shed' mark it as an admission
+        rejection, and qos/tenant counters record who got shed."""
+        with self._lock:
+            self.fault_stats['deadline_evictions'] += 1
+            self.qos_stats['sheds'] += 1
+            self._tenant_row(req.tenant_id)['shed'] += 1
+            result_cb(RequestResult(
+                request_id=req.request_id,
+                prompt_tokens=list(req.tokens),
+                output_tokens=[], ttft_s=0.0,
+                latency_s=elapsed,
+                finish_reason='deadline',
+                error=reason, error_class='shed'))
 
     def _contain_failure(self, exc: BaseException,  # locked: _lock
                          phase: str) -> List[Tuple[Request,
@@ -3277,6 +3444,11 @@ class InferenceEngine:
         with self._lock:
             pending = list(self._deferred)
             self._deferred = []
+            while True:       # scheduler backlog dies with the loop too
+                r = self._sched.pop()
+                if r is None:
+                    break
+                pending.append(r)
             while True:
                 try:
                     pending.append(request_queue.get_nowait())
@@ -3316,13 +3488,28 @@ class InferenceEngine:
             to_start = []
             admit_extra = 0
             dequeued = cancelled_deq = 0
+            # Drain arrivals into the scheduler seam: admission ORDER
+            # is the scheduler's call (FIFO by default, priority +
+            # per-tenant WFQ under cfg.qos — infer/scheduler.py), not
+            # this loop's.
+            while True:
+                try:
+                    self._sched.push(request_queue.get_nowait())
+                except queue.Empty:
+                    break
             while True:
                 if len(to_start) >= self.cfg.prefills_per_gap and any(
                         s is not None for s in self._slots):
                     break  # let active slots decode; prefill more next gap
-                slot = self._free_slot(exclude=[it[1] for it in to_start])
+                excl = [it[1] for it in to_start]
+                slot = self._free_slot(exclude=excl)
                 if slot is None:
-                    break
+                    # QoS preemption: an interactive arrival may take
+                    # over a part-prefilled batch prompt's slot at its
+                    # chunk boundary (no-op unless cfg.qos).
+                    slot = self._maybe_preempt_for(excl)
+                    if slot is None:
+                        break
                 # Admission-deferred requests go first (head-of-line:
                 # a big request must not starve behind a stream of
                 # small ones that keep fitting around it).
@@ -3330,9 +3517,8 @@ class InferenceEngine:
                 if from_deferred:
                     req = self._deferred.pop(0)
                 else:
-                    try:
-                        req = request_queue.get_nowait()
-                    except queue.Empty:
+                    req = self._sched.pop()
+                    if req is None:
                         break
                 if self._paged:
                     demand = self._blocks_demand(
@@ -3381,20 +3567,35 @@ class InferenceEngine:
                     continue
                 dequeued += 1
                 now = time.time()
+                elapsed = (now - req.arrival_time
+                           if req.arrival_time is not None else 0.0)
+                shed_reason = None
                 if (req.deadline_s is not None and
                         req.arrival_time is not None and
-                        now - req.arrival_time >= req.deadline_s):
-                    # Expired while queued: never spend a prefill on it.
-                    # (Without arrival_time the deadline clock starts
-                    # at the submit_time below; _harvest enforces it.)
-                    with self._lock:
-                        self.fault_stats['deadline_evictions'] += 1
-                        result_cb(RequestResult(
-                            request_id=req.request_id,
-                            prompt_tokens=list(req.tokens),
-                            output_tokens=[], ttft_s=0.0,
-                            latency_s=now - req.arrival_time,
-                            finish_reason='deadline'))
+                        elapsed >= req.deadline_s):
+                    # Expired while queued: never spend a prefill on
+                    # it.  (Without arrival_time the deadline clock
+                    # starts at the submit_time below; _harvest
+                    # enforces it.)
+                    shed_reason = (
+                        f'deadline_s={req.deadline_s} expired in '
+                        f'queue ({elapsed:.3f}s elapsed)')
+                elif self.cfg.qos and req.deadline_s is not None:
+                    # Projection bound: with the observed service rate,
+                    # could this request's prefill + decode still land
+                    # inside its deadline?  If not, reject NOW — a
+                    # typed shed the client can retry elsewhere beats a
+                    # guaranteed mid-flight deadline eviction later.
+                    proj = self._svc_estimator.projected_s(
+                        len(req.tokens) + self._max_new(req))
+                    if proj is not None and \
+                            elapsed + proj > req.deadline_s:
+                        shed_reason = (
+                            f'projected completion {elapsed + proj:.3f}s '
+                            f'cannot meet deadline_s={req.deadline_s}')
+                if shed_reason is not None:
+                    self._shed_request(req, elapsed, shed_reason,
+                                       result_cb)
                     moved = True
                     continue
                 try:
@@ -3447,6 +3648,9 @@ class InferenceEngine:
                             self._cancelled.pop(it[0].request_id, None)
                         if to_start:
                             self._start_batch(to_start)
+                            for it in to_start:
+                                self._tenant_row(
+                                    it[0].tenant_id)['admitted'] += 1
                         for it in dropped:
                             result_cb(RequestResult(
                                 request_id=it[0].request_id,
@@ -3508,7 +3712,8 @@ class InferenceEngine:
                     # leftovers from the dequeue phase above).  A
                     # cancel-only streak decays the hint (see above).
                     self._arrivals_hint = (
-                        request_queue.qsize() >> self._cancel_only_streak)
+                        (request_queue.qsize() + self._sched.backlog())
+                        >> self._cancel_only_streak)
                     # The decode phase gets the same step-level
                     # containment prefill has always had: fail the
                     # injured requests, quarantine what can't be

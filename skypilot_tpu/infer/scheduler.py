@@ -1,0 +1,94 @@
+"""Admission-order policy seam for the serving loop (ROADMAP item 5).
+
+The engine's `_serve_loop` historically pulled straight off the client
+request queue — FIFO head-of-line admission baked into the loop body.
+This module extracts the ORDERING policy behind a small interface so
+alternative schedulers (the per-tenant weighted-fair queue in
+`infer/qos.py`) plug in without growing more inline engine code:
+
+    loop drains request_queue -> Scheduler.push()
+    loop asks Scheduler.pop() for the next request to admit
+    preempted / parked work re-enters via Scheduler.requeue()
+
+What stays ENGINE-side on purpose: paged-pool admission control
+(`_deferred` keeps strict head-of-line so a big request is never
+starved by small ones that keep fitting around it), request
+validation, cancellation, and deadline enforcement.  The scheduler
+decides only *which queued request is next*.
+
+Thread model: push/pop/requeue run on the serving-loop thread;
+backlog()/stats() may be called from any thread (server /stats), so
+every scheduler carries its own small lock — never call back into the
+engine from inside a scheduler (the engine lock may be held around
+requeue()).
+"""
+import collections
+import threading
+from typing import Any, Dict, List, Optional, TYPE_CHECKING
+
+from skypilot_tpu.analysis import sanitizers
+
+if TYPE_CHECKING:                     # import cycle guard: engine.py
+    from skypilot_tpu.infer.engine import Request  # pragma: no cover
+
+
+class Scheduler:
+    """Interface: which queued request does the engine admit next?"""
+
+    def push(self, req: 'Request') -> None:
+        """A request arrived (drained off the client queue)."""
+        raise NotImplementedError
+
+    def pop(self) -> Optional['Request']:
+        """Next request to admit, or None when nothing is queued."""
+        raise NotImplementedError
+
+    def requeue(self, req: 'Request') -> None:
+        """Give back a request the engine could not (or chose not to)
+        run yet — preempted chunk jobs re-enter here.  Must make the
+        request eligible again without re-charging its queueing cost."""
+        raise NotImplementedError
+
+    def backlog(self) -> int:
+        """Queued requests (feeds the engine's arrivals hint)."""
+        raise NotImplementedError
+
+    def waiting(self, priority: str) -> int:
+        """Queued requests of the given priority class (0 for
+        schedulers without class lanes) — the preemption trigger."""
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class FifoScheduler(Scheduler):
+    """The historical policy, verbatim: strict arrival order, one
+    lane, no classes.  The default (`InferConfig.qos = False`) — byte-
+    identical admission order to the pre-seam serving loop."""
+
+    def __init__(self) -> None:
+        self._q: collections.deque = collections.deque()  # guarded-by: _lock
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'infer.scheduler.fifo._lock')
+
+    def push(self, req: 'Request') -> None:
+        with self._lock:
+            self._q.append(req)
+
+    def pop(self) -> Optional['Request']:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def requeue(self, req: 'Request') -> None:
+        with self._lock:
+            self._q.appendleft(req)
+
+    def backlog(self) -> int:
+        return len(self._q)
+
+    def waiting(self, priority: str) -> int:
+        return 0                      # no class lanes in FIFO
+
+    def stats(self) -> Dict[str, Any]:
+        return {'policy': 'fifo', 'depth': {'all': len(self._q)}}

@@ -44,6 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from skypilot_tpu.analysis import sanitizers
+from skypilot_tpu.infer import qos as qos_mod
 from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
                                        Request, RequestResult,
                                        resolve_cache_dtype)
@@ -656,6 +657,8 @@ def _make_handler(server: InferenceServer):
                                 value.prompt_logprobs
                         if value.error:
                             final['error'] = value.error
+                        if value.error_class:
+                            final['error_class'] = value.error_class
                         if server.tokenizer is not None:
                             final['text'] = server.tokenizer.decode(
                                 value.output_tokens)
@@ -726,6 +729,10 @@ def _make_handler(server: InferenceServer):
                     # internal_errors, deadline_evictions, loop_restarts,
                     # quarantined_batches, nonfinite_lanes.
                     'faults': dict(eng.fault_stats),
+                    # QoS plane (engine.stats()['qos']): scheduler
+                    # depths per class, preemptions, sheds, per-tenant
+                    # admitted/shed.
+                    'qos': st.get('qos'),
                 })
             else:
                 self._json(404, {'error': 'not found'})
@@ -782,6 +789,14 @@ def _make_handler(server: InferenceServer):
                 deadline_raw = payload.get('deadline_s')
                 deadline_s = (None if deadline_raw is None
                               else float(deadline_raw))
+                # Extension fields: QoS class + fair-queueing tenant
+                # key (engine WFQ; the LB also rate-limits on tenant).
+                priority_raw = payload.get('priority')
+                priority = (None if priority_raw is None
+                            else str(priority_raw))
+                tenant_raw = payload.get('tenant_id')
+                tenant_id = (None if tenant_raw is None
+                             else str(tenant_raw))
             except (TypeError, ValueError) as e:
                 self._json(400, {'error': {'message': f'bad field: {e}',
                                            'type': 'invalid_request_error'}})
@@ -789,6 +804,14 @@ def _make_handler(server: InferenceServer):
             if deadline_s is not None and deadline_s <= 0:
                 self._json(400, {'error': {
                     'message': 'deadline_s must be > 0',
+                    'type': 'invalid_request_error'}})
+                return None
+            if priority is not None and \
+                    priority not in qos_mod.PRIORITY_CLASSES:
+                self._json(400, {'error': {
+                    'message': (
+                        f'unknown priority {priority!r}; expected one '
+                        f'of {list(qos_mod.PRIORITY_CLASSES)}'),
                     'type': 'invalid_request_error'}})
                 return None
             max_n = max(1, min(8, server.engine.cfg.num_slots))
@@ -909,7 +932,9 @@ def _make_handler(server: InferenceServer):
                           request_id=uuid.uuid4().hex,
                           adapter=adapter,
                           want_prompt_logprobs=want_lp and echo,
-                          deadline_s=deadline_s)
+                          deadline_s=deadline_s,
+                          priority=priority,
+                          tenant_id=tenant_id)
             return req, stop, opts
 
         @staticmethod
@@ -1372,11 +1397,21 @@ def _make_handler(server: InferenceServer):
                 temperature = float(payload.get('temperature', 0.0))
                 deadline = payload.get('deadline_s')
                 deadline = None if deadline is None else float(deadline)
+                priority = payload.get('priority')
+                priority = None if priority is None else str(priority)
+                tenant_id = payload.get('tenant_id')
+                tenant_id = None if tenant_id is None else str(tenant_id)
             except (TypeError, ValueError) as e:
                 self._json(400, {'error': f'bad field: {e}'})
                 return
             if deadline is not None and deadline <= 0:
                 self._json(400, {'error': 'deadline_s must be > 0'})
+                return
+            if priority is not None and \
+                    priority not in qos_mod.PRIORITY_CLASSES:
+                self._json(400, {'error': (
+                    f'unknown priority {priority!r}; expected one of '
+                    f'{list(qos_mod.PRIORITY_CLASSES)}')})
                 return
             req = Request(tokens=tokens, max_new_tokens=max_new,
                           temperature=temperature,
@@ -1384,7 +1419,9 @@ def _make_handler(server: InferenceServer):
                           adapter=payload.get('adapter'),
                           want_prompt_logprobs=bool(
                               payload.get('prompt_logprobs')),
-                          deadline_s=deadline)
+                          deadline_s=deadline,
+                          priority=priority,
+                          tenant_id=tenant_id)
             if payload.get('stream'):
                 # Admit BEFORE the SSE 200 goes out: a shed must be a
                 # clean 429 the client (and LB) can act on.
@@ -1419,6 +1456,13 @@ def _make_handler(server: InferenceServer):
                 'latency_s': res.latency_s,
                 'finish_reason': res.finish_reason,
             }
+            # Typed non-error terminals (deadline shed / cancel) carry
+            # their reason through — a client must be able to tell a
+            # QoS shed from having generated zero tokens.
+            if res.error:
+                out['error'] = res.error
+            if res.error_class:
+                out['error_class'] = res.error_class
             if payload.get('logprobs'):
                 out['logprobs'] = res.logprobs
             if payload.get('prompt_logprobs'):
@@ -1476,6 +1520,25 @@ def serve(engine: InferenceEngine, host: str = '0.0.0.0', port: int = 8100,
         srv.stop()
 
 
+def parse_tenant_weights(
+        spec: Optional[str]) -> Optional[Dict[str, float]]:
+    """'tenantA=3,tenantB=1.5' -> {'tenantA': 3.0, 'tenantB': 1.5}.
+    Shared by --qos-tenant-weights here and `skytpu infer serve`."""
+    if not spec:
+        return None
+    out: Dict[str, float] = {}
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        if '=' not in part:
+            raise ValueError(
+                f'bad tenant weight {part!r} (want tenant=weight)')
+        tenant, w = part.split('=', 1)
+        out[tenant.strip()] = float(w)
+    return out or None
+
+
 def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         num_slots: int = 8, max_cache_len: int = 2048,
         tokenizer_name: Optional[str] = None,
@@ -1501,7 +1564,9 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
         prefill_chunk: int = 0,
         kv_block_size: int = 0,
         kv_blocks: Optional[int] = None,
-        auto_prefix_cache: bool = False) -> None:
+        auto_prefix_cache: bool = False,
+        qos: bool = False,
+        qos_tenant_weights: Optional[str] = None) -> None:
     """Build engine (+ optional tokenizer) and serve.  Shared by the
     module entry point and the `skytpu infer serve` CLI.
 
@@ -1621,7 +1686,10 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       decode_lookahead=decode_lookahead,
                       prefill_chunk=prefill_chunk,
                       kv_block_size=kv_block_size, kv_blocks=kv_blocks,
-                      auto_prefix_cache=auto_prefix_cache)
+                      auto_prefix_cache=auto_prefix_cache,
+                      qos=qos,
+                      qos_tenant_weights=parse_tenant_weights(
+                          qos_tenant_weights))
     mesh = None
     if tensor_parallel and tensor_parallel > 1:
         import jax
@@ -1713,6 +1781,17 @@ def main() -> None:
                              'pressure. Supersedes the --auto-prefix '
                              'heuristic; /cache_prefix becomes optional '
                              'pinning')
+    parser.add_argument('--qos', action='store_true',
+                        help='QoS scheduling: priority classes '
+                             '(interactive > batch) + per-tenant '
+                             'weighted-fair queueing, batch preemption '
+                             'at chunk boundaries (with --prefill-chunk '
+                             '+ --auto-prefix-cache), and typed '
+                             'deadline shedding at dequeue')
+    parser.add_argument('--qos-tenant-weights', default=None,
+                        help='per-tenant WFQ weights, e.g. '
+                             '"teamA=3,teamB=1" (unlisted tenants '
+                             'weigh 1.0); requires --qos')
     args = parser.parse_args()
     run(model=args.model, host=args.host, port=args.port,
         num_slots=args.num_slots, max_cache_len=args.max_cache_len,
@@ -1729,7 +1808,8 @@ def main() -> None:
         auto_prefix=args.auto_prefix,
         prefill_chunk=args.prefill_chunk,
         kv_block_size=args.kv_block_size, kv_blocks=args.kv_blocks,
-        auto_prefix_cache=args.auto_prefix_cache)
+        auto_prefix_cache=args.auto_prefix_cache,
+        qos=args.qos, qos_tenant_weights=args.qos_tenant_weights)
 
 
 if __name__ == '__main__':

@@ -63,6 +63,8 @@ class Autoscaler:
     def make(cls, spec: SkyTpuServiceSpec) -> 'Autoscaler':
         if not spec.autoscaling_enabled:
             return Autoscaler(spec)
+        if spec.slo_ttft_ms is not None:
+            return SloLatencyAutoscaler(spec)
         if (spec.use_ondemand_fallback or
                 spec.base_ondemand_fallback_replicas > 0):
             return FallbackRequestRateAutoscaler(spec)
@@ -74,6 +76,13 @@ class Autoscaler:
 
     def collect_request_information(
             self, request_timestamps: List[float]) -> None:
+        pass
+
+    def collect_latency_information(
+            self, replica_latency: Dict[str, Any]) -> None:
+        """LB-measured per-replica latency summaries ({url:
+        {'ttft_p50_ms', 'ttft_p95_ms', 'count'}}), shipped on every
+        controller sync.  Base: ignored."""
         pass
 
     def evaluate_scaling(
@@ -205,6 +214,96 @@ class RequestRateAutoscaler(Autoscaler):
                                    {'replica_id': r.replica_id})
                 for r in order[:n_down]
             ]
+        return []
+
+
+class SloLatencyAutoscaler(Autoscaler):
+    """Scale to a latency SLO instead of a QPS proxy: the LB measures
+    per-replica TTFT at the relay (first SSE event / buffered
+    completion) and ships rolling-window percentiles on every
+    controller sync; this autoscaler holds the fleet's WORST replica
+    p95 under `spec.slo_ttft_ms`.
+
+    Target tracking is deliberately +-1 step-and-observe (not a ratio
+    jump like ceil(qps/target)): TTFT is a queueing-dominated,
+    nonlinear function of fleet size, so the controller steps, lets
+    the window refill, and re-evaluates.  Hysteresis mirrors the
+    request-rate autoscaler: breach must persist for
+    upscale_delay_seconds before +1; downscale additionally requires
+    p95 under slo * slo_downscale_factor (a comfort band, not just
+    "under SLO") for downscale_delay_seconds before -1."""
+
+    def __init__(self, spec: SkyTpuServiceSpec):
+        super().__init__(spec)
+        # Latest per-replica summary from the LB; replaced wholesale
+        # each sync (the LB owns the rolling window).
+        self.replica_latency: Dict[str, Any] = {}
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    # Test hook: tests drive scaling decisions with an injected clock.
+    def _now(self) -> float:
+        return time.time()  # det-ok: this IS the clock seam tests patch
+
+    def collect_latency_information(
+            self, replica_latency: Dict[str, Any]) -> None:
+        if isinstance(replica_latency, dict):
+            self.replica_latency = {
+                str(u): row for u, row in replica_latency.items()
+                if isinstance(row, dict)}
+
+    def fleet_ttft_p95_ms(self) -> Optional[float]:
+        """Worst replica p95 (the SLO is per-request, so the slowest
+        replica is the binding one), or None with no samples yet."""
+        worst = None
+        for row in self.replica_latency.values():
+            v = row.get('ttft_p95_ms')
+            if isinstance(v, (int, float)):
+                worst = float(v) if worst is None else max(
+                    worst, float(v))
+        return worst
+
+    def evaluate_scaling(
+            self, replicas: List[ReplicaView]) -> List[AutoscalerDecision]:
+        alive = [r for r in replicas if r.alive]
+        lo, hi = self.spec.min_replicas, self.spec.max_replicas
+        assert hi is not None       # enforced by spec validation
+        if len(alive) < lo:
+            # Below floor: replace immediately, no hysteresis.
+            return [
+                AutoscalerDecision(DecisionOperator.SCALE_UP,
+                                   {'use_spot': False})
+                for _ in range(lo - len(alive))
+            ]
+        assert self.spec.slo_ttft_ms is not None
+        slo = self.spec.slo_ttft_ms
+        p95 = self.fleet_ttft_p95_ms()
+        now = self._now()
+        if p95 is not None and p95 > slo and len(alive) < hi:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= self.spec.upscale_delay_seconds:
+                self._upscale_since = None
+                return [AutoscalerDecision(DecisionOperator.SCALE_UP,
+                                           {'use_spot': False})]
+            return []
+        if (p95 is not None and len(alive) > lo and
+                p95 < slo * constants.slo_downscale_factor()):
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if (now - self._downscale_since >=
+                    self.spec.downscale_delay_seconds):
+                self._downscale_since = None
+                victim = _scale_down_order(alive, self.latest_version)[0]
+                return [AutoscalerDecision(DecisionOperator.SCALE_DOWN,
+                                           {'replica_id':
+                                            victim.replica_id})]
+            return []
+        # In band (or no signal yet): hold, reset pressure timers.
+        self._upscale_since = None
+        self._downscale_since = None
         return []
 
 

@@ -133,3 +133,54 @@ def affinity_occupancy_high() -> float:
 
 def affinity_occupancy_penalty() -> float:
     return _f('SKYTPU_SERVE_AFFINITY_OCC_PENALTY', 2.0)
+
+
+# --------------------------------------------------------------- QoS
+# LB-level per-tenant token-bucket rate limits + SLO autoscaling
+# (serve/qos.py, autoscalers.SloLatencyAutoscaler).  Rates are
+# requests/second; <= 0 disables limiting for that scope.
+
+
+def qos_default_rate() -> float:
+    """Per-tenant request rate every tenant gets unless overridden by
+    SKYTPU_SERVE_QOS_TENANT_RATES.  <= 0 (the default) = unlimited:
+    turning the qos_policy on without configuring rates must not
+    reject anyone."""
+    return _f('SKYTPU_SERVE_QOS_RATE', 0.0)
+
+
+def qos_default_burst() -> float:
+    """Bucket capacity (requests) for tenants using the default rate;
+    <= 0 falls back to max(1, rate) — one second of traffic."""
+    return _f('SKYTPU_SERVE_QOS_BURST', 0.0)
+
+
+def qos_tenant_rates() -> dict:
+    """Per-tenant overrides: SKYTPU_SERVE_QOS_TENANT_RATES=
+    'teamA=5,teamB=0.5' (requests/second).  Malformed entries are
+    ignored rather than taking the LB down."""
+    out = {}
+    for part in os.environ.get('SKYTPU_SERVE_QOS_TENANT_RATES',
+                               '').split(','):
+        part = part.strip()
+        if not part or '=' not in part:
+            continue
+        tenant, rate = part.split('=', 1)
+        try:
+            out[tenant.strip()] = float(rate)
+        except ValueError:
+            continue
+    return out
+
+
+def slo_latency_window() -> int:
+    """Rolling per-replica latency samples the LB keeps for the SLO
+    autoscaler signal (and /lb/stats)."""
+    return int(_f('SKYTPU_SERVE_SLO_WINDOW', 256))
+
+
+def slo_downscale_factor() -> float:
+    """SLO autoscaler scales DOWN only while observed TTFT stays under
+    this fraction of the target (hysteresis band: between factor*SLO
+    and SLO the fleet holds)."""
+    return _f('SKYTPU_SERVE_SLO_DOWNSCALE_FACTOR', 0.5)

@@ -53,6 +53,11 @@ class ServeController:
         # Per-replica prefix-affinity routing counters ({url: {'hits',
         # 'spills'}}), shipped by the LB when its policy exports them.
         self._lb_affinity: dict = {}  # guarded-by: _lb_lock
+        # QoS plane views from the LB sync (same path as affinity):
+        # per-tenant rate-limit counters and per-replica TTFT
+        # percentile summaries.
+        self._lb_tenant_qos: dict = {}  # guarded-by: _lb_lock
+        self._lb_latency: dict = {}  # guarded-by: _lb_lock
 
     # ----------------------------------------------------------- HTTP API
 
@@ -63,8 +68,14 @@ class ServeController:
             inflight = payload.get('replica_inflight')
             draining = payload.get('replica_draining')
             affinity = payload.get('replica_affinity')
+            tenant_qos = payload.get('tenant_qos')
+            latency = payload.get('replica_latency')
+            if isinstance(latency, dict):
+                self.autoscaler.collect_latency_information(latency)
             if isinstance(inflight, dict) or isinstance(draining, list) \
-                    or isinstance(affinity, dict):
+                    or isinstance(affinity, dict) \
+                    or isinstance(tenant_qos, dict) \
+                    or isinstance(latency, dict):
                 with self._lb_lock:
                     if isinstance(inflight, dict):
                         self._lb_inflight = {
@@ -75,6 +86,12 @@ class ServeController:
                     if isinstance(affinity, dict):
                         self._lb_affinity = {
                             str(k): v for k, v in affinity.items()
+                            if isinstance(v, dict)}
+                    if isinstance(tenant_qos, dict):
+                        self._lb_tenant_qos = dict(tenant_qos)
+                    if isinstance(latency, dict):
+                        self._lb_latency = {
+                            str(k): v for k, v in latency.items()
                             if isinstance(v, dict)}
             return {
                 'ready_replica_urls':
@@ -110,6 +127,12 @@ class ServeController:
                                autoscalers.RequestRateAutoscaler)):
                 new_autoscaler.request_timestamps = list(
                     self.autoscaler.request_timestamps)
+            if (isinstance(new_autoscaler,
+                           autoscalers.SloLatencyAutoscaler) and
+                    isinstance(self.autoscaler,
+                               autoscalers.SloLatencyAutoscaler)):
+                new_autoscaler.replica_latency = dict(
+                    self.autoscaler.replica_latency)
             new_autoscaler.latest_version = self.version
             self.autoscaler = new_autoscaler
             self.replica_manager.update_version(spec, task_yaml,
@@ -132,6 +155,8 @@ class ServeController:
             lb_inflight = dict(self._lb_inflight)
             lb_draining = set(self._lb_draining)
             lb_affinity = dict(self._lb_affinity)
+            lb_tenant_qos = dict(self._lb_tenant_qos)
+            lb_latency = dict(self._lb_latency)
         replicas = []
         for r in serve_state.get_replicas(self.service_name):
             endpoint = r.get('endpoint')
@@ -146,9 +171,11 @@ class ServeController:
                 'inflight': lb_inflight.get(endpoint, 0),
                 'draining': endpoint in lb_draining,
                 'affinity': lb_affinity.get(endpoint),
+                'latency': lb_latency.get(endpoint),
             })
         return {'service': self.service_name, 'version': self.version,
-                'replicas': replicas}
+                'replicas': replicas,
+                'qos': lb_tenant_qos}
 
     def _serve_http(self) -> None:
         controller = self

@@ -37,7 +37,9 @@ Replica fault tolerance (the supervisor half of the proxy):
 ``GET /lb/stats`` exports the counters (attempts, failovers, breaker
 opens, drains honored, streams resumed).
 """
+import collections
 import json
+import math
 import socket
 import threading
 import time
@@ -53,6 +55,7 @@ import numpy as np
 from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu import logsys
 from skypilot_tpu.serve import constants
+from skypilot_tpu.serve import qos as serve_qos
 from skypilot_tpu.serve.circuit_breaker import CircuitBreaker
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         RequestContext)
@@ -175,13 +178,49 @@ class SkyTpuLoadBalancer:
             'non_resumable_failures': 0,
             'deadline_exhausted': 0,
             'probe_failures': 0,
+            'rate_limited': 0,
         }
+        # LB-side QoS plane: per-tenant token buckets (serve/qos.py)
+        # share the LB's injected clock so rate-limit tests replay
+        # deterministically.
+        self.limiter = serve_qos.TenantRateLimiter(clock=self._clock)
+        # Per-replica TTFT samples (seconds), bounded rolling windows.
+        # Streamed generates record time-to-first-event; buffered
+        # generates record whole-response latency (an upper bound on
+        # TTFT — still SLO-relevant signal).  Summaries feed /lb/stats
+        # and the controller sync for the SLO autoscaler.
+        self._latency: Dict[str, collections.deque] = {}  # guarded-by: _stats_lock
 
     # ----------------------------------------------------- health/breakers
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self._counters[key] = self._counters.get(key, 0) + n
+
+    def _record_ttft(self, replica: str, seconds: float) -> None:
+        with self._stats_lock:
+            window = self._latency.get(replica)
+            if window is None:
+                window = collections.deque(
+                    maxlen=constants.slo_latency_window())
+                self._latency[replica] = window
+            window.append(seconds)
+
+    def _latency_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-replica TTFT percentiles (ms) over the rolling window —
+        the SLO autoscaler's target-tracking input."""
+        with self._stats_lock:
+            samples = {u: list(w) for u, w in self._latency.items() if w}
+        out: Dict[str, Dict[str, float]] = {}
+        for url, vals in samples.items():
+            vals.sort()
+            out[url] = {
+                'ttft_p50_ms': 1000.0 * vals[len(vals) // 2],
+                'ttft_p95_ms': 1000.0 * vals[
+                    min(len(vals) - 1, int(math.ceil(0.95 * len(vals))) - 1)],
+                'count': len(vals),
+            }
+        return out
 
     def _rep(self, url: str) -> _ReplicaHealth:
         with self._health_lock:
@@ -287,6 +326,8 @@ class SkyTpuLoadBalancer:
                            'replica_draining': draining,
                            'replica_affinity':
                                self.policy.stats().get('per_replica', {}),
+                           'tenant_qos': self.limiter.stats(),
+                           'replica_latency': self._latency_summary(),
                            }).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',
@@ -439,9 +480,13 @@ class SkyTpuLoadBalancer:
             tokens=(list(tokens) if isinstance(tokens, list) and
                     all(isinstance(t, int) for t in tokens) else None),
             adapter=adapter if isinstance(adapter, str) else None)
+        tenant = payload.get('tenant_id')
+        priority = payload.get('priority')
         return {'payload': payload, 'stream': bool(payload.get('stream')),
                 'deadline_s': deadline, 'resumable': resumable,
-                'path': path, 'context': context}
+                'path': path, 'context': context,
+                'tenant_id': tenant if isinstance(tenant, str) else None,
+                'priority': priority if isinstance(priority, str) else None}
 
     @staticmethod
     def _replica_headers(replica: str) -> Dict[str, str]:
@@ -459,6 +504,7 @@ class SkyTpuLoadBalancer:
         conn = HTTPConnection(parsed.hostname, parsed.port,
                               timeout=timeout)
         body = json.dumps(payload).encode()
+        t0 = self._clock()
         try:
             conn.request('POST', path, body=body,
                          headers=self._replica_headers(replica))
@@ -479,6 +525,10 @@ class SkyTpuLoadBalancer:
             declared = resp.getheader('Content-Length')
             if declared is not None and len(data) < int(declared):
                 return 'broken'   # close-truncated body: retry elsewhere
+            if resp.status == 200:
+                # Whole-response latency: upper bound on TTFT, still
+                # the right sign for SLO target tracking.
+                self._record_ttft(replica, self._clock() - t0)
         finally:
             conn.close()
         try:
@@ -506,6 +556,8 @@ class SkyTpuLoadBalancer:
         conn = HTTPConnection(parsed.hostname, parsed.port,
                               timeout=timeout)
         body = json.dumps(payload).encode()
+        t0 = self._clock()
+        ttft_recorded = False
         try:
             conn.request('POST', path, body=body,
                          headers=self._replica_headers(replica))
@@ -553,6 +605,11 @@ class SkyTpuLoadBalancer:
                 while b'\n\n' in buf:
                     event, buf = buf.split(b'\n\n', 1)
                     raw = event + b'\n\n'
+                    if not ttft_recorded:
+                        # First complete event out of this replica:
+                        # its time-to-first-token, SLO feed.
+                        ttft_recorded = True
+                        self._record_ttft(replica, self._clock() - t0)
                     obj = self._parse_sse_event(event)
                     if obj is not None and obj.get('done'):
                         if relay.resumed:
@@ -612,12 +669,45 @@ class SkyTpuLoadBalancer:
         length = int(handler.headers.get('Content-Length', 0) or 0)
         body = handler.rfile.read(length) if length else None
         route = self._parse_generate(handler.path, handler.command, body)
+        tenant = (route['tenant_id'] if route is not None
+                  else self._peek_tenant(body))
+        retry_after = self.limiter.check(tenant)
+        if retry_after is not None:
+            # Typed admission rejection at the LB edge: the tenant is
+            # over its token-bucket rate; no replica does any work.
+            self._bump('rate_limited')
+            self._send_json(
+                handler, 429,
+                {'error': f'tenant {tenant or serve_qos.DEFAULT_TENANT!r}'
+                          ' over its configured rate limit',
+                 'error_class': 'rate_limited',
+                 'retry_after_s': retry_after},
+                headers={'Retry-After':
+                         str(max(1, int(math.ceil(retry_after))))})
+            return
         if route is None:
             self._handle_passthrough(handler, body)
         elif route['stream']:
             self._handle_stream_generate(handler, route)
         else:
             self._handle_buffered_generate(handler, route)
+
+    @staticmethod
+    def _peek_tenant(body: Optional[bytes]) -> Optional[str]:
+        """Best-effort tenant_id from a passthrough JSON body (the
+        /v1/* OpenAI paths accept tenant_id as an extension field) so
+        LB rate limits cover every generate surface, not just the
+        native routes."""
+        if not body:
+            return None
+        try:
+            payload = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        tenant = payload.get('tenant_id')
+        return tenant if isinstance(tenant, str) else None
 
     def _deadline_clock(self, route: Optional[dict]):
         """Returns remaining() -> Optional[float]: the client's unspent
@@ -888,6 +978,8 @@ class SkyTpuLoadBalancer:
             'outstanding': outstanding,
             'ready_replicas': list(self.policy.ready_replicas),
             'policy': self.policy.stats(),
+            'qos': self.limiter.stats(),
+            'replica_latency': self._latency_summary(),
         })
         return counters
 

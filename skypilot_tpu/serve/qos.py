@@ -1,0 +1,117 @@
+"""LB-side QoS: per-tenant token-bucket rate limiting.
+
+The engine-side QoS plane (`infer/qos.py`) makes overload *fair*; this
+module keeps overload *bounded* before it ever reaches a replica: each
+tenant gets a token bucket at the load balancer, and a tenant over its
+rate receives a typed 429 with a `Retry-After` hint instead of queueing
+into everyone else's tail.  Counters feed `/lb/stats` and are synced to
+the controller so `GET /controller/state` shows who is being limited
+(the same path PR 7 used for affinity counters).
+
+Determinism: the clock is injected (the LB passes its own `clock`
+seam), so tests drive buckets with a fake clock — no wall-clock reads
+in here (analysis/determinism.py scope).
+"""
+import threading
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.analysis import sanitizers
+from skypilot_tpu.serve import constants
+
+# Tenant key for requests that carry no tenant_id: they share one
+# bucket at the default rate rather than bypassing limiting.
+DEFAULT_TENANT = '_default'
+
+
+class TokenBucket:
+    """Classic token bucket: `rate` tokens/second refill up to `burst`
+    capacity; try_acquire() spends one token or returns the seconds
+    until one is available (the 429's Retry-After)."""
+
+    def __init__(self, rate: float, burst: float, clock) -> None:
+        if rate <= 0:
+            raise ValueError(f'rate must be > 0 (got {rate})')
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else max(1.0, self.rate)
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+
+    def try_acquire(self, n: float = 1.0) -> Optional[float]:
+        """None = admitted (token spent); else seconds until `n`
+        tokens will have refilled (never negative)."""
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return None
+        return max(0.0, (n - self._tokens) / self.rate)
+
+
+class TenantRateLimiter:
+    """Per-tenant token buckets with admitted/rejected counters.
+
+    Rates resolve per tenant: an explicit entry in `tenant_rates`
+    wins; otherwise `default_rate` applies; a resolved rate <= 0 means
+    UNLIMITED for that tenant (check() always admits).  Buckets are
+    created lazily and bounded (beyond `max_tenants` distinct ids the
+    overflow shares one bucket — a tenant-id-spraying client must not
+    grow LB memory without limit)."""
+
+    _OVERFLOW = '_overflow'
+
+    def __init__(self, default_rate: Optional[float] = None,
+                 default_burst: Optional[float] = None,
+                 tenant_rates: Optional[Dict[str, float]] = None,
+                 clock=None, max_tenants: int = 1024) -> None:
+        assert clock is not None, 'inject the LB clock seam'
+        self._clock = clock
+        self._default_rate = (constants.qos_default_rate()
+                              if default_rate is None else default_rate)
+        self._default_burst = (constants.qos_default_burst()
+                               if default_burst is None else default_burst)
+        self._tenant_rates = (constants.qos_tenant_rates()
+                              if tenant_rates is None else
+                              dict(tenant_rates))
+        self._max_tenants = max_tenants
+        self._buckets: Dict[str, Optional[TokenBucket]] = {}  # guarded-by: _lock
+        self._counters: Dict[str, Dict[str, int]] = {}  # guarded-by: _lock
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.qos.limiter._lock')
+
+    def _rate_for(self, tenant: str) -> float:
+        return float(self._tenant_rates.get(tenant, self._default_rate))
+
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:  # locked: _lock
+        if tenant not in self._buckets and \
+                len(self._buckets) >= self._max_tenants:
+            tenant = self._OVERFLOW
+        if tenant not in self._buckets:
+            rate = self._rate_for(tenant)
+            self._buckets[tenant] = (
+                TokenBucket(rate, self._default_burst, self._clock)
+                if rate > 0 else None)     # None = unlimited
+        return self._buckets[tenant]
+
+    def check(self, tenant_id: Optional[str]) -> Optional[float]:
+        """One request from `tenant_id`: None = admitted, else the
+        Retry-After seconds for the typed 429."""
+        tenant = tenant_id if tenant_id else DEFAULT_TENANT
+        with self._lock:
+            bucket = self._bucket(tenant)
+            retry_after = None if bucket is None else bucket.try_acquire()
+            row = self._counters.setdefault(
+                tenant if tenant in self._buckets else self._OVERFLOW,
+                {'admitted': 0, 'rejected': 0})
+            row['admitted' if retry_after is None else 'rejected'] += 1
+            return retry_after
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                'default_rate': self._default_rate,
+                'tenants': {t: dict(c)
+                            for t, c in self._counters.items()},
+            }

@@ -28,6 +28,16 @@ class SkyTpuServiceSpec:
     target_qps_per_replica: Optional[float] = None
     upscale_delay_seconds: float = 300.0
     downscale_delay_seconds: float = 1200.0
+    # SLO-driven autoscaling (alternative to target_qps_per_replica):
+    # scale so the fleet's worst per-replica TTFT p95 stays under
+    # slo_ttft_ms.  slo_tpot_ms is recorded for observability/benching
+    # (decode-rate SLO); the autoscaler currently tracks TTFT.
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+    # LB-edge QoS: None/'off' (no per-tenant rate limiting knobs pushed)
+    # or 'tenant_rate' (per-tenant token buckets; rates come from the
+    # SKYTPU_SERVE_QOS_* environment knobs on the LB host).
+    qos_policy: Optional[str] = None
     # Spot policy (FallbackRequestRateAutoscaler parity).
     use_ondemand_fallback: bool = False
     base_ondemand_fallback_replicas: int = 0
@@ -49,6 +59,22 @@ class SkyTpuServiceSpec:
             if self.max_replicas is None:
                 raise exceptions.InvalidTaskError(
                     'target_qps_per_replica requires max_replicas')
+        if self.slo_ttft_ms is not None:
+            if self.slo_ttft_ms <= 0:
+                raise exceptions.InvalidTaskError('slo_ttft_ms must be > 0')
+            if self.max_replicas is None:
+                raise exceptions.InvalidTaskError(
+                    'slo_ttft_ms requires max_replicas')
+            if self.target_qps_per_replica is not None:
+                raise exceptions.InvalidTaskError(
+                    'slo_ttft_ms and target_qps_per_replica are mutually '
+                    'exclusive: pick ONE autoscaling signal')
+        if self.slo_tpot_ms is not None and self.slo_tpot_ms <= 0:
+            raise exceptions.InvalidTaskError('slo_tpot_ms must be > 0')
+        if self.qos_policy not in (None, 'off', 'tenant_rate'):
+            raise exceptions.InvalidTaskError(
+                f'qos_policy must be "off" or "tenant_rate", got '
+                f'{self.qos_policy!r}')
         if not self.readiness_path.startswith('/'):
             raise exceptions.InvalidTaskError(
                 f'readiness path must start with "/": '
@@ -56,7 +82,8 @@ class SkyTpuServiceSpec:
 
     @property
     def autoscaling_enabled(self) -> bool:
-        return self.target_qps_per_replica is not None
+        return (self.target_qps_per_replica is not None or
+                self.slo_ttft_ms is not None)
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyTpuServiceSpec':
@@ -104,11 +131,17 @@ class SkyTpuServiceSpec:
         if 'dynamic_ondemand_fallback' in policy:
             kwargs['use_ondemand_fallback'] = bool(
                 policy['dynamic_ondemand_fallback'])
+        if 'slo_ttft_ms' in policy:
+            kwargs['slo_ttft_ms'] = float(policy['slo_ttft_ms'])
+        if 'slo_tpot_ms' in policy:
+            kwargs['slo_tpot_ms'] = float(policy['slo_tpot_ms'])
         if 'port' in config:
             kwargs['port'] = int(config['port'])
         if 'load_balancing_policy' in config:
             kwargs['load_balancing_policy'] = config[
                 'load_balancing_policy']
+        if 'qos_policy' in config:
+            kwargs['qos_policy'] = config['qos_policy']
         return cls(**kwargs)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -133,6 +166,12 @@ class SkyTpuServiceSpec:
                 self.base_ondemand_fallback_replicas)
         if self.use_ondemand_fallback:
             policy['dynamic_ondemand_fallback'] = True
+        if self.slo_ttft_ms is not None:
+            policy['slo_ttft_ms'] = self.slo_ttft_ms
+            policy['upscale_delay_seconds'] = self.upscale_delay_seconds
+            policy['downscale_delay_seconds'] = self.downscale_delay_seconds
+        if self.slo_tpot_ms is not None:
+            policy['slo_tpot_ms'] = self.slo_tpot_ms
         cfg: Dict[str, Any] = {
             'readiness_probe': probe,
             'replica_policy': policy,
@@ -140,6 +179,8 @@ class SkyTpuServiceSpec:
         }
         if self.load_balancing_policy is not None:
             cfg['load_balancing_policy'] = self.load_balancing_policy
+        if self.qos_policy is not None:
+            cfg['qos_policy'] = self.qos_policy
         return cfg
 
     def to_json(self) -> str:

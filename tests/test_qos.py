@@ -1,0 +1,545 @@
+"""QoS plane: WFQ fairness, priority preemption, deadline shedding,
+LB rate limiting, SLO autoscaling (tier-1, CPU, tiny model).
+
+The contract under test (infer/qos.py + serve/qos.py + wiring): QoS
+reorders and rejects work, it never CHANGES work — every completed
+greedy stream stays byte-identical to a QoS-off run; scheduler math is
+virtual-time (no wall clock), LB buckets and the SLO autoscaler run on
+injected clocks, so nothing here sleeps to make time pass.
+"""
+import copy
+import json
+import queue
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+from skypilot_tpu.infer import qos as iqos  # noqa: E402
+from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
+                                       Request)  # noqa: E402
+from skypilot_tpu.infer.scheduler import FifoScheduler  # noqa: E402
+from skypilot_tpu.models.llama import LlamaConfig  # noqa: E402
+from skypilot_tpu.serve import autoscalers  # noqa: E402
+from skypilot_tpu.serve import qos as sqos  # noqa: E402
+from skypilot_tpu.serve.serve_state import ReplicaStatus  # noqa: E402
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec  # noqa: E402
+
+
+def _req(rid, tokens=(1, 2, 3), max_new=4, **kw):
+    return Request(request_id=rid, tokens=list(tokens),
+                   max_new_tokens=max_new, **kw)
+
+
+# ------------------------------------------------------- WFQ scheduler
+
+
+def test_fifo_scheduler_is_arrival_order():
+    s = FifoScheduler()
+    for i in range(5):
+        s.push(_req(str(i)))
+    assert s.backlog() == 5
+    assert [s.pop().request_id for _ in range(5)] == list('01234')
+    assert s.pop() is None
+
+
+def test_wfq_strict_priority_interactive_first():
+    s = iqos.WfqScheduler()
+    s.push(_req('b1', priority='batch'))
+    s.push(_req('b2', priority='batch'))
+    s.push(_req('i1', priority='interactive'))
+    s.push(_req('i2'))           # unset priority -> interactive
+    got = [s.pop().request_id for _ in range(4)]
+    assert got[:2] == ['i1', 'i2']
+    assert sorted(got[2:]) == ['b1', 'b2']
+
+
+def test_wfq_fairness_under_saturation_tracks_weights():
+    """Saturated queue, weights 3:1, equal-cost requests: admitted
+    service share converges to the weight ratio."""
+    s = iqos.WfqScheduler(weights={'heavy': 3.0, 'light': 1.0})
+    for i in range(30):
+        s.push(_req(f'h{i}', tokens=[1] * 4, max_new=4,
+                    tenant_id='heavy'))
+        s.push(_req(f'l{i}', tokens=[1] * 4, max_new=4,
+                    tenant_id='light'))
+    for _ in range(24):
+        assert s.pop() is not None
+    served = s.stats()['tenants']
+    ratio = served['heavy']['served_cost'] / served['light']['served_cost']
+    assert 2.4 <= ratio <= 3.6, ratio
+    # Cost-based, not count-based: one big request spends the same
+    # budget as many small ones.
+    s2 = iqos.WfqScheduler()
+    s2.push(_req('big', tokens=[1] * 32, max_new=32, tenant_id='a'))
+    for i in range(8):
+        s2.push(_req(f'sm{i}', tokens=[1] * 4, max_new=4, tenant_id='b'))
+    first_b = 0
+    for _ in range(5):
+        r = s2.pop()
+        first_b += r.tenant_id == 'b'
+    assert first_b >= 4       # b's small requests run while a's one
+    #                           big request spends its budget
+
+
+def test_wfq_requeue_is_front_of_lane_and_not_recharged():
+    s = iqos.WfqScheduler()
+    a1, a2 = _req('a1', tenant_id='a'), _req('a2', tenant_id='a')
+    s.push(a1)
+    s.push(a2)
+    got = s.pop()
+    assert got.request_id == 'a1'
+    s.requeue(got)               # preempted: must come back first
+    assert s.pop().request_id == 'a1'
+    assert s.pop().request_id == 'a2'
+    assert s.backlog() == 0
+
+
+def test_service_estimator_ewma_and_projection():
+    est = iqos.ServiceEstimator(alpha=0.5)
+    assert est.rate() is None
+    assert est.projected_s(100) is None       # no signal: never shed
+    est.observe(100, 1.0)
+    assert est.rate() == pytest.approx(100.0)
+    est.observe(200, 1.0)
+    assert est.rate() == pytest.approx(150.0)
+    assert est.projected_s(300) == pytest.approx(2.0)
+    est.observe(0, 1.0)                       # degenerate: ignored
+    est.observe(10, 0.0)
+    assert est.rate() == pytest.approx(150.0)
+
+
+# ----------------------------------------------- engine (tiny model)
+
+
+@pytest.fixture(scope='module')
+def tiny_config():
+    return LlamaConfig(name='qos-test', vocab_size=101, hidden_size=32,
+                       intermediate_size=64, num_layers=2, num_heads=4,
+                       num_kv_heads=2, max_seq_len=128,
+                       tie_embeddings=True, dtype='float32')
+
+
+COMMON = dict(num_slots=4, max_cache_len=64, prefill_buckets=(8, 16, 32),
+              max_new_tokens=8, cache_dtype=jnp.float32)
+
+
+@pytest.fixture(scope='module')
+def shared_params(tiny_config):
+    eng = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          rng=jax.random.PRNGKey(0))
+    return eng.params
+
+
+def _serve(eng, jobs, timeout=120):
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    for job in jobs:
+        q.put(copy.deepcopy(job))
+    t = threading.Thread(
+        target=eng.generate_stream,
+        args=(q, lambda res: results.__setitem__(res.request_id, res),
+              stop), daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + timeout
+        while len(results) < len(jobs) and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert len(results) == len(jobs), (
+        f'only {len(results)}/{len(jobs)} requests got a result')
+    return results
+
+
+def test_qos_reorders_but_never_changes_tokens(tiny_config,
+                                               shared_params):
+    """Mixed tenants/priorities through a qos engine: every completed
+    greedy stream is byte-identical to the same request on a qos-off
+    engine (QoS decides order and admission, never content)."""
+    off = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    on = InferenceEngine(tiny_config,
+                         InferConfig(qos=True,
+                                     qos_tenant_weights={'teamA': 2.0},
+                                     **COMMON),
+                         params=shared_params,
+                         rng=jax.random.PRNGKey(7))
+    jobs = []
+    for i in range(10):
+        jobs.append(_req(str(i),
+                         tokens=[(5 * i + j) % 97 + 1
+                                 for j in range(3 + i % 5)],
+                         max_new=6,
+                         priority='batch' if i % 3 else 'interactive',
+                         tenant_id='teamA' if i % 2 else 'teamB'))
+    ref = _serve(off, jobs)
+    got = _serve(on, jobs)
+    for rid, r in got.items():
+        assert r.finish_reason == ref[rid].finish_reason
+        assert r.output_tokens == ref[rid].output_tokens, rid
+    st = on.stats()['qos']
+    assert st['enabled'] is True
+    assert st['scheduler']['policy'] == 'wfq'
+    tenants = st['tenants']
+    assert tenants['teamA']['admitted'] == 5
+    assert tenants['teamB']['admitted'] == 5
+    assert off.stats()['qos']['enabled'] is False
+
+
+def test_unknown_priority_is_client_error(tiny_config, shared_params):
+    eng = InferenceEngine(tiny_config, InferConfig(qos=True, **COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    res = _serve(eng, [_req('bad', priority='ultra')])['bad']
+    assert res.finish_reason == 'error'
+    assert res.error_class == 'client'
+    assert 'priority' in res.error
+
+
+def test_shed_never_misses_deadline(tiny_config, shared_params):
+    """Projection shedding: with an observed service rate that cannot
+    finish the request inside deadline_s, the engine rejects at
+    dequeue — typed shape, no prefill burned, counters tick."""
+    eng = InferenceEngine(tiny_config, InferConfig(qos=True, **COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    # Seed the estimator deterministically: 1 token/s means any
+    # request projects to many seconds of service.
+    eng._svc_estimator.observe(10, 10.0)
+    before = eng.fault_stats['deadline_evictions']
+    jobs = [_req('doomed', max_new=8, deadline_s=2.0),
+            _req('fine', max_new=8)]        # no deadline: never shed
+    got = _serve(eng, jobs)
+    doomed = got['doomed']
+    assert doomed.finish_reason == 'deadline'
+    assert doomed.output_tokens == []
+    assert doomed.error_class == 'shed'
+    assert 'projected' in doomed.error
+    assert got['fine'].finish_reason == 'length'
+    st = eng.stats()['qos']
+    assert st['sheds'] == 1
+    assert st['tenants'][iqos.DEFAULT_TENANT]['shed'] == 1
+    # Unified with the historical expired-in-queue eviction counter.
+    assert eng.fault_stats['deadline_evictions'] == before + 1
+
+
+def test_expired_at_dequeue_uses_same_typed_shape(tiny_config,
+                                                  shared_params):
+    """Bugfix satellite: expired-in-queue and projected-miss produce
+    ONE typed rejection shape (finish_reason='deadline' preserved,
+    error_class='shed' added) — on a FIFO engine too."""
+    eng = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    req = _req('late', max_new=8, deadline_s=1.0)
+    req.arrival_time = time.time() - 10
+    res = _serve(eng, [req])['late']
+    assert res.finish_reason == 'deadline'
+    assert res.output_tokens == []
+    assert res.error_class == 'shed'
+    assert 'expired in queue' in res.error
+    assert eng.stats()['qos']['sheds'] == 1
+
+
+def test_interactive_preempts_batch_at_chunk_boundary(tiny_config,
+                                                      shared_params):
+    """A part-prefilled batch prompt parks at its chunk boundary for
+    an interactive arrival, then resumes suffix-only off its own radix
+    blocks — BOTH streams byte-identical to an uncontended qos-off
+    run."""
+    from skypilot_tpu.infer.faults import FaultPlan, FaultSpec
+    # Largest bucket 16: the 60-token batch prompt MUST take the
+    # chunked path (prompts beyond the largest bucket chunk in
+    # prefill_chunk steps).
+    qos_cfg = dict(num_slots=1, max_cache_len=128,
+                   prefill_buckets=(8, 16), max_new_tokens=8,
+                   cache_dtype=jnp.float32, kv_block_size=8,
+                   prefill_chunk=8, auto_prefix_cache=True)
+    ref = InferenceEngine(tiny_config, InferConfig(**qos_cfg),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    eng = InferenceEngine(tiny_config, InferConfig(qos=True, **qos_cfg),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    batch = _req('batch', tokens=[(7 * j) % 97 + 1 for j in range(60)],
+                 max_new=8, priority='batch')
+    inter = _req('inter', tokens=[9, 4, 2, 8], max_new=8,
+                 priority='interactive')
+    # Uncontended reference (each request alone, qos off).
+    ref_out = {**_serve(ref, [copy.deepcopy(batch)]),
+               **_serve(ref, [copy.deepcopy(inter)])}
+    # Stall every loop pass so the 60-token prompt's chunk rounds
+    # stretch long enough to land the interactive arrival mid-prefill
+    # deterministically (the stall site only sleeps; streams are
+    # unaffected).
+    eng.arm_faults(FaultPlan(seed=0, specs=[
+        FaultSpec(site='stall', prob=1.0, stall_s=0.03)]))
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    t = threading.Thread(
+        target=eng.generate_stream,
+        args=(q, lambda r: results.__setitem__(r.request_id, r), stop),
+        daemon=True)
+    t.start()
+    try:
+        q.put(copy.deepcopy(batch))
+        deadline = time.time() + 60
+        while not eng._chunking and time.time() < deadline:
+            time.sleep(0.002)          # wait until batch is mid-chunk
+        assert eng._chunking, 'batch prompt never started chunking'
+        q.put(copy.deepcopy(inter))
+        while len(results) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        eng.disarm_faults()
+    assert len(results) == 2, results.keys()
+    assert eng.qos_stats['preemptions'] >= 1
+    for rid in ('batch', 'inter'):
+        assert results[rid].finish_reason == ref_out[rid].finish_reason
+        assert results[rid].output_tokens == ref_out[rid].output_tokens, rid
+
+
+# ------------------------------------------------- LB rate limiting
+
+
+def test_token_bucket_refill_and_retry_after():
+    t = [0.0]
+    b = sqos.TokenBucket(rate=2.0, burst=2.0, clock=lambda: t[0])
+    assert b.try_acquire() is None
+    assert b.try_acquire() is None
+    ra = b.try_acquire()
+    assert ra == pytest.approx(0.5)           # 1 token at 2/s
+    t[0] += 0.5
+    assert b.try_acquire() is None
+    with pytest.raises(ValueError):
+        sqos.TokenBucket(rate=0.0, burst=1.0, clock=lambda: 0.0)
+
+
+def test_tenant_rate_limiter_isolates_tenants():
+    t = [0.0]
+    lim = sqos.TenantRateLimiter(default_rate=1.0, default_burst=1.0,
+                                 tenant_rates={'vip': 0.0},
+                                 clock=lambda: t[0])
+    assert lim.check('a') is None
+    assert lim.check('a') is not None          # a is out of tokens
+    assert lim.check('b') is None              # b unaffected
+    assert lim.check(None) is None             # default-tenant bucket
+    for _ in range(50):
+        assert lim.check('vip') is None        # rate<=0 => unlimited
+    st = lim.stats()
+    assert st['tenants']['a'] == {'admitted': 1, 'rejected': 1}
+    assert st['tenants']['vip']['rejected'] == 0
+
+
+class _ReplicaStub(BaseHTTPRequestHandler):
+    """Minimal replica: answers any POST with a JSON 200."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get('Content-Length', 0) or 0)
+        self.rfile.read(n)
+        body = json.dumps({'output_tokens': [1], 'done': True}).encode()
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_lb_returns_429_with_retry_after_for_over_rate_tenant():
+    from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import (
+        RoundRobinPolicy)
+    replica = ThreadingHTTPServer(('127.0.0.1', 0), _ReplicaStub)
+    replica.daemon_threads = True
+    threading.Thread(target=replica.serve_forever, daemon=True).start()
+    try:
+        policy = RoundRobinPolicy()
+        policy.set_ready_replicas(
+            [f'http://127.0.0.1:{replica.server_port}'])
+        t = [100.0]
+        lb = SkyTpuLoadBalancer(None, 0, policy, clock=lambda: t[0])
+        lb.limiter = sqos.TenantRateLimiter(
+            default_rate=0.0,                  # others unlimited
+            tenant_rates={'teamB': 1.0}, default_burst=1.0,
+            clock=lambda: t[0])
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                lb.handle_request(self)
+
+        httpd = ThreadingHTTPServer(('127.0.0.1', 0), H)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+
+        def post(payload):
+            conn = HTTPConnection('127.0.0.1', httpd.server_port,
+                                  timeout=10)
+            conn.request('POST', '/generate',
+                         body=json.dumps(payload).encode())
+            resp = conn.getresponse()
+            out = (resp.status, dict(resp.getheaders()),
+                   json.loads(resp.read()))
+            conn.close()
+            return out
+
+        base = {'tokens': [1, 2], 'max_new_tokens': 2,
+                'tenant_id': 'teamB'}
+        status, _, _ = post(base)
+        assert status == 200
+        status, headers, body = post(base)     # bucket now empty
+        assert status == 429
+        assert int(headers['Retry-After']) >= 1
+        assert body['error_class'] == 'rate_limited'
+        assert body['retry_after_s'] > 0
+        # Other tenants keep flowing while teamB is limited.
+        status, _, _ = post({'tokens': [1], 'max_new_tokens': 1,
+                             'tenant_id': 'teamA'})
+        assert status == 200
+        t[0] += 1.1                            # refill teamB
+        status, _, _ = post(base)
+        assert status == 200
+        stats = lb.lb_stats()
+        assert stats['rate_limited'] == 1
+        assert stats['qos']['tenants']['teamB']['rejected'] == 1
+        assert stats['qos']['tenants']['teamA']['admitted'] == 1
+        # Buffered relays feed the per-replica latency window.
+        assert stats['replica_latency'] == {} or all(
+            row['count'] >= 1
+            for row in stats['replica_latency'].values())
+        httpd.shutdown()
+    finally:
+        replica.shutdown()
+
+
+# ------------------------------------------------- SLO autoscaler
+
+
+def _views(n):
+    return [autoscalers.ReplicaView(replica_id=i,
+                                    status=ReplicaStatus.READY,
+                                    version=1, is_spot=False)
+            for i in range(n)]
+
+
+def test_spec_slo_fields_validate_and_roundtrip():
+    s = SkyTpuServiceSpec(min_replicas=1, max_replicas=4,
+                          slo_ttft_ms=250.0, slo_tpot_ms=50.0,
+                          qos_policy='tenant_rate')
+    assert s.autoscaling_enabled
+    s2 = SkyTpuServiceSpec.from_yaml_config(s.to_yaml_config())
+    assert (s2.slo_ttft_ms, s2.slo_tpot_ms, s2.qos_policy) == \
+        (250.0, 50.0, 'tenant_rate')
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError):
+        SkyTpuServiceSpec(slo_ttft_ms=100.0)   # needs max_replicas
+    with pytest.raises(exceptions.InvalidTaskError):
+        SkyTpuServiceSpec(max_replicas=2, slo_ttft_ms=100.0,
+                          target_qps_per_replica=1.0)  # pick ONE signal
+    with pytest.raises(exceptions.InvalidTaskError):
+        SkyTpuServiceSpec(qos_policy='best_effort')
+
+
+def test_slo_autoscaler_target_tracks_ttft(monkeypatch):
+    spec = SkyTpuServiceSpec(min_replicas=1, max_replicas=4,
+                             slo_ttft_ms=200.0,
+                             upscale_delay_seconds=10.0,
+                             downscale_delay_seconds=20.0)
+    a = autoscalers.Autoscaler.make(spec)
+    assert isinstance(a, autoscalers.SloLatencyAutoscaler)
+    now = [1000.0]
+    monkeypatch.setattr(a, '_now', lambda: now[0])
+    # No latency signal yet: hold (never flap on missing data).
+    assert a.evaluate_scaling(_views(2)) == []
+    # Breach must PERSIST for upscale_delay before +1.
+    a.collect_latency_information(
+        {'u1': {'ttft_p95_ms': 150.0, 'count': 9},
+         'u2': {'ttft_p95_ms': 400.0, 'count': 9}})  # worst counts
+    assert a.evaluate_scaling(_views(2)) == []
+    now[0] += 5.0
+    assert a.evaluate_scaling(_views(2)) == []
+    now[0] += 6.0
+    d = a.evaluate_scaling(_views(2))
+    assert [x.operator for x in d] == [
+        autoscalers.DecisionOperator.SCALE_UP]
+    # Momentary recovery resets the pressure timer.
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 190.0}})
+    assert a.evaluate_scaling(_views(3)) == []
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 400.0}})
+    assert a.evaluate_scaling(_views(3)) == []     # timer restarted
+    # Downscale needs the comfort band (slo * factor), not just <slo.
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 190.0}})
+    now[0] += 25.0
+    assert a.evaluate_scaling(_views(3)) == []
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 40.0}})
+    assert a.evaluate_scaling(_views(3)) == []
+    now[0] += 21.0
+    d = a.evaluate_scaling(_views(3))
+    assert [x.operator for x in d] == [
+        autoscalers.DecisionOperator.SCALE_DOWN]
+    # Never above max_replicas, never below min_replicas.
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 4000.0}})
+    now[0] += 100.0
+    assert a.evaluate_scaling(_views(4)) == []
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 1.0}})
+    now[0] += 100.0
+    assert a.evaluate_scaling(_views(1)) == []
+    # Below the floor: replace immediately, no hysteresis.
+    d = a.evaluate_scaling([])
+    assert [x.operator for x in d] == [
+        autoscalers.DecisionOperator.SCALE_UP]
+
+
+def test_controller_ingests_qos_and_latency_sync():
+    """Satellite: the LB sync's tenant_qos/replica_latency land in
+    GET /controller/state and feed the SLO autoscaler (same path the
+    affinity counters took in the failover PR)."""
+    from skypilot_tpu.serve.controller import ServeController
+    spec = SkyTpuServiceSpec(min_replicas=1, max_replicas=4,
+                             slo_ttft_ms=200.0)
+    ctl = ServeController.__new__(ServeController)
+    ctl.service_name = 'svc-qos'
+    ctl.spec = spec
+    ctl.version = 1
+    ctl.autoscaler = autoscalers.Autoscaler.make(spec)
+    from skypilot_tpu.analysis import sanitizers
+    ctl._lb_lock = sanitizers.instrument_lock(
+        threading.Lock(), 'serve.controller._lb_lock.test')
+    ctl._lb_inflight, ctl._lb_draining = {}, set()
+    ctl._lb_affinity, ctl._lb_tenant_qos = {}, {}
+    ctl._lb_latency = {}
+    payload = {
+        'request_timestamps': [],
+        'tenant_qos': {'default_rate': 0.0,
+                       'tenants': {'teamB': {'admitted': 3,
+                                             'rejected': 2}}},
+        'replica_latency': {'http://r1:9': {'ttft_p95_ms': 333.0,
+                                            'ttft_p50_ms': 100.0,
+                                            'count': 7}},
+    }
+    import unittest.mock as mock
+    with mock.patch('skypilot_tpu.serve.serve_state.'
+                    'ready_replica_endpoints', return_value=[]):
+        ctl._handle('/controller/load_balancer_sync', payload)
+    assert ctl.autoscaler.fleet_ttft_p95_ms() == 333.0
+    with mock.patch('skypilot_tpu.serve.serve_state.get_replicas',
+                    return_value=[{'replica_id': 1, 'status': 'READY',
+                                   'version': 1, 'is_spot': 0,
+                                   'endpoint': 'http://r1:9'}]):
+        snap = ctl.state_snapshot()
+    assert snap['qos']['tenants']['teamB']['rejected'] == 2
+    assert snap['replicas'][0]['latency']['ttft_p95_ms'] == 333.0
