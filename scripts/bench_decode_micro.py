@@ -161,6 +161,7 @@ def _measure_tiny_sweep(args, fills, steps=4, reps=5):
     import jax
     import jax.numpy as jnp
 
+    from skypilot_tpu.analysis import sanitizers
     from skypilot_tpu.infer import InferConfig, InferenceEngine
     from skypilot_tpu.models.llama import LlamaConfig
 
@@ -224,6 +225,16 @@ def _measure_tiny_sweep(args, fills, steps=4, reps=5):
                      'paged_tpot_ms': round(pms / steps, 3)})
         print(f'measured fill={fill:4d}: dense {dms:7.2f} ms, paged '
               f'{pms:7.2f} ms ({nb} blocks gathered)', flush=True)
+    if sanitizers.compile_sanitizer_enabled():
+        # The sweep drives the real jit roots across the whole nb
+        # ladder: accumulated compiles must stay within the provable
+        # static bounds for these configs.
+        for eng in (dense, paged):
+            counts = sanitizers.check_compile_budget(eng)
+            touched = {k: v for k, v in counts.items() if v[0]}
+            print(f'compile budget ok: '
+                  f'{ {k: f"{m}/{bd}" for k, (m, bd) in touched.items()} }',
+                  flush=True)
     return {'batch': b, 'decode_steps': steps,
             'model': 'tiny 2-layer llama (float32)', 'rows': rows}
 

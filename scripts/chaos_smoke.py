@@ -142,6 +142,13 @@ def episode(eng: InferenceEngine, seed: int, n: int) -> list:
             sanitizers.check_block_conservation(eng)
         except sanitizers.BlockLeakError as e:
             bad.append(f'BLOCK LEAK: {e}')
+    if sanitizers.compile_sanitizer_enabled():
+        # Fault storms must not smuggle unbucketed shapes into the jit
+        # roots: measured compiles stay within the provable bound.
+        try:
+            sanitizers.check_compile_budget(eng)
+        except sanitizers.CompileBudgetError as e:
+            bad.append(f'COMPILE STORM: {e}')
         held = eng._num_blocks - 1 - len(eng._free_blocks)
         radix_held = eng._radix.blocks_held if eng._radix else 0
         prefix_held = sum(len(e.get('blocks', ()))

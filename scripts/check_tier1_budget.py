@@ -26,21 +26,27 @@ regression they are.  The checker reports:
   skycheck uses, so __pycache__ artifacts can't satisfy a typo), and a
   typo'd --require fails loudly instead of failing every run.
 - `--extra-seconds LABEL:SECONDS`: wall time spent by non-pytest tier-1
-  steps that share the CI window (e.g. the skycheck gate) — added to
-  the suite time before the budget verdict so the pytest budget shrinks
-  by exactly what the other steps consumed.
+  steps that share the CI window (e.g. a bench dryrun) — added to the
+  suite time before the budget verdict so the pytest budget shrinks by
+  exactly what the other steps consumed.
+- `--skycheck-json FILE`: the machine output of
+  `scripts/skycheck.py --json FILE` — every analysis pass is charged
+  individually (label `skycheck.<pass>`) instead of as one opaque
+  lump, so the pass that grew names itself in this report.
 
 Usage:
     python scripts/check_tier1_budget.py /tmp/_t1.log \
         [--budget 870] [--margin 0.10] [--top 15] \
         [--require tests/test_radix.py ...] \
-        [--extra-seconds skycheck:2.1]
+        [--skycheck-json /tmp/_skycheck.json] \
+        [--extra-seconds bench_dryrun:2.1]
 
 Exit codes: 0 within budget, 1 over budget (or the run itself timed
 out, which a missing summary line implies), 2 unreadable log or bad
 arguments.
 """
 import argparse
+import json
 import os
 import re
 import sys
@@ -92,8 +98,11 @@ def main(argv=None) -> int:
     ap.add_argument('--extra-seconds', action='append', default=[],
                     metavar='LABEL:SECONDS',
                     help='non-pytest wall time sharing the window '
-                         '(repeatable), e.g. skycheck:2.1; added to '
-                         'the suite time for the budget verdict')
+                         '(repeatable), e.g. bench_dryrun:2.1; added '
+                         'to the suite time for the budget verdict')
+    ap.add_argument('--skycheck-json', default=None, metavar='FILE',
+                    help='skycheck --json output: charge each analysis '
+                         'pass its own measured seconds')
     args = ap.parse_args(argv)
     extras = []
     for spec in args.extra_seconds:
@@ -103,6 +112,17 @@ def main(argv=None) -> int:
         except ValueError:
             print(f'check_tier1_budget: bad --extra-seconds {spec!r} '
                   '(want LABEL:SECONDS)')
+            return 2
+    if args.skycheck_json:
+        try:
+            with open(args.skycheck_json, encoding='utf-8') as f:
+                sky = json.load(f)
+            for name, info in sorted(sky.get('passes', {}).items()):
+                extras.append((f'skycheck.{name}',
+                               float(info['seconds'])))
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f'check_tier1_budget: bad --skycheck-json '
+                  f'{args.skycheck_json!r}: {e}')
             return 2
     on_disk = set(iter_py_files(_REPO, subdirs=['tests']))
     unknown = [req for req in args.require if req not in on_disk]

@@ -9,21 +9,28 @@
 set -o pipefail
 BUDGET="${1:-870}"
 LOG=/tmp/_t1.log
-rm -f "$LOG"
+SKYJSON=/tmp/_skycheck.json
+rm -f "$LOG" "$SKYJSON"
 rc=0
 # Static analysis gate first: new findings (vs skycheck_baseline.txt)
-# fail tier-1 before any pytest time is spent.  Its wall time is
-# charged to the shared window via --extra-seconds below.
-SKYCHECK_T0=$(date +%s.%N)
-timeout -k 5 30 python scripts/skycheck.py \
-    --baseline skycheck_baseline.txt || rc=1
-SKYCHECK_SECS=$(echo "$(date +%s.%N) $SKYCHECK_T0" | awk '{print $1-$2}')
+# fail tier-1 before any pytest time is spent.  --json records each
+# pass's own wall time; the budget guard charges them individually.
+timeout -k 5 60 python scripts/skycheck.py \
+    --baseline skycheck_baseline.txt --json "$SKYJSON" || rc=1
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly --durations=15 2>&1 | tee "$LOG"
 [ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+# Decode-bench dryrun under the compile sanitizer: drives the REAL
+# paged/dense jit roots across the nb ladder and asserts the measured
+# compile counts stay inside the provable static bounds.
+BENCH_T0=$(date +%s.%N)
+timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 \
+    python scripts/bench_decode_micro.py --paged --max-cache-len 256 \
+    --fill-sweep 40 200 --out /tmp/_bench_paged.json || rc=1
+BENCH_SECS=$(echo "$(date +%s.%N) $BENCH_T0" | awk '{print $1-$2}')
 # --require: every tier-1 test file must actually reach the window —
 # a file lost to a collection error or marker typo fails by name.
 python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
@@ -33,11 +40,14 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_skycheck.py \
     --require tests/test_lb_affinity.py \
     --require tests/test_qos.py \
-    --extra-seconds "skycheck:$SKYCHECK_SECS" || rc=1
+    --skycheck-json "$SKYJSON" \
+    --extra-seconds "bench_dryrun:$BENCH_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
 # accounting under randomized faults.  Outside the pytest window on
-# purpose — it must not eat durations budget from the suite.
-timeout -k 10 240 env JAX_PLATFORMS=cpu \
+# purpose — it must not eat durations budget from the suite.  The
+# compile sanitizer rides along: fault storms must not smuggle
+# unbucketed shapes into the jit roots.
+timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 \
     python scripts/chaos_smoke.py || rc=1
 # Replica-plane chaos sweep (fixed seeds): seeded mid-decode replica
 # kills behind the LB; every greedy request must complete
@@ -45,7 +55,7 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu \
 # finish its in-flight stream with zero 5xx at the LB.  Runs under
 # prefix_affinity routing: byte-identity + failover must hold under
 # the affinity policy too (least_load is covered by the pytest suite).
-timeout -k 10 300 env JAX_PLATFORMS=cpu \
+timeout -k 10 300 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 \
     python scripts/chaos_smoke.py --multi-replica 3 --seeds 0 1 \
     --requests 8 --policy prefix_affinity || rc=1
 exit "$rc"

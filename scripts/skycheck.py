@@ -1,8 +1,9 @@
 #!/usr/bin/env python3
 """skycheck: the repo's static-analysis suite (see skypilot_tpu/analysis).
 
-Runs the lock-discipline, jit-boundary, layering and determinism passes
-over the tree and compares findings against a checked-in baseline:
+Runs the lock-discipline, jit-boundary, layering, determinism,
+wire-contract, block-lifecycle and compile-budget passes over the tree
+and compares findings against a checked-in baseline:
 
     python scripts/skycheck.py --baseline skycheck_baseline.txt
 
@@ -13,11 +14,19 @@ fixing findings:
 
     python scripts/skycheck.py --write-baseline skycheck_baseline.txt
 
-``--passes lock,jit,layer,det`` restricts which passes run; ``--all``
-prints baselined findings too.  Runs in well under the 30s tier-1
-budget line it is charged under (see run_tier1.sh).
+The baseline is a RATCHET: rewriting it with MORE pinned findings than
+it already holds is refused (exit 3) unless ``--allow-grow`` is given —
+shrinking is always fine, growth is a decision someone must own.
+
+``--passes lock,jit,...`` restricts which passes run; ``--all`` prints
+baselined findings too.  ``--json FILE`` (or ``--json -`` for stdout)
+emits machine-readable results including PER-PASS wall time, which
+run_tier1.sh feeds to check_tier1_budget.py so each pass is charged
+for its own seconds.  Runs in well under the tier-1 budget lines it is
+charged under.
 """
 import argparse
+import json
 import os
 import sys
 import time
@@ -26,28 +35,47 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+from skypilot_tpu.analysis import block_lifecycle  # noqa: E402
+from skypilot_tpu.analysis import compile_budget  # noqa: E402
 from skypilot_tpu.analysis import determinism  # noqa: E402
 from skypilot_tpu.analysis import jit_boundary  # noqa: E402
 from skypilot_tpu.analysis import layering  # noqa: E402
 from skypilot_tpu.analysis import lock_discipline  # noqa: E402
+from skypilot_tpu.analysis import wire_contract  # noqa: E402
 from skypilot_tpu.analysis.findings import load_baseline  # noqa: E402
 from skypilot_tpu.analysis.findings import new_findings  # noqa: E402
 from skypilot_tpu.analysis.walker import iter_py_files  # noqa: E402
 
+# Per-file passes: check_file(rel_path, text) -> [Finding].
 PASSES = {
     'lock': lock_discipline.check_file,
     'jit': jit_boundary.check_file,
     'layer': layering.check_file,
     'det': determinism.check_file,
+    'block': block_lifecycle.check_file,
+    'compile': compile_budget.check_file,
 }
+
+# Whole-tree passes: check_tree({rel_path: text}) -> [Finding].  They
+# see every file at once (the wire contract spans planes).
+TREE_PASSES = {
+    'wire': wire_contract.check_tree,
+}
+
+ALL_PASSES = tuple(PASSES) + tuple(TREE_PASSES)
 
 # Where hand-written, annotation-bearing sources live.
 DEFAULT_SUBDIRS = ('skypilot_tpu', 'scripts', 'tests')
 
 
 def run(root, subdirs, pass_names):
+    """-> (findings, files_checked, {pass: seconds})."""
     findings = []
     checked = 0
+    timings = {name: 0.0 for name in pass_names}
+    file_passes = [n for n in pass_names if n in PASSES]
+    tree_passes = [n for n in pass_names if n in TREE_PASSES]
+    files = {}
     for rel in iter_py_files(root, subdirs=subdirs):
         abs_path = os.path.join(root, rel.replace('/', os.sep))
         try:
@@ -57,9 +85,48 @@ def run(root, subdirs, pass_names):
             print(f'skycheck: cannot read {rel}: {e}', file=sys.stderr)
             continue
         checked += 1
-        for name in pass_names:
+        if tree_passes:
+            files[rel] = text
+        for name in file_passes:
+            t0 = time.monotonic()
             findings.extend(PASSES[name](rel, text))
-    return findings, checked
+            timings[name] += time.monotonic() - t0
+    for name in tree_passes:
+        t0 = time.monotonic()
+        findings.extend(TREE_PASSES[name](files))
+        timings[name] += time.monotonic() - t0
+    return findings, checked, timings
+
+
+def _write_baseline(path, findings, allow_grow):
+    """Ratcheted rewrite: refuse growth unless explicitly allowed."""
+    if os.path.exists(path) and not allow_grow:
+        try:
+            old = load_baseline(path)
+        except ValueError as e:
+            print(f'skycheck: existing baseline unreadable: {e}',
+                  file=sys.stderr)
+            return 2
+        grown, _ = new_findings(findings, old)
+        if grown:
+            print(f'skycheck: refusing to GROW the baseline by '
+                  f'{len(grown)} finding(s) (ratchet); fix them or '
+                  're-run with --allow-grow to accept deliberately:',
+                  file=sys.stderr)
+            for fd in grown[:20]:
+                print(f'  {fd.render()}', file=sys.stderr)
+            if len(grown) > 20:
+                print(f'  ... and {len(grown) - 20} more',
+                      file=sys.stderr)
+            return 3
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write('# skycheck pinned findings -- regenerate with:\n'
+                '#   python scripts/skycheck.py --write-baseline '
+                f'{os.path.basename(path)}\n')
+        for fd in findings:
+            f.write(fd.render() + '\n')
+    print(f'skycheck: wrote {len(findings)} finding(s) to {path}')
+    return 0
 
 
 def main(argv=None):
@@ -69,33 +136,35 @@ def main(argv=None):
     ap.add_argument('--baseline', default=None,
                     help='pinned-findings file; new findings fail')
     ap.add_argument('--write-baseline', default=None, metavar='FILE',
-                    help='write current findings as the new baseline')
-    ap.add_argument('--passes', default=','.join(PASSES),
-                    help=f'comma list of passes ({",".join(PASSES)})')
+                    help='write current findings as the new baseline '
+                         '(refuses growth without --allow-grow)')
+    ap.add_argument('--allow-grow', action='store_true',
+                    help='let --write-baseline pin MORE findings than '
+                         'the existing file (deliberate ratchet bump)')
+    ap.add_argument('--passes', default=','.join(ALL_PASSES),
+                    help=f'comma list of passes ({",".join(ALL_PASSES)})')
     ap.add_argument('--all', action='store_true',
                     help='print baselined findings too, not just new')
+    ap.add_argument('--json', default=None, metavar='FILE',
+                    help='write machine-readable results (per-pass '
+                         "seconds, counts, new findings); '-' = stdout")
     args = ap.parse_args(argv)
 
     pass_names = [p.strip() for p in args.passes.split(',') if p.strip()]
-    unknown = [p for p in pass_names if p not in PASSES]
+    unknown = [p for p in pass_names if p not in PASSES
+               and p not in TREE_PASSES]
     if unknown:
         ap.error(f'unknown pass(es): {", ".join(unknown)}')
 
     t0 = time.monotonic()
-    findings, checked = run(args.root, DEFAULT_SUBDIRS, pass_names)
+    findings, checked, timings = run(args.root, DEFAULT_SUBDIRS,
+                                     pass_names)
     findings.sort()
     elapsed = time.monotonic() - t0
 
     if args.write_baseline:
-        with open(args.write_baseline, 'w', encoding='utf-8') as f:
-            f.write('# skycheck pinned findings -- regenerate with:\n'
-                    '#   python scripts/skycheck.py --write-baseline '
-                    f'{os.path.basename(args.write_baseline)}\n')
-            for fd in findings:
-                f.write(fd.render() + '\n')
-        print(f'skycheck: wrote {len(findings)} finding(s) to '
-              f'{args.write_baseline}')
-        return 0
+        return _write_baseline(args.write_baseline, findings,
+                               args.allow_grow)
 
     baseline = {}
     if args.baseline:
@@ -106,21 +175,47 @@ def main(argv=None):
             return 2
     new, fixed = new_findings(findings, baseline)
 
-    if args.all:
-        for fd in findings:
-            marker = 'NEW ' if fd in new else ''
-            print(f'{marker}{fd.render()}')
-    else:
-        for fd in new:
-            print(fd.render())
+    per_pass_findings = {name: 0 for name in pass_names}
+    prefix_of = {name: name.upper() for name in pass_names}
+    for fd in findings:
+        for name in pass_names:
+            if fd.pass_id.startswith(prefix_of[name]):
+                per_pass_findings[name] += 1
+                break
 
-    pinned = len(findings) - len(new)
-    print(f'skycheck: {checked} files, {len(findings)} finding(s) '
-          f'({pinned} baselined, {len(new)} new, {fixed} fixed) '
-          f'in {elapsed:.2f}s [{",".join(pass_names)}]')
-    if fixed:
-        print('skycheck: baseline has stale entries - shrink it with '
-              '--write-baseline')
+    payload = {
+        'files_checked': checked,
+        'elapsed_seconds': round(elapsed, 3),
+        'passes': {name: {'seconds': round(timings[name], 3),
+                          'findings': per_pass_findings[name]}
+                   for name in pass_names},
+        'total_findings': len(findings),
+        'baselined': len(findings) - len(new),
+        'new': [fd.render() for fd in new],
+        'fixed': fixed,
+    }
+    if args.json == '-':
+        print(json.dumps(payload, indent=2))
+    elif args.json:
+        with open(args.json, 'w', encoding='utf-8') as f:
+            json.dump(payload, f, indent=2)
+            f.write('\n')
+
+    if args.json != '-':
+        if args.all:
+            for fd in findings:
+                marker = 'NEW ' if fd in new else ''
+                print(f'{marker}{fd.render()}')
+        else:
+            for fd in new:
+                print(fd.render())
+        pinned = len(findings) - len(new)
+        print(f'skycheck: {checked} files, {len(findings)} finding(s) '
+              f'({pinned} baselined, {len(new)} new, {fixed} fixed) '
+              f'in {elapsed:.2f}s [{",".join(pass_names)}]')
+        if fixed:
+            print('skycheck: baseline has stale entries - shrink it '
+                  'with --write-baseline')
     return 1 if new else 0
 
 

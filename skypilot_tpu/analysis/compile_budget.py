@@ -1,0 +1,434 @@
+"""COMPILE pass: provable worst-case XLA compile counts per jit root.
+
+Every jitted dispatch root in the engine keys its compile cache on the
+SHAPES of its array arguments and the VALUES of its static arguments.
+The whole recompilation-storm discipline (TPU-pod playbook: tracing is
+a first-order cost) rests on one convention: every dynamic dimension
+reaching a root must come out of a finite bucketing helper —
+
+- ``_bucket`` / ``_suffix_bucket``: the prefill ladder
+  (``cfg.prefill_buckets``),
+- ``_nb_bucket``: the pow2 table-width ladder (capped at
+  ``_max_blocks``),
+- ``_select_window``: the adaptive decode window (two variants).
+
+This pass makes the convention checkable.  It discovers the roots from
+the ``self._NAME = jax.jit(...)`` builds, walks every ``self._NAME(...)``
+call site, and resolves each argument's shape dims (value, for static
+argnums) back to bucket symbols through locals, parameters and caller
+argument expressions.  A dimension that bottoms out anywhere else is
+**COMPILE001**: an unbounded shape dimension — one compile per distinct
+runtime value, the storm the ladder exists to prevent.
+
+For dims the dataflow cannot see through (loop targets over group
+dicts), an inline annotation asserts the symbol::
+
+    for (bucket, aid), group in groups.items():  # compile-shape: bucket=prefill_buckets
+
+The static worst case per root is the sum over call sites of the
+product of each site's symbol cardinalities (sites are summed, not
+deduped — an upper bound stays an upper bound).  ``root_bounds``
+evaluates it for an explicit ``model``; ``runtime_model`` derives the
+model from a live engine's config, which is what the env-gated runtime
+sanitizer (``SKYTPU_COMPILE_SANITIZER`` in ``analysis.sanitizers``)
+asserts measured compile counts against at quiesce.
+"""
+import ast
+import math
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import dataflow
+from skypilot_tpu.analysis.findings import Finding
+
+PASS_UNBOUNDED = 'COMPILE001'
+
+ENGINE_FILE = 'skypilot_tpu/infer/engine.py'
+
+# Bucketing helpers -> the symbol naming their output lattice.
+SYMBOL_FUNCS = {
+    '_bucket': 'prefill_buckets',
+    '_nb_bucket': 'nb_buckets',
+    '_suffix_bucket': 'suffix_buckets',
+    '_select_window': 'decode_windows',
+}
+
+# A boolean static argument computed at the call site (want_plp =
+# any(...)): both variants compile.
+BOOL_SYMBOL = 'static_bool'
+
+# The inline pow2-floor ladder over registered-prefix lengths
+# (_start_prefixed_group's b_ loop): only assertable by annotation.
+PREFIX_SYMBOL = 'prefix_pow2'
+
+SYMBOLS = tuple(sorted(set(SYMBOL_FUNCS.values()))) + (
+    BOOL_SYMBOL, PREFIX_SYMBOL)
+
+_ANNOT_RE = re.compile(
+    r'#\s*compile-shape:\s*(\w+)\s*=\s*(\w+)')
+
+# Array constructors whose first argument is the shape.
+_SHAPE_CTORS = frozenset({
+    'np.zeros', 'np.ones', 'np.full', 'np.empty',
+    'jnp.zeros', 'jnp.ones', 'jnp.full', 'jnp.empty',
+})
+# Calls that pass their first argument's array shape through.
+_PASSTHROUGH = frozenset({
+    'np.asarray', 'jnp.asarray', 'np.ascontiguousarray',
+    'jax.device_put',
+})
+# Fixed-shape producers (PRNG keys).
+_FIXED_CALLS = frozenset({
+    'jax.random.PRNGKey', 'jax.random.split', 'jax.random.fold_in',
+})
+
+
+class RootSpec:
+    def __init__(self, name: str, line: int,
+                 static_argnums: Tuple[int, ...]) -> None:
+        self.name = name
+        self.line = line
+        self.static_argnums = static_argnums
+
+
+def discover_roots(text: str) -> List[RootSpec]:
+    """``self._NAME = jax.jit(fn, ...)`` assignments, with their
+    static_argnums."""
+    tree = ast.parse(text)
+    roots: List[RootSpec] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and
+                len(node.targets) == 1 and
+                isinstance(node.targets[0], ast.Attribute) and
+                isinstance(node.targets[0].value, ast.Name) and
+                node.targets[0].value.id == 'self' and
+                isinstance(node.value, ast.Call) and
+                dataflow.dotted_name(node.value.func) == 'jax.jit'):
+            continue
+        static: Tuple[int, ...] = ()
+        for kw in node.value.keywords:
+            if kw.arg == 'static_argnums' and \
+                    isinstance(kw.value, ast.Tuple):
+                static = tuple(
+                    e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant) and
+                    isinstance(e.value, int))
+        roots.append(RootSpec(node.targets[0].attr, node.lineno,
+                              static))
+    return roots
+
+
+def _annotations(index: dataflow.ModuleIndex,
+                 fn: dataflow.FunctionInfo) -> Dict[str, str]:
+    """``# compile-shape: NAME=SYMBOL`` lines inside the function."""
+    start = fn.node.lineno
+    end = getattr(fn.node, 'end_lineno', start)
+    out: Dict[str, str] = {}
+    for ln in range(start, min(end, len(index.lines)) + 1):
+        m = _ANNOT_RE.search(index.lines[ln - 1])
+        if m:
+            out[m.group(1)] = m.group(2)
+    return out
+
+
+class _Resolver:
+    """Symbol resolution for one call site's arguments: dims and
+    static values back to bucket symbols, interprocedurally."""
+
+    def __init__(self, index: dataflow.ModuleIndex) -> None:
+        self.index = index
+        self.symbols: Set[str] = set()
+        self.unresolved: List[Tuple[int, str]] = []
+
+    # -- dim/value position ----------------------------------------
+
+    def dim(self, fn: dataflow.FunctionInfo, expr: ast.expr,
+            depth: int = 5,
+            seen: Optional[Set[Tuple[str, str]]] = None) -> None:
+        seen = seen if seen is not None else set()
+        if isinstance(expr, dataflow._Opaque):
+            return self._miss(0, 'tuple-unpacked value')
+        if isinstance(expr, ast.Constant):
+            return
+        if isinstance(expr, ast.Attribute):
+            return          # self.cfg.* / self._max_blocks: fixed
+        if isinstance(expr, (ast.UnaryOp,)):
+            return self.dim(fn, expr.operand, depth, seen)
+        if isinstance(expr, ast.BinOp):
+            self.dim(fn, expr.left, depth, seen)
+            self.dim(fn, expr.right, depth, seen)
+            return
+        if isinstance(expr, ast.IfExp):
+            self.dim(fn, expr.body, depth, seen)
+            self.dim(fn, expr.orelse, depth, seen)
+            return
+        if isinstance(expr, ast.Compare):
+            self.symbols.add(BOOL_SYMBOL)
+            return
+        if isinstance(expr, ast.Call):
+            name = dataflow.dotted_name(expr.func)
+            if name is not None and name.startswith('self.'):
+                attr = name[5:]
+                if attr in SYMBOL_FUNCS:
+                    self.symbols.add(SYMBOL_FUNCS[attr])
+                    return
+            if name in ('min', 'max', 'int', 'abs', 'round'):
+                for a in expr.args:
+                    self.dim(fn, a, depth, seen)
+                return
+            if name in ('any', 'all', 'bool'):
+                self.symbols.add(BOOL_SYMBOL)
+                return
+            return self._miss(expr.lineno,
+                              f'call {name or "<expr>"}(...)')
+        if isinstance(expr, ast.Name):
+            return self._via_name(fn, expr, depth, seen, self.dim)
+        self._miss(getattr(expr, 'lineno', 0),
+                   f'{type(expr).__name__} expression')
+
+    # -- array position --------------------------------------------
+
+    def array(self, fn: dataflow.FunctionInfo, expr: ast.expr,
+              depth: int = 5,
+              seen: Optional[Set[Tuple[str, str]]] = None) -> None:
+        seen = seen if seen is not None else set()
+        if isinstance(expr, dataflow._Opaque):
+            return self._miss(0, 'tuple-unpacked array')
+        if isinstance(expr, (ast.Constant, ast.Attribute)):
+            return          # self.cache / self.params: fixed shapes
+        if isinstance(expr, ast.BinOp):
+            self.array(fn, expr.left, depth, seen)
+            self.array(fn, expr.right, depth, seen)
+            return
+        if isinstance(expr, ast.Subscript):
+            return self.array(fn, expr.value, depth, seen)
+        if isinstance(expr, ast.Call):
+            name = dataflow.dotted_name(expr.func)
+            if name in _SHAPE_CTORS and expr.args:
+                shape = expr.args[0]
+                elts = shape.elts if isinstance(
+                    shape, (ast.Tuple, ast.List)) else [shape]
+                for e in elts:
+                    self.dim(fn, e, depth, seen)
+                return
+            if name in _PASSTHROUGH and expr.args:
+                return self.array(fn, expr.args[0], depth, seen)
+            if name in _FIXED_CALLS:
+                return
+            if name == 'range' and expr.args:
+                for a in expr.args:
+                    self.dim(fn, a, depth, seen)
+                return
+            if name is not None and name.startswith('self.'):
+                attr = name[5:]
+                if attr == '_lane_tables' and len(expr.args) == 2:
+                    self.array(fn, expr.args[0], depth, seen)
+                    self.dim(fn, expr.args[1], depth, seen)
+                    return
+                helper = self.index.find(attr)
+                if helper is not None and depth > 0:
+                    # A shape-producing helper (e.g. _decode_tables):
+                    # the returned array's dims are whatever the
+                    # helper's own return expressions resolve to.
+                    key = (helper.qualname, '<return>')
+                    if key in seen:
+                        return
+                    seen.add(key)
+                    for node in dataflow._walk_no_nested(helper.node):
+                        if isinstance(node, ast.Return) and \
+                                node.value is not None:
+                            self.array(helper, node.value,
+                                       depth - 1, seen)
+                    return
+            if isinstance(expr.func, ast.Attribute) and \
+                    expr.func.attr in ('astype', 'copy', 'reshape'):
+                return self.array(fn, expr.func.value, depth, seen)
+            if name in ('int', 'float') and expr.args:
+                return      # python scalar: shape-() weak-typed arg
+            if name == 'init_cache':
+                # (model_config, width, bucket, dtype): dims are the
+                # two middle arguments.
+                for a in expr.args[1:3]:
+                    self.dim(fn, a, depth, seen)
+                return
+            return self._miss(expr.lineno,
+                              f'call {name or "<expr>"}(...)')
+        if isinstance(expr, ast.Name):
+            if self._prng_unpack(fn, expr.id):
+                return
+            return self._via_name(fn, expr, depth, seen, self.array)
+        self._miss(getattr(expr, 'lineno', 0),
+                   f'{type(expr).__name__} expression')
+
+    # -- shared name resolution ------------------------------------
+
+    def _via_name(self, fn, expr, depth, seen, recurse) -> None:
+        annot = _annotations(self.index, fn).get(expr.id)
+        if annot is not None:
+            if annot in SYMBOLS:
+                self.symbols.add(annot)
+            elif annot != 'const':
+                self._miss(expr.lineno,
+                           f'unknown compile-shape symbol {annot!r}')
+            return
+        key = (fn.qualname, expr.id)
+        if key in seen or depth <= 0:
+            return
+        seen.add(key)
+        defs = dataflow._defs_cache(self.index, fn).get(expr.id)
+        if defs:
+            for d in defs:
+                recurse(fn, d, depth - 1, seen)
+            return
+        params = fn.params
+        if expr.id in params:
+            sites = self.index.call_sites.get(
+                fn.qualname.rsplit('.', 1)[-1], [])
+            resolved = False
+            for caller, call in sites:
+                arg = dataflow._arg_for_param(fn, call, expr.id)
+                if arg is not None:
+                    recurse(caller, arg, depth - 1, seen)
+                    resolved = True
+            if resolved:
+                return
+            default = fn.defaults.get(expr.id)
+            if default is not None:
+                return recurse(fn, default, depth - 1, seen)
+        self._miss(expr.lineno, f"name '{expr.id}'")
+
+    def _prng_unpack(self, fn: dataflow.FunctionInfo,
+                     name: str) -> bool:
+        """``self._rng, key = jax.random.split(...)``: fixed-shape PRNG
+        keys bound by tuple unpack (which local_defs marks opaque)."""
+        for node in dataflow._walk_no_nested(fn.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    dataflow.dotted_name(node.value.func) in \
+                    _FIXED_CALLS:
+                for tgt in node.targets:
+                    for e in getattr(tgt, 'elts', [tgt]):
+                        if isinstance(e, ast.Name) and e.id == name:
+                            return True
+        return False
+
+    def _miss(self, line: int, what: str) -> None:
+        self.unresolved.append((line, what))
+
+
+def root_profiles(text: str, path: str = ENGINE_FILE
+                  ) -> Tuple[Dict[str, List[Tuple[str, ...]]],
+                             List[Finding]]:
+    """Per root: one sorted symbol tuple per call site, plus COMPILE001
+    findings for every dimension that resolved to nothing bounded."""
+    index = dataflow.ModuleIndex(path, text)
+    roots = discover_roots(text)
+    profiles: Dict[str, List[Tuple[str, ...]]] = {}
+    findings: List[Finding] = []
+    emitted: Set[Tuple[int, str]] = set()
+    for root in roots:
+        sites = index.call_sites.get(root.name, [])
+        profiles[root.name] = []
+        for caller, call in sites:
+            res = _Resolver(index)
+            for i, arg in enumerate(call.args):
+                if i in root.static_argnums:
+                    res.dim(caller, arg)
+                else:
+                    res.array(caller, arg)
+            profiles[root.name].append(tuple(sorted(res.symbols)))
+            for line, what in res.unresolved:
+                key = (line or call.lineno, what)
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                findings.append(Finding(
+                    path, line or call.lineno, PASS_UNBOUNDED,
+                    f'{caller.qualname} -> {root.name}: shape/static '
+                    f'dimension from {what} is not provably bucketed '
+                    '(one XLA compile per distinct runtime value); '
+                    'route it through a bucketing helper or assert it '
+                    'with a # compile-shape: annotation'))
+    findings.sort(key=lambda f: (f.line, f.message))
+    return profiles, findings
+
+
+def root_bounds(text: str, model: Dict[str, int],
+                path: str = ENGINE_FILE) -> Dict[str, int]:
+    """Provable worst-case compile count per root under ``model``
+    (symbol -> cardinality): sum over call sites of the product of the
+    site's symbol cardinalities."""
+    profiles, _ = root_profiles(text, path)
+    out: Dict[str, int] = {}
+    for name, sites in profiles.items():
+        total = 0
+        for syms in sites:
+            site = 1
+            for s in syms:
+                site *= model.get(s, 1)
+            total += site
+        out[name] = total
+    return out
+
+
+def nb_ladder_size(max_blocks: int) -> int:
+    """Cardinality of ``_nb_bucket``'s output lattice: pow2 values
+    1, 2, 4, ... capped at max_blocks (the cap itself included when it
+    is not a power of two)."""
+    if max_blocks <= 1:
+        return 1
+    n = math.floor(math.log2(max_blocks - 1)) + 1 \
+        if max_blocks > 1 else 0
+    pow2s = n + 1                      # 1, 2, ..., 2**n
+    if 2 ** n >= max_blocks and 2 ** (n - 1) < max_blocks and \
+            2 ** n != max_blocks:
+        # The while-loop cap replaces the overshooting pow2 with
+        # max_blocks itself — same count, different value.
+        return pow2s
+    return pow2s
+
+
+def runtime_model(engine) -> Dict[str, int]:
+    """The symbol cardinalities of a LIVE engine's config — what the
+    runtime compile sanitizer asserts measured counts against."""
+    cfg = engine.cfg
+    buckets = len(tuple(cfg.prefill_buckets))
+    max_blocks = int(getattr(engine, '_max_blocks', 1) or 1)
+    max_len = int(getattr(cfg, 'max_cache_len', 2048) or 2048)
+    return {
+        'prefill_buckets': buckets,
+        'suffix_buckets': buckets,
+        'nb_buckets': nb_ladder_size(max_blocks),
+        'decode_windows': 2 if getattr(cfg, 'adaptive_decode_window',
+                                       False) else 1,
+        BOOL_SYMBOL: 2,
+        # pow2-floor of a registered-prefix length < max_cache_len.
+        PREFIX_SYMBOL: max(1, math.floor(math.log2(max_len)) + 1),
+    }
+
+
+def check_engine_budget(engine) -> Dict[str, Tuple[int, int]]:
+    """measured-vs-bound per jit root of a live engine; the runtime
+    sanitizer raises when measured exceeds the provable bound."""
+    import inspect
+    mod = inspect.getmodule(type(engine))
+    text = inspect.getsource(mod)
+    bounds = root_bounds(text, runtime_model(engine))
+    out: Dict[str, Tuple[int, int]] = {}
+    for name, bound in bounds.items():
+        fn = getattr(engine, name, None)
+        size = getattr(fn, '_cache_size', None)
+        if fn is None or size is None:
+            continue
+        out[name] = (int(size()), bound)
+    return out
+
+
+def check_file(path: str, text: str) -> List[Finding]:
+    if path != ENGINE_FILE:
+        return []
+    try:
+        _, findings = root_profiles(text, path)
+    except SyntaxError:
+        return []
+    return findings

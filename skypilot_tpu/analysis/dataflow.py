@@ -1,0 +1,625 @@
+"""Shared dataflow scaffolding for the skycheck contract passes.
+
+PR 6's passes were syntactic (one AST node at a time); the wire-schema,
+block-lifecycle and compile-budget passes all need to answer the same
+deeper question: *where does this value come from?*  This module is the
+shared answer — a small, deliberately conservative def-use layer over
+``ast`` with three capabilities:
+
+- **ModuleIndex**: one parse of a file, functions indexed by dotted
+  qualname (``Class.method``, ``outer.inner``) plus a call-site index
+  so ``self.helper(...)`` argument expressions can be found from the
+  callee side (the interprocedural step).
+- **resolve_sources**: reduce an expression to the set of *source
+  atoms* feeding it — constants, ``a.b.c`` attribute chains, calls (by
+  dotted callee name), or function parameters.  Parameters resolve one
+  level through the caller's argument expression (depth-bounded, cycle
+  guarded); anything the walk cannot classify becomes an ``unknown``
+  atom carrying the reason, so passes degrade to findings instead of
+  silent blind spots.
+- **KeyModel** (`dict_key_model`): the dict-key lattice of a JSON
+  payload — which string keys a function's returned / emitted /
+  assigned dict carries, whether each key is produced on *every* path
+  or only some branch, and a best-effort value type per key (for the
+  WIRE003 type-conflict check).
+
+Everything here is pure ``ast`` — no imports of the analyzed modules,
+so the passes run in milliseconds and never pay a jax import.
+"""
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    'Source', 'FunctionInfo', 'ModuleIndex', 'KeyModel',
+    'dotted_name', 'local_defs', 'resolve_sources', 'dict_key_model',
+    'infer_value_type', 'read_keys',
+]
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Source:
+    """One atom feeding an expression.
+
+    kind: 'const' | 'attr' | 'call' | 'param' | 'unknown'
+    detail: const repr / dotted chain / callee name / param name /
+    reason the walk gave up.
+    """
+    kind: str
+    detail: str
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``self.cfg.kv_block_size`` -> that string; None when the chain
+    bottoms out in anything but a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    params: List[str]
+    defaults: Dict[str, ast.expr]
+
+
+class ModuleIndex:
+    """One file, parsed once: functions by qualname + call sites by
+    simple callee name (``self.f(...)`` and bare ``f(...)`` both index
+    under ``f``)."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.tree = ast.parse(text)
+        self.lines = text.splitlines()
+        self.functions: Dict[str, FunctionInfo] = {}
+        # simple callee name -> [(caller FunctionInfo, Call node)]
+        self.call_sites: Dict[str, List[Tuple[FunctionInfo,
+                                              ast.Call]]] = {}
+        self._index(self.tree.body, prefix='')
+        for info in self.functions.values():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._callee_simple_name(node.func)
+                if name is not None:
+                    self.call_sites.setdefault(name, []).append(
+                        (info, node))
+
+    @staticmethod
+    def _callee_simple_name(func: ast.AST) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == 'self':
+            return func.attr
+        return None
+
+    def _index(self, body: Sequence[ast.stmt], prefix: str) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f'{prefix}{node.name}'
+                args = node.args
+                params = ([a.arg for a in args.posonlyargs] +
+                          [a.arg for a in args.args] +
+                          [a.arg for a in args.kwonlyargs])
+                defaults: Dict[str, ast.expr] = {}
+                pos = ([a.arg for a in args.posonlyargs] +
+                       [a.arg for a in args.args])
+                for name, dflt in zip(pos[len(pos) - len(args.defaults):],
+                                      args.defaults):
+                    defaults[name] = dflt
+                for a, dflt in zip(args.kwonlyargs, args.kw_defaults):
+                    if dflt is not None:
+                        defaults[a.arg] = dflt
+                self.functions[qual] = FunctionInfo(qual, node, params,
+                                                    defaults)
+                self._index(node.body, prefix=f'{qual}.')
+            elif isinstance(node, ast.ClassDef):
+                self._index(node.body, prefix=f'{prefix}{node.name}.')
+            elif isinstance(node, (ast.If, ast.Try, ast.With, ast.For,
+                                   ast.While)):
+                self._index(_suites(node), prefix=prefix)
+
+    def find(self, name: str) -> Optional[FunctionInfo]:
+        """Exact qualname, else unique ``...name`` suffix match."""
+        if name in self.functions:
+            return self.functions[name]
+        hits = [f for q, f in self.functions.items()
+                if q.endswith('.' + name)]
+        return hits[0] if len(hits) == 1 else None
+
+
+def _suites(node: ast.stmt) -> List[ast.stmt]:
+    out: List[ast.stmt] = []
+    for field in ('body', 'orelse', 'finalbody'):
+        out.extend(getattr(node, field, ()) or ())
+    for handler in getattr(node, 'handlers', ()) or ():
+        out.extend(handler.body)
+    return out
+
+
+def local_defs(fn_node: ast.AST) -> Dict[str, List[ast.expr]]:
+    """name -> every expression assigned to it inside the function
+    (nested defs excluded).  Tuple/list unpack targets map each name to
+    the whole RHS wrapped as an unknown marker unless it is the
+    single-element ``[x] = rhs`` form, which maps to the RHS call."""
+    defs: Dict[str, List[ast.expr]] = {}
+
+    def add(name: str, expr: ast.expr) -> None:
+        defs.setdefault(name, []).append(expr)
+
+    for node in _walk_no_nested(fn_node):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                _bind_target(tgt, node.value, add)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            _bind_target(node.target, node.value, add)
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name):
+            add(node.target.id, node)        # opaque: x op= ...
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            _bind_target(node.target, node, add)   # loop var: opaque
+        elif isinstance(node, ast.withitem) and \
+                node.optional_vars is not None:
+            _bind_target(node.optional_vars, node.context_expr, add)
+        elif isinstance(node, ast.NamedExpr) and \
+                isinstance(node.target, ast.Name):
+            add(node.target.id, node.value)
+    return defs
+
+
+def _bind_target(tgt, value, add) -> None:
+    if isinstance(tgt, ast.Name):
+        add(tgt.id, value)
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        if len(tgt.elts) == 1 and isinstance(tgt.elts[0], ast.Name):
+            add(tgt.elts[0].id, value)       # [x] = call()
+        else:
+            for el in tgt.elts:
+                if isinstance(el, ast.Name):
+                    add(el.id, _OPAQUE)
+
+
+class _Opaque(ast.expr):
+    """Sentinel def expression for bindings the walk cannot model."""
+
+
+_OPAQUE = _Opaque()
+
+# Nodes whose operands simply pass through source resolution.
+_TRANSPARENT_UNARY = (ast.UnaryOp, ast.Starred, ast.Await,
+                      ast.FormattedValue)
+
+
+def _walk_no_nested(fn_node: ast.AST):
+    """ast.walk over a function body that does not descend into nested
+    function/class definitions."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def resolve_sources(index: ModuleIndex, fn: FunctionInfo,
+                    expr: ast.expr, depth: int = 4,
+                    _seen: Optional[Set[Tuple[str, str]]] = None
+                    ) -> Set[Source]:
+    """The source atoms feeding ``expr`` inside ``fn`` (see module
+    docstring).  Arithmetic/boolean/conditional operators union their
+    operands; parameters resolve through caller argument expressions
+    while ``depth`` lasts."""
+    seen = _seen if _seen is not None else set()
+    if isinstance(expr, _Opaque):
+        return {Source('unknown', 'unpacked binding')}
+    if isinstance(expr, ast.Constant):
+        return {Source('const', repr(expr.value))}
+    if isinstance(expr, ast.Name):
+        return _resolve_name(index, fn, expr.id, depth, seen)
+    if isinstance(expr, ast.Attribute):
+        dotted = dotted_name(expr)
+        if dotted is not None:
+            return {Source('attr', dotted)}
+        return {Source('unknown', 'attribute of non-name')}
+    if isinstance(expr, ast.Call):
+        dotted = dotted_name(expr.func)
+        return {Source('call', dotted if dotted is not None
+                       else type(expr.func).__name__)}
+    if isinstance(expr, ast.BinOp):
+        return (resolve_sources(index, fn, expr.left, depth, seen) |
+                resolve_sources(index, fn, expr.right, depth, seen))
+    if isinstance(expr, _TRANSPARENT_UNARY):
+        inner = getattr(expr, 'operand', None) or \
+            getattr(expr, 'value', None)
+        if inner is not None:
+            return resolve_sources(index, fn, inner, depth, seen)
+    if isinstance(expr, ast.BoolOp):
+        out: Set[Source] = set()
+        for v in expr.values:
+            out |= resolve_sources(index, fn, v, depth, seen)
+        return out
+    if isinstance(expr, ast.IfExp):
+        return (resolve_sources(index, fn, expr.body, depth, seen) |
+                resolve_sources(index, fn, expr.orelse, depth, seen))
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = set()
+        for el in expr.elts:
+            out |= resolve_sources(index, fn, el, depth, seen)
+        return out
+    if isinstance(expr, ast.Compare):
+        return {Source('const', 'bool')}
+    if isinstance(expr, ast.Subscript):
+        base = dotted_name(expr.value)
+        if base is not None:
+            return {Source('attr', base + '[]')}
+        return {Source('unknown', 'subscript')}
+    return {Source('unknown', type(expr).__name__)}
+
+
+def _resolve_name(index: ModuleIndex, fn: FunctionInfo, name: str,
+                  depth: int, seen: Set[Tuple[str, str]]) -> Set[Source]:
+    key = (fn.qualname, name)
+    if key in seen:
+        return {Source('unknown', f'cycle through {name!r}')}
+    seen = seen | {key}
+    defs = _defs_cache(index, fn)
+    if name in defs:
+        out: Set[Source] = set()
+        for d in defs[name]:
+            out |= resolve_sources(index, fn, d, depth, seen)
+        return out
+    if name in fn.params:
+        if depth <= 0:
+            return {Source('param', f'{fn.qualname}.{name}')}
+        out = set()
+        callers = index.call_sites.get(
+            fn.qualname.rsplit('.', 1)[-1], [])
+        for caller, call in callers:
+            if caller.qualname == fn.qualname:
+                continue
+            arg = _arg_for_param(fn, call, name)
+            if arg is None:
+                if name in fn.defaults:
+                    arg = fn.defaults[name]
+                else:
+                    out.add(Source('param', f'{fn.qualname}.{name}'))
+                    continue
+            out |= resolve_sources(index, caller, arg, depth - 1, seen)
+        if not out:
+            if name in fn.defaults:
+                return resolve_sources(index, fn, fn.defaults[name],
+                                       depth - 1, seen)
+            return {Source('param', f'{fn.qualname}.{name}')}
+        return out
+    return {Source('unknown', f'unbound name {name!r}')}
+
+
+def _arg_for_param(fn: FunctionInfo, call: ast.Call,
+                   param: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == param:
+            return kw.value
+    params = fn.params
+    if params and params[0] == 'self':
+        params = params[1:]
+    try:
+        pos = params.index(param)
+    except ValueError:
+        return None
+    if pos < len(call.args) and not any(
+            isinstance(a, ast.Starred) for a in call.args[:pos + 1]):
+        return call.args[pos]
+    return None
+
+
+_DEFS_ATTR = '_skycheck_defs'
+
+
+def _defs_cache(index: ModuleIndex, fn: FunctionInfo):
+    cached = getattr(fn, _DEFS_ATTR, None)
+    if cached is None:
+        cached = local_defs(fn.node)
+        setattr(fn, _DEFS_ATTR, cached)
+    return cached
+
+
+# ------------------------------------------------------- dict-key lattice
+
+_TYPE_MAP = {int: 'number', float: 'number', str: 'str', bool: 'bool',
+             type(None): 'none'}
+_CAST_TYPES = {'int': 'number', 'float': 'number', 'len': 'number',
+               'sum': 'number', 'round': 'number', 'str': 'str',
+               'bool': 'bool', 'dict': 'dict', 'list': 'list',
+               'sorted': 'list', 'tuple': 'list', 'set': 'list',
+               'min': 'number', 'max': 'number', 'abs': 'number'}
+
+
+def infer_value_type(index: ModuleIndex, fn: FunctionInfo,
+                     expr: ast.expr) -> str:
+    """Best-effort concrete JSON type of ``expr``; 'unknown' never
+    conflicts with anything."""
+    if isinstance(expr, ast.Constant):
+        return _TYPE_MAP.get(type(expr.value), 'unknown')
+    if isinstance(expr, ast.Dict) or isinstance(expr, ast.DictComp):
+        return 'dict'
+    if isinstance(expr, (ast.List, ast.ListComp, ast.Tuple)):
+        return 'list'
+    if isinstance(expr, ast.Compare):
+        return 'bool'
+    if isinstance(expr, ast.IfExp):
+        a = infer_value_type(index, fn, expr.body)
+        b = infer_value_type(index, fn, expr.orelse)
+        if a == b:
+            return a
+        if 'none' in (a, b):        # Optional[x]: x or null
+            return a if b == 'none' else b
+        return 'unknown'
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in _CAST_TYPES:
+            return _CAST_TYPES[f.id]
+        if isinstance(f, ast.Attribute) and f.attr in ('get',):
+            return 'unknown'
+        return 'unknown'
+    if isinstance(expr, ast.BinOp):
+        a = infer_value_type(index, fn, expr.left)
+        b = infer_value_type(index, fn, expr.right)
+        if 'number' in (a, b):
+            return 'number'
+        return 'unknown'
+    if isinstance(expr, ast.Name):
+        info = _defs_cache(index, fn).get(expr.id)
+        if info and len(info) == 1 and not isinstance(info[0], _Opaque):
+            if isinstance(info[0], ast.stmt):
+                return 'unknown'
+            return infer_value_type(index, fn, info[0])
+        return 'unknown'
+    return 'unknown'
+
+
+@dataclasses.dataclass
+class KeyModel:
+    """Produced keys of one payload: key -> (always, types, lines)."""
+    always: Set[str] = dataclasses.field(default_factory=set)
+    sometimes: Set[str] = dataclasses.field(default_factory=set)
+    types: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    lines: Dict[str, int] = dataclasses.field(default_factory=dict)
+    complete: bool = True          # False when **spread went unresolved
+
+    @property
+    def keys(self) -> Set[str]:
+        return self.always | self.sometimes
+
+    def merge_branch(self, other: 'KeyModel') -> None:
+        """Combine two alternative branches: always = intersection."""
+        self.sometimes |= ((self.always ^ other.always) |
+                           other.sometimes)
+        self.always &= other.always
+        self.sometimes -= self.always
+        for k, t in other.types.items():
+            self.types.setdefault(k, set()).update(t)
+        for k, ln in other.lines.items():
+            self.lines.setdefault(k, ln)
+        self.complete = self.complete and other.complete
+
+
+def _literal_keys(index: ModuleIndex, fn: FunctionInfo,
+                  node: ast.Dict, model: KeyModel,
+                  conditional: bool, depth: int = 3) -> None:
+    for k, v in zip(node.keys, node.values):
+        if k is None:                       # {**spread}
+            resolved = False
+            if isinstance(v, ast.Name):
+                defs = _defs_cache(index, fn).get(v.id, [])
+                for d in defs:
+                    if isinstance(d, ast.Dict) and depth > 0:
+                        _literal_keys(index, fn, d, model,
+                                      conditional, depth - 1)
+                        resolved = True
+            if not resolved:
+                model.complete = False
+            continue
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            _note_key(index, fn, model, k.value, v, conditional,
+                      k.lineno)
+        else:
+            model.complete = False
+
+
+def _note_key(index: ModuleIndex, fn: FunctionInfo, model: KeyModel,
+              key: str, value: ast.expr, conditional: bool,
+              lineno: int) -> None:
+    if conditional:
+        if key not in model.always:
+            model.sometimes.add(key)
+    else:
+        model.always.add(key)
+        model.sometimes.discard(key)
+    t = infer_value_type(index, fn, value)
+    if t != 'unknown':
+        model.types.setdefault(key, set()).add(t)
+    model.lines.setdefault(key, lineno)
+
+
+def _is_conditional(fn_node: ast.AST, target: ast.AST) -> bool:
+    """True when ``target`` sits under an If/Try/loop inside the
+    function (i.e. does not execute on every call)."""
+    for holder in ast.walk(fn_node):
+        if holder is fn_node or not isinstance(
+                holder, (ast.If, ast.Try, ast.For, ast.While,
+                         ast.ExceptHandler)):
+            continue
+        if any(child is target for child in ast.walk(holder)):
+            return True
+    return False
+
+
+def _apply_var_mutations(index: ModuleIndex, fn: FunctionInfo,
+                         var: str, model: KeyModel) -> None:
+    """Fold ``var['k'] = v`` / ``var.update({...})`` /
+    ``var.setdefault('k', v)`` statements into the model."""
+    for node in _walk_no_nested(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Subscript) and \
+                isinstance(node.targets[0].value, ast.Name) and \
+                node.targets[0].value.id == var:
+            sl = node.targets[0].slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value,
+                                                           str):
+                cond = _is_conditional(fn.node, node)
+                _note_key(index, fn, model, sl.value, node.value,
+                          cond, node.lineno)
+            else:
+                model.complete = False
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == var:
+            cond = _is_conditional(fn.node, node)
+            if node.func.attr == 'update' and node.args and \
+                    isinstance(node.args[0], ast.Dict):
+                _literal_keys(index, fn, node.args[0], model, cond)
+            elif node.func.attr == 'setdefault' and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                val = (node.args[1] if len(node.args) > 1
+                       else ast.Constant(value=None))
+                _note_key(index, fn, model, node.args[0].value, val,
+                          cond, node.lineno)
+
+
+def _resolve_payload_expr(index: ModuleIndex, fn: FunctionInfo,
+                          expr: ast.expr, conditional: bool
+                          ) -> KeyModel:
+    model = KeyModel()
+    if isinstance(expr, ast.Dict):
+        _literal_keys(index, fn, expr, model, conditional)
+    elif isinstance(expr, ast.Name):
+        defs = _defs_cache(index, fn).get(expr.id, [])
+        dict_defs = [d for d in defs if isinstance(d, ast.Dict)]
+        if dict_defs:
+            branch = None
+            for d in dict_defs:
+                m = KeyModel()
+                _literal_keys(index, fn, d, m, conditional)
+                if branch is None:
+                    branch = m
+                else:
+                    branch.merge_branch(m)
+            model = branch or model
+        else:
+            model.complete = False
+        _apply_var_mutations(index, fn, expr.id, model)
+    elif isinstance(expr, ast.Call):
+        dotted = dotted_name(expr.func)
+        callee = index.find(dotted.rsplit('.', 1)[-1]) if dotted else \
+            None
+        if callee is not None:
+            return dict_key_model(index, callee, ('return',))
+        model.complete = False
+    else:
+        model.complete = False
+    return model
+
+
+def dict_key_model(index: ModuleIndex, fn: FunctionInfo,
+                   mode: Tuple[str, ...]) -> KeyModel:
+    """The produced-key lattice of a function's payload.
+
+    mode:
+      ('return',)       union of all ``return {...}`` branches
+      ('var', NAME)     dict bound to NAME + its ``NAME[k]=`` mutations
+      ('call', FUNC)    first argument of every ``FUNC(...)`` call
+    """
+    kind = mode[0]
+    if kind == 'return':
+        branch: Optional[KeyModel] = None
+        for node in _walk_no_nested(fn.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                m = _resolve_payload_expr(index, fn, node.value, False)
+                if branch is None:
+                    branch = m
+                else:
+                    branch.merge_branch(m)
+        return branch if branch is not None else KeyModel(complete=False)
+    if kind == 'var':
+        name_expr = ast.Name(id=mode[1])
+        return _resolve_payload_expr(index, fn, name_expr, False)
+    if kind == 'call':
+        branch = None
+        for node in _walk_no_nested(fn.node):
+            if isinstance(node, ast.Call) and node.args:
+                callee = node.func
+                simple = (callee.id if isinstance(callee, ast.Name)
+                          else callee.attr
+                          if isinstance(callee, ast.Attribute) else None)
+                if simple == mode[1]:
+                    m = _resolve_payload_expr(index, fn, node.args[0],
+                                              False)
+                    if branch is None:
+                        branch = m
+                    else:
+                        branch.merge_branch(m)
+        return branch if branch is not None else KeyModel(complete=False)
+    raise ValueError(f'unknown dict_key_model mode {mode!r}')
+
+
+# ------------------------------------------------------- consumed keys
+
+def read_keys(index: ModuleIndex, fn: FunctionInfo,
+              varnames: Optional[Sequence[str]] = None,
+              exclude_vars: Sequence[str] = (),
+              scope: Optional[ast.AST] = None) -> Dict[str, int]:
+    """String keys the function READS: ``X['k']`` loads and
+    ``X.get('k'...)`` calls, restricted to receivers named in
+    ``varnames`` (None = any receiver, except names in
+    ``exclude_vars`` — receivers holding some *other* surface's
+    document).  ``scope`` restricts the walk to one statement subtree
+    of the function (e.g. a single route branch of a multi-route
+    handler).  Returns key -> first line."""
+    out: Dict[str, int] = {}
+
+    def receiver_ok(node: ast.expr) -> bool:
+        if varnames is None:
+            if isinstance(node, ast.Name) and node.id in exclude_vars:
+                return False
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in varnames
+        return False
+
+    for node in _walk_no_nested(scope if scope is not None
+                                else fn.node):
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load) and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str) and \
+                receiver_ok(node.value):
+            out.setdefault(node.slice.value, node.lineno)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ('get', 'pop') and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str) and \
+                receiver_ok(node.func.value):
+            out.setdefault(node.args[0].value, node.lineno)
+        elif isinstance(node, ast.Compare) and \
+                isinstance(node.left, ast.Constant) and \
+                isinstance(node.left.value, str) and \
+                len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                receiver_ok(node.comparators[0]):
+            out.setdefault(node.left.value, node.lineno)
+    return out

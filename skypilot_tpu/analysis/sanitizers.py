@@ -1,7 +1,7 @@
-"""Opt-in runtime sanitizers: lock-order checking and block-leak
-detection.
+"""Opt-in runtime sanitizers: lock-order checking, block-leak
+detection, and compile-budget enforcement.
 
-Both are env-gated and cost nothing when off:
+All are env-gated and cost nothing when off:
 
 - ``SKYTPU_LOCK_SANITIZER=1`` — ``instrument_lock(lock, name)`` wraps a
   ``threading.Lock`` so every acquisition records (per-thread) what was
@@ -22,8 +22,18 @@ Both are env-gated and cost nothing when off:
   blocks.  The serving loop calls ``maybe_check_block_conservation``
   on idle iterations; chaos_smoke and the fault tests call the checker
   directly after drain.
+- ``SKYTPU_COMPILE_SANITIZER=1`` — ``check_compile_budget(engine)``
+  asserts, per jit root, that the number of XLA compilations the root
+  has actually accumulated (``fn._cache_size()``) is within the
+  PROVABLE worst case the static COMPILE pass derives from the
+  engine's source and this engine's config
+  (``analysis.compile_budget``).  A measured count above the bound
+  means a shape dimension escaped the bucketing ladder — the
+  recompilation storm the ladder exists to prevent — and raises
+  ``CompileBudgetError`` naming the offending root.  Checked at the
+  same quiesce points as block conservation.
 
-``SKYTPU_SANITIZERS=1`` enables both.  Lock *names* are roles shared
+``SKYTPU_SANITIZERS=1`` enables all three.  Lock *names* are roles shared
 across instances (``'infer.engine._lock'``), so an order inversion
 between two engine instances is still an inversion — the discipline is
 per role, matching how the code is written.
@@ -47,12 +57,21 @@ def block_sanitizer_enabled() -> bool:
     return _env_on('SKYTPU_BLOCK_SANITIZER') or _env_on('SKYTPU_SANITIZERS')
 
 
+def compile_sanitizer_enabled() -> bool:
+    return (_env_on('SKYTPU_COMPILE_SANITIZER') or
+            _env_on('SKYTPU_SANITIZERS'))
+
+
 class LockOrderError(RuntimeError):
     """A lock acquisition violates the global acquisition order."""
 
 
 class BlockLeakError(RuntimeError):
     """The paged pool's refcount conservation invariant is broken."""
+
+
+class CompileBudgetError(RuntimeError):
+    """A jit root compiled more variants than the provable bound."""
 
 
 # --------------------------------------------------------------- lock order
@@ -278,3 +297,36 @@ def maybe_check_block_conservation(engine: Any) -> None:
     """Serving-loop quiesce hook: no-op unless the gate is on."""
     if block_sanitizer_enabled():
         check_block_conservation(engine)
+
+
+# ------------------------------------------------------------ compile budget
+
+def check_compile_budget(engine: Any) -> Dict[str, Any]:
+    """Assert measured XLA compile counts against the static bounds.
+
+    For every jit root the COMPILE pass discovers in the engine's
+    source, ``fn._cache_size()`` (the root's accumulated compilation
+    count) must not exceed the provable worst case under THIS engine's
+    config.  Exceeding it means a shape dimension reached the root
+    without going through a bucketing ladder.  Returns
+    ``{root: (measured, bound)}``; raises CompileBudgetError on any
+    violation.
+    """
+    from skypilot_tpu.analysis import compile_budget
+    counts = compile_budget.check_engine_budget(engine)
+    over = [(name, measured, bound)
+            for name, (measured, bound) in sorted(counts.items())
+            if measured > bound]
+    if over:
+        lines = [f'{name}: measured {measured} compiles > provable '
+                 f'bound {bound}' for name, measured, bound in over]
+        raise CompileBudgetError(
+            'compile budget exceeded (a shape dimension escaped the '
+            'bucketing ladder):\n  ' + '\n  '.join(lines))
+    return counts
+
+
+def maybe_check_compile_budget(engine: Any) -> None:
+    """Quiesce hook twin of maybe_check_block_conservation."""
+    if compile_sanitizer_enabled():
+        check_compile_budget(engine)

@@ -1,0 +1,398 @@
+"""WIRE pass: cross-plane JSON wire-schema drift.
+
+The three planes (engine server, load balancer, controller) talk
+through string-keyed JSON documents whose producers and consumers live
+in different files — nothing but convention keeps them aligned.  This
+pass extracts, per *surface* (one named JSON document), the keys every
+producer emits (via the dict-key lattice in ``analysis.dataflow``) and
+the keys every registered consumer reads, then reports:
+
+- **WIRE001** (error tier): a consumed key no producer emits — or one
+  emitted only on *some* producer branch (e.g. paged-only engine stats
+  keys read by a consumer that may face a dense replica).  These are
+  the live drift bugs.
+- **WIRE002** (baseline tier): a produced key nothing consumes.  Most
+  are legitimate operator/dashboard surface — pinned in
+  ``skycheck_baseline.txt`` so only *new* unconsumed keys surface.
+- **WIRE003** (error tier): one key produced with conflicting concrete
+  value types across branches/producers of the same surface.
+
+The surface registry below is explicit, like jit_boundary.HOT_ROOTS:
+adding an HTTP endpoint or a cross-plane reader means adding a spec
+line here — skycheck then owns the contract forever after.
+
+The pass is tree-scoped (``check_tree``): it needs producer and
+consumer files together.  ``contract()`` returns the full
+produced/consumed table; ``render_markdown()`` formats it for the
+generated table in docs/architecture.md.
+"""
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.analysis import dataflow
+from skypilot_tpu.analysis.findings import Finding
+
+PASS_CONSUMED_NOT_PRODUCED = 'WIRE001'
+PASS_PRODUCED_NOT_CONSUMED = 'WIRE002'
+PASS_TYPE_CONFLICT = 'WIRE003'
+
+
+@dataclasses.dataclass(frozen=True)
+class Producer:
+    path: str                  # repo-relative producer file
+    func: str                  # qualname (suffix ok) of the producer
+    mode: Tuple[str, ...]      # dataflow.dict_key_model mode
+
+
+@dataclasses.dataclass(frozen=True)
+class Consumer:
+    path: str
+    func: str
+    vars: Optional[Tuple[str, ...]] = None   # doc receivers; None=any
+    exclude_vars: Tuple[str, ...] = ()       # receivers to skip
+    route: Optional[str] = None              # scope to one If branch
+    #   whose test compares against this constant (multi-route handler)
+
+
+@dataclasses.dataclass(frozen=True)
+class SurfaceSpec:
+    name: str
+    producers: Tuple[Producer, ...]
+    consumers: Tuple[Consumer, ...]
+    # Event streams (SSE) carry a UNION of event types: consumers
+    # dispatch on discriminator keys, so branch-dependent production is
+    # the design, not drift — only consumed-never-produced is an error.
+    union_producers: bool = False
+
+
+_SERVER = 'skypilot_tpu/infer/server.py'
+_ENGINE = 'skypilot_tpu/infer/engine.py'
+_LB = 'skypilot_tpu/serve/load_balancer.py'
+_CTRL = 'skypilot_tpu/serve/controller.py'
+_POLICIES = 'skypilot_tpu/serve/load_balancing_policies.py'
+
+# The wire contract: every cross-plane JSON document the system
+# exchanges.  Producer modes: ('return',) = returned dict,
+# ('var', N) = dict bound to local N (+ its N[k]= mutations),
+# ('call', F) = first arg of every F(...) call in the function.
+SURFACES: Tuple[SurfaceSpec, ...] = (
+    # Engine-plane /stats HTTP document (server.py builds it inline in
+    # the route handler around engine.stats()).
+    SurfaceSpec(
+        '/stats',
+        producers=(Producer(_SERVER, 'do_GET', ('route-stats',)),),
+        consumers=(
+            Consumer('tests/test_infer.py',
+                     'test_openai_completions_token_array',
+                     vars=('stats',)),
+        ),
+    ),
+    # Engine-plane /healthz readiness document: the LB probe thread and
+    # the routing policies read it on the routing-critical path.
+    SurfaceSpec(
+        '/healthz',
+        producers=(Producer(_SERVER, 'health', ('var', 'doc')),),
+        consumers=(
+            Consumer(_LB, '_probe_replica_once', vars=('doc',)),
+            Consumer(_POLICIES, 'PrefixAffinityPolicy.observe_replica',
+                     vars=('health_doc',)),
+            Consumer(_SERVER, 'do_GET', vars=('doc',)),
+        ),
+    ),
+    # The kv sub-document of /healthz (engine.kv_health()): consumed by
+    # prefix-affinity routing (block_size keys the ring, occupancy
+    # feeds the load penalty).
+    SurfaceSpec(
+        '/healthz.kv',
+        producers=(Producer(_ENGINE, 'kv_health', ('return',)),),
+        consumers=(
+            Consumer(_POLICIES, 'PrefixAffinityPolicy.observe_replica',
+                     vars=('kv',)),
+            Consumer(_POLICIES, 'PrefixAffinityPolicy._eff_load',
+                     vars=None),
+            Consumer(_POLICIES, 'PrefixAffinityPolicy._load_bound',
+                     vars=None, exclude_vars=('radix',)),
+        ),
+    ),
+    # The radix sub-document of /healthz.kv: the affinity load bound
+    # boosts its spill threshold by the fleet-average hit rate.
+    SurfaceSpec(
+        '/healthz.kv.radix',
+        producers=(Producer(_ENGINE, 'kv_health', ('var', 'radix')),),
+        consumers=(
+            Consumer(_POLICIES, 'PrefixAffinityPolicy._load_bound',
+                     vars=('radix',)),
+        ),
+    ),
+    # LB-plane /lb/stats observability document.
+    SurfaceSpec(
+        '/lb/stats',
+        producers=(Producer(_LB, 'lb_stats', ('return',)),),
+        consumers=(
+            Consumer('tests/test_serve_failover.py', None,
+                     vars=('stats', 'st')),
+            Consumer('tests/test_lb_affinity.py', None,
+                     vars=('stats', 'st')),
+            Consumer('scripts/bench_serve_lb.py', None,
+                     vars=('stats',)),
+        ),
+    ),
+    # Controller /controller/state snapshot.
+    SurfaceSpec(
+        '/controller/state',
+        producers=(Producer(_CTRL, 'state_snapshot', ('return',)),),
+        consumers=(
+            Consumer('tests/test_qos.py', None, vars=('snap',)),
+            Consumer('tests/test_serve.py', None, vars=('snap',)),
+        ),
+    ),
+    # LB -> controller sync body (one producer, one consumer, different
+    # processes: the canonical drift surface).
+    SurfaceSpec(
+        'lb_sync',
+        producers=(Producer(_LB, '_sync_with_controller_once',
+                            ('call', 'dumps')),),
+        consumers=(
+            Consumer(_CTRL, 'ServeController._handle',
+                     vars=('payload',),
+                     route='/controller/load_balancer_sync'),
+        ),
+    ),
+    # Engine-plane /generate SSE terminal events (done/error): consumed
+    # by the LB's stream relay for failover stitching.
+    SurfaceSpec(
+        'sse.events',
+        producers=(
+            Producer(_SERVER, '_stream', ('call', 'emit')),
+            Producer(_LB, 'emit_error_event', ('call', 'emit_event')),
+            Producer(_LB, '_handle_stream_generate',
+                     ('call', 'emit_event')),
+        ),
+        consumers=(
+            Consumer(_LB, '_proxy_stream_once', vars=('obj',)),
+        ),
+        union_producers=True,
+    ),
+    # engine.stats() itself (the dict under /stats['kv_cache'] and the
+    # flat alias tier): branch-stability matters because dashboards
+    # read it for BOTH layouts.
+    SurfaceSpec(
+        'engine.stats',
+        producers=(Producer(_ENGINE, 'stats', ('return',)),),
+        consumers=(
+            Consumer(_SERVER, 'do_GET', vars=('st',)),
+            Consumer('tests/test_paged_kv.py', None, vars=('st',)),
+            Consumer('tests/test_radix.py', None, vars=('st',)),
+        ),
+    ),
+)
+
+
+def _producer_model(files: Dict[str, str], spec: Producer
+                    ) -> Optional[dataflow.KeyModel]:
+    text = files.get(spec.path)
+    if text is None:
+        return None
+    try:
+        index = _index_for(spec.path, text)
+    except SyntaxError:
+        return None
+    if spec.mode == ('route-stats',):
+        return _route_stats_model(index)
+    fn = index.find(spec.func)
+    if fn is None:
+        return None
+    return dataflow.dict_key_model(index, fn, spec.mode)
+
+
+def _route_stats_model(index: dataflow.ModuleIndex
+                       ) -> Optional[dataflow.KeyModel]:
+    """The dict literal server.do_GET answers on the '/stats' route —
+    anchored on the route string, so handler refactors don't lose it."""
+    fn = index.find('do_GET')
+    if fn is None:
+        return None
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if isinstance(test, ast.Compare) and test.comparators and \
+                isinstance(test.comparators[0], ast.Constant) and \
+                test.comparators[0].value == '/stats':
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == '_json' and \
+                        len(sub.args) >= 2 and \
+                        isinstance(sub.args[1], ast.Dict):
+                    model = dataflow.KeyModel()
+                    dataflow._literal_keys(index, fn, sub.args[1],
+                                           model, conditional=False)
+                    return model
+    return None
+
+
+_INDEX_CACHE: Dict[Tuple[str, int], dataflow.ModuleIndex] = {}
+
+
+def _index_for(path: str, text: str) -> dataflow.ModuleIndex:
+    key = (path, hash(text))
+    idx = _INDEX_CACHE.get(key)
+    if idx is None:
+        if len(_INDEX_CACHE) > 64:   # bound memory across test runs
+            _INDEX_CACHE.clear()
+        idx = dataflow.ModuleIndex(path, text)
+        _INDEX_CACHE[key] = idx
+    return idx
+
+
+def _consumer_keys(files: Dict[str, str], spec: Consumer
+                   ) -> Dict[str, Tuple[int, str]]:
+    """key -> (line, 'path:func') over one consumer spec."""
+    text = files.get(spec.path)
+    if text is None:
+        return {}
+    try:
+        index = dataflow.ModuleIndex(spec.path, text)
+    except SyntaxError:
+        return {}
+    fns: List[dataflow.FunctionInfo] = []
+    if spec.func is None:
+        fns = list(index.functions.values())
+    else:
+        fn = index.find(spec.func)
+        if fn is not None:
+            fns = [fn]
+    out: Dict[str, Tuple[int, str]] = {}
+    for fn in fns:
+        scope = None
+        if spec.route is not None:
+            scope = _route_branch(fn.node, spec.route)
+            if scope is None:
+                continue
+        for key, line in dataflow.read_keys(
+                index, fn, spec.vars,
+                exclude_vars=spec.exclude_vars, scope=scope).items():
+            out.setdefault(key, (line, f'{spec.path}:{fn.qualname}'))
+    return out
+
+
+def _route_branch(fn_node: ast.AST, route: str) -> Optional[ast.AST]:
+    """The body of the If branch inside ``fn_node`` whose test compares
+    against the constant ``route`` — scopes a multi-route handler's
+    reads to one wire surface.  Only the branch *body*: an elif chain
+    keeps its other routes in ``orelse``."""
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.If):
+            continue
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Constant) and sub.value == route:
+                return ast.Module(body=node.body, type_ignores=[])
+    return None
+
+
+@dataclasses.dataclass
+class SurfaceContract:
+    name: str
+    produced: dataflow.KeyModel
+    consumed: Dict[str, Tuple[int, str]]
+    producer_of: Dict[str, Tuple[str, int]]   # key -> (path, line)
+    producer_path: str
+    union_producers: bool = False
+
+
+def contract(files: Dict[str, str],
+             surfaces: Sequence[SurfaceSpec] = SURFACES
+             ) -> List[SurfaceContract]:
+    out: List[SurfaceContract] = []
+    for spec in surfaces:
+        produced: Optional[dataflow.KeyModel] = None
+        producer_path = spec.producers[0].path
+        producer_of: Dict[str, Tuple[str, int]] = {}
+        for p in spec.producers:
+            model = _producer_model(files, p)
+            if model is None:
+                continue
+            for key, line in model.lines.items():
+                producer_of.setdefault(key, (p.path, line))
+            if produced is None:
+                produced = model
+            else:
+                # Multiple producers of one surface are alternatives
+                # (e.g. engine done event vs LB synthesized terminal):
+                # 'always' means every producer emits it.
+                produced.merge_branch(model)
+        if produced is None:
+            produced = dataflow.KeyModel(complete=False)
+        consumed: Dict[str, Tuple[int, str]] = {}
+        for c in spec.consumers:
+            for key, loc in _consumer_keys(files, c).items():
+                consumed.setdefault(key, loc)
+        out.append(SurfaceContract(spec.name, produced, consumed,
+                                   producer_of, producer_path,
+                                   spec.union_producers))
+    return out
+
+
+def check_tree(files: Dict[str, str],
+               surfaces: Sequence[SurfaceSpec] = SURFACES
+               ) -> List[Finding]:
+    findings: List[Finding] = []
+    for sc in contract(files, surfaces):
+        prod = sc.produced
+        for key, (line, where) in sorted(sc.consumed.items()):
+            path, _, func = where.partition(':')
+            if key not in prod.keys:
+                if not prod.complete:
+                    # The producer model has unresolved spreads: a
+                    # missing key is unprovable — stay quiet rather
+                    # than cry wolf on every consumer.
+                    continue
+                findings.append(Finding(
+                    path, line, PASS_CONSUMED_NOT_PRODUCED,
+                    f"surface '{sc.name}': key '{key}' consumed by "
+                    f'{func} but never produced'))
+            elif key in prod.sometimes and not sc.union_producers:
+                findings.append(Finding(
+                    path, line, PASS_CONSUMED_NOT_PRODUCED,
+                    f"surface '{sc.name}': key '{key}' consumed by "
+                    f'{func} but produced only on some branches '
+                    '(layout/feature-dependent producers must emit a '
+                    'stable key set)'))
+        for key in sorted(prod.keys):
+            if key not in sc.consumed:
+                ppath, pline = sc.producer_of.get(
+                    key, (sc.producer_path, 1))
+                findings.append(Finding(
+                    ppath, pline, PASS_PRODUCED_NOT_CONSUMED,
+                    f"surface '{sc.name}': key '{key}' produced but "
+                    'no registered consumer reads it'))
+        for key, types in sorted(prod.types.items()):
+            concrete = types - {'unknown', 'none'}
+            if len(concrete) > 1:
+                ppath, pline = sc.producer_of.get(
+                    key, (sc.producer_path, 1))
+                findings.append(Finding(
+                    ppath, pline, PASS_TYPE_CONFLICT,
+                    f"surface '{sc.name}': key '{key}' produced with "
+                    f'conflicting value types '
+                    f'{"/".join(sorted(concrete))}'))
+    return findings
+
+
+def render_markdown(files: Dict[str, str],
+                    surfaces: Sequence[SurfaceSpec] = SURFACES) -> str:
+    """The generated wire-contract table for docs/architecture.md."""
+    rows = ['| surface | producer | stable keys | branch-dependent | '
+            'consumed |',
+            '|---|---|---|---|---|']
+    for sc in contract(files, surfaces):
+        stable = ', '.join(f'`{k}`' for k in sorted(sc.produced.always))
+        branchy = ', '.join(f'`{k}`'
+                            for k in sorted(sc.produced.sometimes))
+        consumed = ', '.join(f'`{k}`' for k in sorted(sc.consumed))
+        rows.append(f'| `{sc.name}` | `{sc.producer_path}` | '
+                    f'{stable or "—"} | {branchy or "—"} | '
+                    f'{consumed or "—"} |')
+    return '\n'.join(rows) + '\n'

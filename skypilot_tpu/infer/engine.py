@@ -1409,7 +1409,7 @@ class InferenceEngine:
         cur = int(self._slot_nblocks[slot])
         if need <= cur:
             return
-        ids = self._alloc_blocks(need - cur)
+        ids = self._alloc_blocks(need - cur)  # owns-blocks: table
         self._tables_np[slot, cur:need] = ids
         self._slot_nblocks[slot] = need
 
@@ -1550,7 +1550,19 @@ class InferenceEngine:
             'evictions': rs['evictions'],
         }
         if not self._paged:
-            return {'layout': 'dense', 'occupancy': 0.0, 'radix': radix}
+            # Same key set as the paged branch: prefix_affinity keys
+            # its route length off block_size and the LB caches this
+            # document per replica — a dense replica in a mixed fleet
+            # must not make consumers key-miss (block_size 0 reads as
+            # "no paged pool", observe_replica ignores it).
+            return {
+                'layout': 'dense',
+                'block_size': 0,
+                'blocks_total': 0,
+                'blocks_free': 0,
+                'occupancy': 0.0,
+                'radix': radix,
+            }
         usable = self._num_blocks - 1
         free = len(self._free_blocks)
         return {
@@ -1588,10 +1600,23 @@ class InferenceEngine:
             return {
                 'kv': kv,
                 'serving': bool(self._serving),
-                # deprecated aliases of kv.*
+                # deprecated aliases of kv.* — the SAME key set as the
+                # paged branch (zeros where dense has no block pool):
+                # dashboards and tests read these flat keys without
+                # knowing which layout the replica runs.
                 'kv_layout': 'dense',
+                'block_size': 0,
+                'blocks_total': 0,
+                'blocks_free': 0,
+                'blocks_allocated': 0,
+                'blocks_shared': 0,
+                'blocks_prefix': 0,
+                'shared_refs_saved': 0,
+                'kv_bytes_per_block': 0,
                 'kv_bytes_total': total * row_bytes,
                 'kv_bytes_resident': total * row_bytes,
+                'admission_deferred': 0,
+                'prefix_block_hits': 0,
                 'faults': dict(self.fault_stats),
                 'qos': self._qos_section(),
             }
@@ -1938,17 +1963,26 @@ class InferenceEngine:
                     f'prefix ({need} blocks; {len(self._free_blocks)} '
                     'free after honoring running slots) — raise '
                     'kv_blocks')
-            blocks = self._alloc_blocks(need)
+            blocks = self._alloc_blocks(need)  # owns-blocks: entry
             table = np.zeros((1, bucket // bs_), np.int32)
             table[0, :need] = blocks
-            with self._ctx():
-                _, _, self.cache = self._paged_prefill(
-                    self.params, jnp.asarray(arr),
-                    jnp.zeros((1,), jnp.int32),
-                    jnp.full((1,), n - 1, jnp.int32), self.cache,
-                    jnp.asarray(table), jnp.zeros((1,), jnp.float32),
-                    jax.random.PRNGKey(0),
-                    jnp.full((1,), aid, jnp.int32), False)
+            try:
+                with self._ctx():
+                    _, _, self.cache = self._paged_prefill(
+                        self.params, jnp.asarray(arr),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.full((1,), n - 1, jnp.int32), self.cache,
+                        jnp.asarray(table),
+                        jnp.zeros((1,), jnp.float32),
+                        jax.random.PRNGKey(0),
+                        jnp.full((1,), aid, jnp.int32), False)
+            except BaseException:
+                # The registry never adopted the blocks: return the
+                # refs so pool accounting stays balanced (the runtime
+                # block sanitizer asserts this at quiesce).
+                for b in blocks:
+                    self._deref_block(b)
+                raise
             self._prefixes[key] = {'blocks': blocks, 'len': n}
             self._prefixes.move_to_end(key)
             while len(self._prefixes) > self.cfg.max_prefixes:
@@ -1982,19 +2016,27 @@ class InferenceEngine:
                     f'prefix ({need} blocks; {len(self._free_blocks)} '
                     'free after honoring running slots) — raise '
                     'kv_blocks')
-            blocks = self._alloc_blocks(need)
+            blocks = self._alloc_blocks(need)  # owns-blocks: radix
             table = np.zeros((1, bucket // bs_), np.int32)
             table[0, :need] = blocks
             # Rows [m, n) (the sub-block tail) scatter into table
             # entries past `need`, i.e. the dump block — discarded.
-            with self._ctx():
-                _, _, self.cache = self._paged_prefill(
-                    self.params, jnp.asarray(arr),
-                    jnp.zeros((1,), jnp.int32),
-                    jnp.full((1,), n - 1, jnp.int32), self.cache,
-                    jnp.asarray(table), jnp.zeros((1,), jnp.float32),
-                    jax.random.PRNGKey(0),
-                    jnp.full((1,), aid, jnp.int32), False)
+            try:
+                with self._ctx():
+                    _, _, self.cache = self._paged_prefill(
+                        self.params, jnp.asarray(arr),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.full((1,), n - 1, jnp.int32), self.cache,
+                        jnp.asarray(table),
+                        jnp.zeros((1,), jnp.float32),
+                        jax.random.PRNGKey(0),
+                        jnp.full((1,), aid, jnp.int32), False)
+            except BaseException:
+                # The tree never adopted the blocks: return the refs
+                # so pool accounting stays balanced.
+                for b in blocks:
+                    self._deref_block(b)
+                raise
             # own=True: the tree takes over our allocation refs;
             # duplicates of already-cached runs are dereffed (freed).
             self.radix_stats['inserts'] += self._radix.insert(
@@ -2065,9 +2107,9 @@ class InferenceEngine:
         b_ = 1
         while b_ * 2 <= start:
             b_ *= 2
-        prefix_b = [(k[:, :b_], v[:, :b_]) for k, v in kv]
+        prefix_b = [(k[:, :b_], v[:, :b_]) for k, v in kv]  # compile-shape: prefix_b=prefix_pow2
         r = start - b_
-        rem = []
+        rem = []        # compile-shape: rem=prefix_pow2
         for k, v in kv:
             hkv, _, hd = k.shape
             if r:
@@ -2157,7 +2199,7 @@ class InferenceEngine:
                 self._append_shared_blocks(
                     slot, [int(b) for b in entry['blocks'][:shared_n]])
                 if tail:
-                    [dst] = self._alloc_blocks(1)
+                    [dst] = self._alloc_blocks(1)  # owns-blocks: table
                     cur = int(self._slot_nblocks[slot])
                     self._tables_np[slot, cur] = dst
                     self._slot_nblocks[slot] = cur + 1
@@ -2347,7 +2389,7 @@ class InferenceEngine:
                     rest.append(it)
                     continue
                 rgroups.setdefault(sb, []).append((it, start, blocks))
-            for sb, rgroup in rgroups.items():
+            for sb, rgroup in rgroups.items():  # compile-shape: sb=suffix_buckets
                 self._start_radix_group_paged(rgroup, sb, gen)
             items = rest
         if self._prefixes:
@@ -2367,6 +2409,8 @@ class InferenceEngine:
                     rest.append(it)
                     continue
                 groups.setdefault((key, start, sb), []).append(it)
+            # compile-shape: sb=suffix_buckets
+            # compile-shape: start=const  (enters jit as shape-() scalar only)
             for (key, start, sb), group in groups.items():
                 self._start_prefixed_group(group, start, sb, key)
             items = rest
@@ -2394,7 +2438,7 @@ class InferenceEngine:
         by_bucket: Dict[int, list] = {}
         for it in items:
             by_bucket.setdefault(it[4], []).append(it)
-        for bucket, group in by_bucket.items():
+        for bucket, group in by_bucket.items():  # compile-shape: bucket=prefill_buckets
             for ofs in range(0, len(group), lanes):
                 chunk = group[ofs:ofs + lanes]
                 p = len(chunk)
@@ -2982,6 +3026,7 @@ class InferenceEngine:
                <= in_flight_steps for s in live):
             return          # every survivor finishes in flight
         self._rng, key = jax.random.split(self._rng)
+        # compile-shape: chain=const  (device tokens/lengths, fixed (num_slots,))
         if self._paged:
             # The in-flight window advances device lengths past the
             # host mirror: budget blocks for both windows' rows.
@@ -3730,9 +3775,12 @@ class InferenceEngine:
                     moved = True
             if not moved:
                 # Quiesce point: nothing in flight moved this pass, so
-                # the block pool's refcounts must balance exactly
-                # (no-op unless SKYTPU_BLOCK_SANITIZER/SKYTPU_SANITIZERS).
+                # the block pool's refcounts must balance exactly and
+                # every jit root's compile count must sit within its
+                # provable bound (each no-op unless its sanitizer
+                # gate / SKYTPU_SANITIZERS is on).
                 sanitizers.maybe_check_block_conservation(self)
+                sanitizers.maybe_check_compile_budget(self)
                 time.sleep(idle_sleep)
 
     def warmup_decode(self, tokens: Sequence[int]) -> None:

@@ -347,6 +347,9 @@ class InferenceServer:
             'draining': self.draining.is_set(),
             'drained': self.drained.is_set(),
             'inflight': self.gen_inflight,
+            # Stable key set: None until the engine can answer — probe
+            # consumers must never key-miss on a starting replica.
+            'kv': None,
         }
         # KV/radix summary for affinity-aware LB routing: kv_health()
         # is counters-only (this document is probed on a short

@@ -289,6 +289,28 @@ def test_paged_config_validation(tiny_config):
             kv_block_size=8, kv_blocks=4))
 
 
+def test_dense_paged_wire_key_parity(tiny_config, shared_params):
+    """Regression (PR-9 wire drift fixes): a dense replica must answer
+    kv_health() and stats() with the SAME key set as a paged one —
+    prefix_affinity keys its route length off kv_health's block_size
+    and dashboards read the flat stats aliases, so a mixed fleet
+    key-missed on dense replicas before."""
+    dense, paged = _pair(tiny_config, shared_params)
+    kd, kp = dense.kv_health(), paged.kv_health()
+    assert set(kd) == set(kp)
+    assert set(kd['radix']) == set(kp['radix'])
+    # block_size 0 reads as "no paged pool": observe_replica's guard
+    # (isinstance int and > 0) must ignore, not crash.
+    assert kd['layout'] == 'dense' and kd['block_size'] == 0
+    sd, sp = dense.stats(), paged.stats()
+    assert set(sd) == set(sp)
+    for k in ('block_size', 'blocks_total', 'blocks_free',
+              'blocks_allocated', 'blocks_shared', 'blocks_prefix',
+              'shared_refs_saved', 'kv_bytes_per_block',
+              'admission_deferred', 'prefix_block_hits'):
+        assert sd[k] == 0, k
+
+
 def test_check_tier1_budget_parser(tmp_path):
     import importlib.util
     import pathlib

@@ -11,8 +11,10 @@ import threading
 import numpy as np
 import pytest
 
-from skypilot_tpu.analysis import (determinism, jit_boundary, layering,
-                                   lock_discipline, sanitizers)
+from skypilot_tpu.analysis import (block_lifecycle, compile_budget,
+                                   dataflow, determinism, jit_boundary,
+                                   layering, lock_discipline, sanitizers,
+                                   wire_contract)
 from skypilot_tpu.analysis.findings import (Finding, load_baseline,
                                             new_findings)
 from skypilot_tpu.analysis.walker import iter_py_files
@@ -461,3 +463,541 @@ def test_maybe_check_is_gated(monkeypatch):
     monkeypatch.setenv('SKYTPU_BLOCK_SANITIZER', '1')
     with pytest.raises(sanitizers.BlockLeakError):
         sanitizers.maybe_check_block_conservation(eng)
+
+
+def test_compile_sanitizer_gating(monkeypatch):
+    monkeypatch.setattr(compile_budget, 'check_engine_budget',
+                        lambda eng: {'_decode': (3, 2)})
+    monkeypatch.delenv('SKYTPU_COMPILE_SANITIZER', raising=False)
+    monkeypatch.delenv('SKYTPU_SANITIZERS', raising=False)
+    sanitizers.maybe_check_compile_budget(object())  # gate off: no-op
+    monkeypatch.setenv('SKYTPU_COMPILE_SANITIZER', '1')
+    with pytest.raises(sanitizers.CompileBudgetError, match='_decode'):
+        sanitizers.maybe_check_compile_budget(object())
+    # Within bound: the counts come back for reporting.
+    monkeypatch.setattr(compile_budget, 'check_engine_budget',
+                        lambda eng: {'_decode': (2, 2)})
+    assert sanitizers.check_compile_budget(object()) == \
+        {'_decode': (2, 2)}
+
+
+# ------------------------------------------------------------ dataflow
+
+def test_dataflow_dict_key_model_branches():
+    text = textwrap.dedent('''
+        def stats(paged):
+            if paged:
+                return {'a': 1, 'b': 'x', 'c': 0}
+            return {'a': 2, 'b': 3}
+    ''')
+    index = dataflow.ModuleIndex('m.py', text)
+    model = dataflow.dict_key_model(index, index.find('stats'),
+                                    ('return',))
+    assert model.always == {'a', 'b'}
+    assert model.sometimes == {'c'}
+    # 'b' is str on one branch, a number on the other: a WIRE003 seed.
+    assert {'str', 'number'} <= model.types['b']
+
+
+def test_dataflow_read_keys_forms():
+    text = textwrap.dedent('''
+        def f(doc):
+            x = doc['alpha']
+            y = doc.get('beta')
+            if 'gamma' in doc:
+                pass
+            return x, y
+    ''')
+    index = dataflow.ModuleIndex('m.py', text)
+    keys = dataflow.read_keys(index, index.find('f'))
+    assert set(keys) == {'alpha', 'beta', 'gamma'}
+
+
+# --------------------------------------------------------- wire contract
+
+def _wire_fixture(producer_body, consumer_body):
+    files = {
+        'skypilot_tpu/infer/prod.py': textwrap.dedent(producer_body),
+        'skypilot_tpu/serve/cons.py': textwrap.dedent(consumer_body),
+    }
+    spec = wire_contract.SurfaceSpec(
+        'test.surface',
+        (wire_contract.Producer('skypilot_tpu/infer/prod.py', 'make',
+                                ('return',)),),
+        (wire_contract.Consumer('skypilot_tpu/serve/cons.py', 'use',
+                                vars=('doc',)),))
+    return wire_contract.check_tree(files, (spec,))
+
+
+def test_wire001_consumed_never_produced():
+    findings = _wire_fixture(
+        '''
+        def make():
+            return {'present': 1}
+        ''', '''
+        def use(doc):
+            return doc['missing'] + doc['present']
+        ''')
+    assert _ids(findings) == ['WIRE001']
+    assert "'missing'" in findings[0].message
+    assert findings[0].path == 'skypilot_tpu/serve/cons.py'
+
+
+def test_wire001_branch_dependent_key():
+    findings = _wire_fixture(
+        '''
+        def make(paged):
+            if paged:
+                return {'k': 1, 'extra': 2}
+            return {'k': 1}
+        ''', '''
+        def use(doc):
+            return doc['extra'] + doc['k']
+        ''')
+    assert _ids(findings) == ['WIRE001']
+    assert 'some branches' in findings[0].message
+
+
+def test_wire002_produced_never_consumed():
+    findings = _wire_fixture(
+        '''
+        def make():
+            return {'used': 1, 'orphan': 2}
+        ''', '''
+        def use(doc):
+            return doc['used']
+        ''')
+    assert _ids(findings) == ['WIRE002']
+    assert "'orphan'" in findings[0].message
+    assert findings[0].path == 'skypilot_tpu/infer/prod.py'
+
+
+def test_wire003_type_conflict():
+    findings = _wire_fixture(
+        '''
+        def make(alt):
+            if alt:
+                return {'v': 'text'}
+            return {'v': 7}
+        ''', '''
+        def use(doc):
+            return doc['v']
+        ''')
+    assert 'WIRE003' in _ids(findings)
+
+
+def test_wire_incomplete_producer_stays_quiet():
+    # **spread makes the produced set unprovable: no WIRE001 cry-wolf.
+    findings = _wire_fixture(
+        '''
+        def make(extra):
+            return {'k': 1, **extra}
+        ''', '''
+        def use(doc):
+            return doc['whatever']
+        ''')
+    assert 'WIRE001' not in _ids(findings)
+
+
+# ------------------------------------ wire golden schema (real tree)
+
+def _wire_files():
+    files = {}
+    for spec in wire_contract.SURFACES:
+        for ep in list(spec.producers) + list(spec.consumers):
+            if ep.path not in files:
+                with open(os.path.join(REPO, ep.path),
+                          encoding='utf-8') as f:
+                    files[ep.path] = f.read()
+    return files
+
+
+def _contract_by_name():
+    return {sc.name: sc
+            for sc in wire_contract.contract(_wire_files())}
+
+
+def test_wire_golden_schema_snapshot():
+    """The produced key set of every HTTP surface, pinned.  A key
+    appearing or vanishing here is a cross-plane API change: update the
+    snapshot IN THE SAME PR as every consumer."""
+    sc = _contract_by_name()
+    assert sc['/stats'].produced.always == {
+        'adapters', 'awaiting_first_token', 'chunk', 'chunking_slots',
+        'drain_refused', 'draining', 'faults', 'gen_inflight', 'kv',
+        'kv_cache', 'num_slots', 'prefill_chunk', 'prefix', 'qos',
+        'queue_depth', 'resident_prefixes', 'shed_count',
+        'slots_active', 'spec'}
+    assert sc['/healthz'].produced.always == {
+        'drained', 'draining', 'inflight', 'kv', 'loop_alive',
+        'model_ready', 'status'}
+    assert sc['/lb/stats'].produced.always == {
+        'breaker_open_now', 'breaker_opens', 'draining_replicas',
+        'outstanding', 'policy', 'qos', 'ready_replicas',
+        'replica_latency'}
+    assert sc['/controller/state'].produced.always == {
+        'qos', 'replicas', 'service', 'version'}
+    # Stability invariant: NO surface key may be branch-dependent —
+    # a mixed dense/paged fleet must see one schema.
+    for name in ('/stats', '/healthz', '/healthz.kv', '/lb/stats',
+                 '/controller/state', 'engine.stats'):
+        assert sc[name].produced.sometimes == set(), (
+            name, sc[name].produced.sometimes)
+
+
+def test_wire_drift_fix_dense_kv_health_keys():
+    """Regression (dense-fleet drift): kv_health()'s dense branch must
+    emit the SAME key set as the paged branch — prefix_affinity keys
+    its route length off block_size and the LB caches this doc."""
+    sc = _contract_by_name()['/healthz.kv']
+    assert sc.produced.always == {
+        'block_size', 'blocks_free', 'blocks_total', 'layout',
+        'occupancy', 'radix'}
+
+
+def test_wire_drift_fix_dense_stats_flat_aliases():
+    """Regression: stats()'s dense branch must emit the flat alias
+    tier its docstring promises (dashboards and tests KeyError'd on
+    dense replicas before)."""
+    sc = _contract_by_name()['engine.stats']
+    assert {'block_size', 'blocks_total', 'blocks_free',
+            'blocks_allocated', 'blocks_shared', 'blocks_prefix',
+            'shared_refs_saved', 'kv_bytes_per_block',
+            'admission_deferred', 'prefix_block_hits'} \
+        <= sc.produced.always
+
+
+def test_wire_drift_fix_health_kv_always_present():
+    """Regression: /healthz must carry 'kv' unconditionally (None
+    until the engine can answer) — probe consumers key-missed on a
+    starting replica before."""
+    sc = _contract_by_name()['/healthz']
+    assert 'kv' in sc.produced.always
+    assert 'kv' not in sc.produced.sometimes
+
+
+def test_wire_real_tree_no_error_tier_findings():
+    """WIRE001/WIRE003 are the ERROR tier: the real tree must be
+    clean.  (WIRE002 orphans are pinned in skycheck_baseline.txt.)"""
+    findings = wire_contract.check_tree(_wire_files())
+    bad = [f for f in findings if f.pass_id != 'WIRE002']
+    assert not bad, [f.render() for f in bad]
+
+
+# ------------------------------------------------------ block lifecycle
+
+BLOCK_PATH = 'skypilot_tpu/infer/engine.py'
+
+
+def _block(body):
+    text = 'class E:\n' + textwrap.indent(textwrap.dedent(body), '    ')
+    return block_lifecycle.check_file(BLOCK_PATH, text)
+
+
+def test_block_leak_on_jit_exception_path():
+    findings = _block('''
+        def f(self):
+            ids = self._alloc_blocks(4)  # owns-blocks: table
+            self._paged_prefill(ids)
+            self._tables_np[0] = ids
+    ''')
+    assert _ids(findings) == ['BLOCK001']
+    assert 'jitted dispatch raises' in findings[0].message
+
+
+def test_block_unwind_handler_is_clean():
+    findings = _block('''
+        def f(self):
+            ids = self._alloc_blocks(4)  # owns-blocks: table
+            try:
+                self._paged_prefill(ids)
+            except BaseException:
+                for b in ids:
+                    self._deref_block(b)
+                raise
+            self._tables_np[0] = ids
+    ''')
+    assert findings == []
+
+
+def test_block_double_free():
+    findings = _block('''
+        def f(self):
+            ids = self._alloc_blocks(1)  # owns-blocks: free
+            for b in ids:
+                self._deref_block(b)
+            for b in ids:
+                self._deref_block(b)
+    ''')
+    assert _ids(findings) == ['BLOCK002']
+
+
+def test_block_annotation_restricts_sinks():
+    findings = _block('''
+        def f(self):
+            ids = self._alloc_blocks(1)  # owns-blocks: entry
+            self._tables_np[0] = ids
+    ''')
+    assert _ids(findings) == ['BLOCK002']
+    assert 'not permitted' in findings[0].message
+
+
+def test_block_leak_on_return_path():
+    findings = _block('''
+        def f(self, flag):
+            ids = self._alloc_blocks(2)  # owns-blocks: table
+            if flag:
+                return None
+            self._tables_np[0] = ids
+    ''')
+    assert _ids(findings) == ['BLOCK001']
+
+
+def test_block_radix_and_entry_sinks_clean():
+    findings = _block('''
+        def f(self, key):
+            blocks = self._alloc_blocks(3)  # owns-blocks: radix
+            self._radix.insert(key, blocks, own=True)
+
+        def g(self, key):
+            blocks = self._alloc_blocks(3)  # owns-blocks: entry
+            self._prefixes[key] = {'blocks': blocks}
+    ''')
+    assert findings == []
+
+
+def test_block_real_tree_clean():
+    """engine.py/radix.py prove every alloc reaches exactly one sink
+    on all paths (the two PR-9 leak fixes hold)."""
+    for rel in block_lifecycle.OWNED_FILES:
+        with open(os.path.join(REPO, rel), encoding='utf-8') as f:
+            text = f.read()
+        findings = block_lifecycle.check_file(rel, text)
+        assert findings == [], [fd.render() for fd in findings]
+
+
+def test_block_other_files_skipped():
+    assert block_lifecycle.check_file(
+        'skypilot_tpu/serve/controller.py',
+        'x = self._alloc_blocks(1)\n') == []
+
+
+# ------------------------------------------------------- compile budget
+
+COMPILE_FIXTURE = '''
+import jax
+import numpy as np
+
+
+class E:
+    def __init__(self):
+        self._paged_prefill = jax.jit(run, donate_argnums=(0,),
+                                      static_argnums=(2,))
+
+    def good(self, n):
+        b = self._bucket(n)
+        tokens = np.zeros((4, b), np.int32)
+        self._paged_prefill(self.params, tokens, True)
+
+    def annotated(self, groups):
+        for k, g in groups.items():  # compile-shape: k=nb_buckets
+            tokens = np.zeros((4, k), np.int32)
+            self._paged_prefill(self.params, tokens, False)
+'''
+
+COMPILE_BAD = COMPILE_FIXTURE + '''
+    def bad(self, raw_len):
+        tokens = np.zeros((4, raw_len), np.int32)
+        self._paged_prefill(self.params, tokens, False)
+'''
+
+
+def test_compile_fixture_bounded_and_annotated():
+    path = compile_budget.ENGINE_FILE
+    profiles, findings = compile_budget.root_profiles(
+        COMPILE_FIXTURE, path)
+    assert findings == [], [f.render() for f in findings]
+    assert sorted(profiles['_paged_prefill']) == [
+        ('nb_buckets',), ('prefill_buckets',)]
+    bounds = compile_budget.root_bounds(
+        COMPILE_FIXTURE, {'prefill_buckets': 6, 'nb_buckets': 5}, path)
+    assert bounds == {'_paged_prefill': 11}
+
+
+def test_compile001_unbucketed_dim():
+    findings = compile_budget.check_file(compile_budget.ENGINE_FILE,
+                                         COMPILE_BAD)
+    assert _ids(findings) == ['COMPILE001']
+    assert 'raw_len' in findings[0].message
+
+
+def test_compile_other_files_skipped():
+    assert compile_budget.check_file('skypilot_tpu/serve/lb.py',
+                                     COMPILE_BAD) == []
+
+
+def test_compile_nb_ladder_size():
+    # 1,2,4,...  capped at max_blocks
+    assert compile_budget.nb_ladder_size(1) == 1
+    assert compile_budget.nb_ladder_size(8) == 4    # 1,2,4,8
+    assert compile_budget.nb_ladder_size(100) == 8  # 1..64,100-cap
+
+
+_ENGINE_TEXT = None
+
+
+def _engine_text():
+    global _ENGINE_TEXT
+    if _ENGINE_TEXT is None:
+        with open(os.path.join(REPO, compile_budget.ENGINE_FILE),
+                  encoding='utf-8') as f:
+            _ENGINE_TEXT = f.read()
+    return _ENGINE_TEXT
+
+
+def test_compile_real_engine_fully_bucketed():
+    """Every shape/static dimension reaching a jit root resolves to a
+    bucket symbol: the dispatch plane provably cannot compile-storm."""
+    _, findings = compile_budget.root_profiles(_engine_text())
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_compile_static_bounds_regression():
+    """Per-root provable compile counts under a fixed reference model,
+    pinned.  A bound GROWING means a new shape symbol reached that
+    root — deliberate changes update the pin in the same PR; a bound
+    appearing as inf/None means the pass lost resolution."""
+    model = {'prefill_buckets': 6, 'suffix_buckets': 6,
+             'nb_buckets': 8, 'decode_windows': 2, 'static_bool': 2,
+             'prefix_pow2': 11}
+    bounds = compile_budget.root_bounds(_engine_text(), model)
+    assert bounds == {
+        '_paged_prefill': 212,
+        '_paged_decode': 24,
+        '_paged_spec_verify': 8,
+        '_paged_copy_blocks': 1,
+        '_prefill_insert': 12,
+        '_chunk_prefill': 1,
+        '_decode': 3,
+        '_spec_verify': 1,
+        '_prefill_capture': 6,
+        '_prefix_prefill': 66,
+    }
+
+
+def test_compile_runtime_model_shape():
+    class Cfg:
+        prefill_buckets = (64, 128, 256)
+        adaptive_decode_window = True
+        max_cache_len = 1024
+
+    class Eng:
+        cfg = Cfg()
+        _max_blocks = 100
+    model = compile_budget.runtime_model(Eng())
+    assert model['prefill_buckets'] == 3
+    assert model['suffix_buckets'] == 3
+    assert model['nb_buckets'] == 8
+    assert model['decode_windows'] == 2
+    assert model['prefix_pow2'] == 11
+
+
+# ------------------------------------------- driver: json + ratchet
+
+def _violation_tree(tmp_path, n=1):
+    pkg = tmp_path / 'skypilot_tpu' / 'serve'
+    pkg.mkdir(parents=True, exist_ok=True)
+    for i in range(n):
+        (pkg / f'bad{i}.py').write_text(
+            'import time\n'
+            f'def f{i}():\n'
+            '    return time.time()\n')
+    return tmp_path
+
+
+def test_driver_json_output(tmp_path):
+    import json as json_mod
+    _violation_tree(tmp_path)
+    out = tmp_path / 'sky.json'
+    r = _run_skycheck('--root', str(tmp_path), '--json', str(out))
+    assert r.returncode == 1
+    payload = json_mod.loads(out.read_text())
+    assert payload['total_findings'] >= 1
+    assert payload['new'] and '[DET001]' in payload['new'][0]
+    # Every pass reports its own wall time for the tier-1 ledger.
+    for name in ('lock', 'jit', 'layer', 'det', 'block', 'compile',
+                 'wire'):
+        info = payload['passes'][name]
+        assert info['seconds'] >= 0.0
+        assert isinstance(info['findings'], int)
+    assert payload['passes']['det']['findings'] == 1
+    # '-' prints the same payload on stdout.
+    r = _run_skycheck('--root', str(tmp_path), '--json', '-')
+    assert json_mod.loads(r.stdout)['total_findings'] == \
+        payload['total_findings']
+
+
+def test_driver_baseline_ratchet(tmp_path):
+    _violation_tree(tmp_path, n=1)
+    base = tmp_path / 'base.txt'
+    r = _run_skycheck('--root', str(tmp_path),
+                      '--write-baseline', str(base))
+    assert r.returncode == 0, r.stdout + r.stderr
+    # A second violation appears: rewriting must REFUSE to grow...
+    _violation_tree(tmp_path, n=2)
+    r = _run_skycheck('--root', str(tmp_path),
+                      '--write-baseline', str(base))
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert 'refusing to GROW' in r.stderr
+    assert load_baseline(str(base))  # unchanged, still readable
+    # ... unless growth is explicitly owned.
+    r = _run_skycheck('--root', str(tmp_path),
+                      '--write-baseline', str(base), '--allow-grow')
+    assert r.returncode == 0, r.stdout + r.stderr
+    # Shrinking (violation fixed) never needs --allow-grow.
+    (tmp_path / 'skypilot_tpu' / 'serve' / 'bad1.py').unlink()
+    r = _run_skycheck('--root', str(tmp_path),
+                      '--write-baseline', str(base))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_budget_guard_charges_skycheck_passes(tmp_path):
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        'check_tier1_budget_sky',
+        pathlib.Path(__file__).resolve().parent.parent / 'scripts' /
+        'check_tier1_budget.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    log = tmp_path / 't1.log'
+    log.write_text('==== 1 passed in 500.00s ====\n')
+    sky = tmp_path / 'sky.json'
+    sky.write_text(
+        '{"passes": {"wire": {"seconds": 40.0, "findings": 0},'
+        ' "lock": {"seconds": 30.0, "findings": 0}}}')
+    # 500 + 70 = 570 > 870*0.9=783? no -> OK; tighter budget -> FAIL.
+    assert mod.main([str(log), '--skycheck-json', str(sky)]) == 0
+    assert mod.main([str(log), '--skycheck-json', str(sky),
+                     '--budget', '600']) == 1
+    # 500s alone fits a 600s budget minus margin (540): the skycheck
+    # seconds are what pushed it over — the charge is real.
+    assert mod.main([str(log), '--budget', '600']) == 0
+    assert mod.main([str(log), '--skycheck-json',
+                     str(tmp_path / 'missing.json')]) == 2
+
+
+def test_architecture_wire_table_fresh():
+    """docs/architecture.md embeds the generated wire-contract table
+    between <!-- wire-contract:begin/end --> markers; it must match a
+    fresh render, or the docs are lying about the HTTP surfaces."""
+    doc = os.path.join(REPO, 'docs', 'architecture.md')
+    with open(doc, encoding='utf-8') as f:
+        text = f.read()
+    begin, end = '<!-- wire-contract:begin -->', '<!-- wire-contract:end -->'
+    assert begin in text and end in text
+    embedded = text.split(begin, 1)[1].split(end, 1)[0].strip()
+    fresh = wire_contract.render_markdown(_wire_files()).strip()
+    assert embedded == fresh, (
+        'docs/architecture.md wire-contract table is stale; replace the '
+        'block between the markers with:\n' + fresh)
