@@ -235,6 +235,13 @@ def _measure_tiny_sweep(args, fills, steps=4, reps=5):
             print(f'compile budget ok: '
                   f'{ {k: f"{m}/{bd}" for k, (m, bd) in touched.items()} }',
                   flush=True)
+    if sanitizers.shard_sanitizer_enabled():
+        # The sweep's engines keep their root inputs (params, cache)
+        # live the whole run: their committed layouts must still match
+        # the declared registry (no-op off-mesh).
+        for eng in (dense, paged):
+            report = sanitizers.check_shard_layout(eng)
+            print(f'shard layout ok: {report}', flush=True)
     return {'batch': b, 'decode_steps': steps,
             'model': 'tiny 2-layer llama (float32)', 'rows': rows}
 

@@ -158,6 +158,13 @@ def episode(eng: InferenceEngine, seed: int, n: int) -> list:
                 f'BLOCK LEAK: {held} blocks held at drain but only '
                 f'{radix_held} radix + {prefix_held} prefix expected; '
                 f'refs={eng._block_refs.tolist()}')
+    if sanitizers.shard_sanitizer_enabled():
+        # Fault storms must not re-commit root inputs off their
+        # declared layouts (no-op for mesh-less engines).
+        try:
+            sanitizers.check_shard_layout(eng)
+        except sanitizers.ShardLayoutError as e:
+            bad.append(f'SHARD DRIFT: {e}')
     print(f'  seed={seed}: {reasons} wall={time.time() - t0:.1f}s '
           f'fired={plan.stats()["fired"]} '
           f'counters={eng.fault_stats} '

@@ -27,7 +27,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | 
 # paged/dense jit roots across the nb ladder and asserts the measured
 # compile counts stay inside the provable static bounds.
 BENCH_T0=$(date +%s.%N)
-timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 \
+timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 SKYTPU_SHARD_SANITIZER=1 \
     python scripts/bench_decode_micro.py --paged --max-cache-len 256 \
     --fill-sweep 40 200 --out /tmp/_bench_paged.json || rc=1
 BENCH_SECS=$(echo "$(date +%s.%N) $BENCH_T0" | awk '{print $1-$2}')
@@ -47,7 +47,7 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
 # purpose — it must not eat durations budget from the suite.  The
 # compile sanitizer rides along: fault storms must not smuggle
 # unbucketed shapes into the jit roots.
-timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 \
+timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 SKYTPU_SHARD_SANITIZER=1 \
     python scripts/chaos_smoke.py || rc=1
 # Replica-plane chaos sweep (fixed seeds): seeded mid-decode replica
 # kills behind the LB; every greedy request must complete
@@ -55,7 +55,7 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 \
 # finish its in-flight stream with zero 5xx at the LB.  Runs under
 # prefix_affinity routing: byte-identity + failover must hold under
 # the affinity policy too (least_load is covered by the pytest suite).
-timeout -k 10 300 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 \
+timeout -k 10 300 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 SKYTPU_SHARD_SANITIZER=1 \
     python scripts/chaos_smoke.py --multi-replica 3 --seeds 0 1 \
     --requests 8 --policy prefix_affinity || rc=1
 exit "$rc"

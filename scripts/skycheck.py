@@ -2,8 +2,9 @@
 """skycheck: the repo's static-analysis suite (see skypilot_tpu/analysis).
 
 Runs the lock-discipline, jit-boundary, layering, determinism,
-wire-contract, block-lifecycle and compile-budget passes over the tree
-and compares findings against a checked-in baseline:
+wire-contract, block-lifecycle, compile-budget and sharding-contract
+passes over the tree and compares findings against a checked-in
+baseline:
 
     python scripts/skycheck.py --baseline skycheck_baseline.txt
 
@@ -18,12 +19,16 @@ The baseline is a RATCHET: rewriting it with MORE pinned findings than
 it already holds is refused (exit 3) unless ``--allow-grow`` is given —
 shrinking is always fine, growth is a decision someone must own.
 
-``--passes lock,jit,...`` restricts which passes run; ``--all`` prints
-baselined findings too.  ``--json FILE`` (or ``--json -`` for stdout)
-emits machine-readable results including PER-PASS wall time, which
-run_tier1.sh feeds to check_tier1_budget.py so each pass is charged
-for its own seconds.  Runs in well under the tier-1 budget lines it is
-charged under.
+``--passes lock,jit,...`` restricts which passes run (unknown names
+are rejected with the available list); ``--all`` prints baselined
+findings too.  ``--changed`` restricts the per-file passes to files
+git reports as modified (fast pre-commit loop) — tree passes still
+read the whole tree because their contracts span files, and tier-1
+always runs the full sweep.  ``--json FILE`` (or ``--json -`` for
+stdout) emits machine-readable results including PER-PASS wall time,
+which run_tier1.sh feeds to check_tier1_budget.py so each pass is
+charged for its own seconds.  Runs in well under the tier-1 budget
+lines it is charged under.
 """
 import argparse
 import json
@@ -41,6 +46,7 @@ from skypilot_tpu.analysis import determinism  # noqa: E402
 from skypilot_tpu.analysis import jit_boundary  # noqa: E402
 from skypilot_tpu.analysis import layering  # noqa: E402
 from skypilot_tpu.analysis import lock_discipline  # noqa: E402
+from skypilot_tpu.analysis import shard_contract  # noqa: E402
 from skypilot_tpu.analysis import wire_contract  # noqa: E402
 from skypilot_tpu.analysis.findings import load_baseline  # noqa: E402
 from skypilot_tpu.analysis.findings import new_findings  # noqa: E402
@@ -57,9 +63,11 @@ PASSES = {
 }
 
 # Whole-tree passes: check_tree({rel_path: text}) -> [Finding].  They
-# see every file at once (the wire contract spans planes).
+# see every file at once (the wire contract spans planes; the shard
+# contract reads the mesh vocabulary out of parallel/mesh.py).
 TREE_PASSES = {
     'wire': wire_contract.check_tree,
+    'shard': shard_contract.check_tree,
 }
 
 ALL_PASSES = tuple(PASSES) + tuple(TREE_PASSES)
@@ -68,8 +76,34 @@ ALL_PASSES = tuple(PASSES) + tuple(TREE_PASSES)
 DEFAULT_SUBDIRS = ('skypilot_tpu', 'scripts', 'tests')
 
 
-def run(root, subdirs, pass_names):
-    """-> (findings, files_checked, {pass: seconds})."""
+def changed_files(root):
+    """Repo-relative paths git reports as modified (vs HEAD) or
+    untracked — the --changed pre-commit scope.  Returns None (full
+    sweep) when git is unavailable or this is not a work tree."""
+    import subprocess
+    out = set()
+    for args in (['git', '-C', root, 'diff', '--name-only', 'HEAD'],
+                 ['git', '-C', root, 'ls-files', '--others',
+                  '--exclude-standard']):
+        try:
+            proc = subprocess.run(args, capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
+
+
+def run(root, subdirs, pass_names, only=None):
+    """-> (findings, files_checked, {pass: seconds}).
+
+    only: optional set of rel paths restricting the PER-FILE passes
+    (--changed).  Tree passes always see the whole walked tree — their
+    contracts span files, so a partial tree would under-report.
+    """
     findings = []
     checked = 0
     timings = {name: 0.0 for name in pass_names}
@@ -84,9 +118,11 @@ def run(root, subdirs, pass_names):
         except OSError as e:
             print(f'skycheck: cannot read {rel}: {e}', file=sys.stderr)
             continue
-        checked += 1
         if tree_passes:
             files[rel] = text
+        if only is not None and rel not in only:
+            continue
+        checked += 1
         for name in file_passes:
             t0 = time.monotonic()
             findings.extend(PASSES[name](rel, text))
@@ -145,6 +181,11 @@ def main(argv=None):
                     help=f'comma list of passes ({",".join(ALL_PASSES)})')
     ap.add_argument('--all', action='store_true',
                     help='print baselined findings too, not just new')
+    ap.add_argument('--changed', action='store_true',
+                    help='per-file passes only on git-modified files '
+                         '(fast pre-commit loop; tree passes still '
+                         'read the whole tree, and tier-1 always runs '
+                         'the full sweep)')
     ap.add_argument('--json', default=None, metavar='FILE',
                     help='write machine-readable results (per-pass '
                          "seconds, counts, new findings); '-' = stdout")
@@ -154,11 +195,18 @@ def main(argv=None):
     unknown = [p for p in pass_names if p not in PASSES
                and p not in TREE_PASSES]
     if unknown:
-        ap.error(f'unknown pass(es): {", ".join(unknown)}')
+        ap.error(f'unknown pass(es): {", ".join(unknown)} '
+                 f'(available: {", ".join(ALL_PASSES)})')
+    only = None
+    if args.changed:
+        only = changed_files(args.root)
+        if only is None:
+            print('skycheck: --changed needs a git work tree; running '
+                  'the full sweep', file=sys.stderr)
 
     t0 = time.monotonic()
     findings, checked, timings = run(args.root, DEFAULT_SUBDIRS,
-                                     pass_names)
+                                     pass_names, only=only)
     findings.sort()
     elapsed = time.monotonic() - t0
 

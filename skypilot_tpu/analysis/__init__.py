@@ -15,10 +15,27 @@ Static passes (driven by ``scripts/skycheck.py``):
 - ``determinism`` (DET001/DET002): bare wall clocks and unseeded RNG
   in the serve plane and the fault/chaos tooling, outside the
   injected clock/rng seams.
+- ``wire_contract`` (WIRE001-003, whole-tree): the JSON wire contract
+  between planes — every key a registered consumer reads off an HTTP
+  surface is produced unconditionally; orphans and type conflicts.
+- ``block_lifecycle`` (BLOCK001/BLOCK002): path-sensitive proofs that
+  every allocated block-id list reaches exactly one release sink on
+  every path, including jit exception edges.
+- ``compile_budget`` (COMPILE001): every shape/static dimension
+  reaching a ``jax.jit`` root resolves to a finite bucket symbol, with
+  provable per-root compile-count bounds.
+- ``shard_contract`` (SHARD001-004, whole-tree): the sharding contract
+  of the mesh-using modules — axis names against the
+  ``parallel/mesh.py`` vocabulary, registry-declared buffers must be
+  sharded before reaching jit roots, host transfers on sharded values,
+  and divisibility guards for sharded dimensions.
 
 Runtime sanitizers (``sanitizers``; env-gated, zero overhead off):
-a lock-order checker over the engine/LB/breaker locks and a
-block-leak checker asserting paged-pool refcount conservation.
+a lock-order checker over the engine/LB/breaker locks, a block-leak
+checker asserting paged-pool refcount conservation, a compile-budget
+checker pinning each jit root's XLA cache size to its proven bound,
+and a shard-layout checker asserting a mesh-bearing engine's committed
+params/cache layouts match the declared registry.
 
 Findings print as ``path:line: [PASS-ID] message``; a checked-in
 ``skycheck_baseline.txt`` pins pre-existing findings so CI fails only

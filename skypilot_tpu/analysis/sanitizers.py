@@ -33,7 +33,19 @@ All are env-gated and cost nothing when off:
   ``CompileBudgetError`` naming the offending root.  Checked at the
   same quiesce points as block conservation.
 
-``SKYTPU_SANITIZERS=1`` enables all three.  Lock *names* are roles shared
+- ``SKYTPU_SHARD_SANITIZER=1`` — ``check_shard_layout(engine)``
+  asserts, at the same quiesce points, that the committed layouts of
+  the jit roots' live inputs match the declared sharding registry
+  (``analysis.shard_contract.REGISTRY``) for the engine's active mesh:
+  every KV-cache leaf carries exactly the declared
+  ``named_sharding(mesh, None, 'kv_heads', None, None)`` (mesh-fitted,
+  like placement itself), every param leaf is committed to THIS mesh,
+  and under ``tensor>1`` the param tree is not silently
+  fully-replicated — the HBM blow-up the static SHARD002 rule proves
+  absent.  Violations raise ``ShardLayoutError``; a mesh-less engine
+  is a no-op.
+
+``SKYTPU_SANITIZERS=1`` enables all four.  Lock *names* are roles shared
 across instances (``'infer.engine._lock'``), so an order inversion
 between two engine instances is still an inversion — the discipline is
 per role, matching how the code is written.
@@ -62,6 +74,11 @@ def compile_sanitizer_enabled() -> bool:
             _env_on('SKYTPU_SANITIZERS'))
 
 
+def shard_sanitizer_enabled() -> bool:
+    return (_env_on('SKYTPU_SHARD_SANITIZER') or
+            _env_on('SKYTPU_SANITIZERS'))
+
+
 class LockOrderError(RuntimeError):
     """A lock acquisition violates the global acquisition order."""
 
@@ -72,6 +89,11 @@ class BlockLeakError(RuntimeError):
 
 class CompileBudgetError(RuntimeError):
     """A jit root compiled more variants than the provable bound."""
+
+
+class ShardLayoutError(RuntimeError):
+    """A live buffer's committed sharding drifted from the declared
+    registry (or the param tree replicated under tensor>1)."""
 
 
 # --------------------------------------------------------------- lock order
@@ -330,3 +352,90 @@ def maybe_check_compile_budget(engine: Any) -> None:
     """Quiesce hook twin of maybe_check_block_conservation."""
     if compile_sanitizer_enabled():
         check_compile_budget(engine)
+
+
+# ------------------------------------------------------------- shard layout
+
+def _shard_shape(sharding: Any, shape: Any) -> Any:
+    return tuple(sharding.shard_shape(tuple(shape)))
+
+
+def check_shard_layout(engine: Any) -> Dict[str, int]:
+    """Assert the engine's live jit-root inputs hold their DECLARED
+    layouts on the active mesh.
+
+    The persistent roots' committed inputs are the param tree and the
+    KV cache (everything else is per-dispatch); their ``.sharding``
+    must match ``analysis.shard_contract.REGISTRY``'s declared specs,
+    resolved through the same logical-rule table and mesh-fitting as
+    placement itself:
+
+    - every cache leaf: exactly ``named_sharding(mesh, None,
+      'kv_heads', None, None)`` fitted to the leaf shape (indivisible
+      dims replicate, engine._fit_sharding);
+    - every param leaf: committed to THIS mesh (a leaf resharded onto
+      a stray mesh, or left on one device, is drift);
+    - under ``tensor>1``: at least one param leaf actually sharded —
+      a fully-replicated tree is the silent HBM blow-up.
+
+    Returns an accounting dict ({} when the engine has no mesh);
+    raises ShardLayoutError on drift.
+    """
+    mesh = getattr(engine, '_mesh', None)
+    if mesh is None:
+        return {}
+    import jax
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    errors: List[str] = []
+    declared = mesh_lib.named_sharding(mesh, None, 'kv_heads', None,
+                                       None)
+    cache_leaves = 0
+    for li, (k, v) in enumerate(getattr(engine, 'cache', ()) or ()):
+        for tag, leaf in (('k', k), ('v', v)):
+            cache_leaves += 1
+            expect = engine._fit_sharding(leaf.shape, declared)
+            got = getattr(leaf, 'sharding', None)
+            if got is None or \
+                    _shard_shape(got, leaf.shape) != \
+                    _shard_shape(expect, leaf.shape):
+                errors.append(
+                    f'cache layer {li} {tag}: committed sharding '
+                    f'{got} != declared {expect.spec} '
+                    f'(registry: P(None, kv_heads, None, None))')
+    mesh_devices = set(mesh.devices.flat)
+    tensor = dict(mesh.shape).get('tensor', 1)
+    param_leaves = jax.tree.leaves(getattr(engine, 'params', {}))
+    sharded = 0
+    for leaf in param_leaves:
+        sh = getattr(leaf, 'sharding', None)
+        if sh is None:
+            continue
+        leaf_devices = set(getattr(sh, 'device_set', ()))
+        if leaf_devices and leaf_devices != mesh_devices:
+            errors.append(
+                f'param leaf committed to {len(leaf_devices)} '
+                f'device(s) outside the active mesh '
+                f'({len(mesh_devices)} devices)')
+            continue
+        if _shard_shape(sh, leaf.shape) != tuple(leaf.shape):
+            sharded += 1
+    if tensor > 1 and param_leaves and sharded == 0:
+        errors.append(
+            f'param tree fully replicated across a tensor={tensor} '
+            'mesh: every leaf holds the whole weight (HBM blow-up); '
+            'params must be born sharded through the logical rules')
+    if errors:
+        raise ShardLayoutError(
+            'shard layout drifted from the declared registry:\n  '
+            + '\n  '.join(errors[:8]))
+    return {'cache_leaves': cache_leaves,
+            'param_leaves': len(param_leaves),
+            'param_leaves_sharded': sharded,
+            'tensor_degree': tensor}
+
+
+def maybe_check_shard_layout(engine: Any) -> None:
+    """Quiesce hook twin for the shard-layout sanitizer."""
+    if shard_sanitizer_enabled():
+        check_shard_layout(engine)

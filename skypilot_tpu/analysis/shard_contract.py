@@ -1,0 +1,675 @@
+"""Sharding & mesh contracts (skycheck pass ``shard``): prove the TP
+plane's layouts before scaling it.
+
+The mesh vocabulary lives in ONE place (``parallel/mesh.py``:
+``MESH_AXES`` + ``_BASE_RULES``), but PartitionSpecs, logical-axis
+tuples and ``axis_name=`` strings are scattered across the engine, the
+model, the trainer and the collective kernels — an axis rename (or a
+typo'd logical axis) silently resolves to *replicated*, which on a
+``tensor>1`` mesh is an HBM blow-up, not an error.  This pass parses
+the vocabulary straight out of ``parallel/mesh.py`` (pure ast — no jax
+import) and checks every sharding-bearing construct in the mesh-using
+modules against it, plus a declarative registry of the big buffers and
+the divisibility proofs their sharded dims need:
+
+- **SHARD001** — a ``PartitionSpec`` / ``axis_name=`` / logical-axis
+  string names an axis the constructed mesh (``MESH_AXES``) or the
+  logical rule table does not define.  First-match rule resolution
+  makes unknown names *silently replicate*; this makes them loud.
+- **SHARD002** — a registry-declared large buffer (KV cache, params)
+  reaches a ``jax.jit`` root with **no** sharding application anywhere
+  on its def-chain while the module constructs a mesh: the
+  fully-replicated HBM blow-up that blocks the sharded KV pool.
+- **SHARD003** — a host transfer (``np.asarray`` / ``.item()`` /
+  ``jax.device_get`` / implicit bool) on a value whose def-chain
+  carries an explicit ``NamedSharding`` — reusing the JIT001 sync
+  catalogue: gathering a sharded array to host is a cross-device
+  all-gather hidden inside a cast.
+- **SHARD004** — a registry-declared sharded dim whose symbolic size
+  (``num_kv_heads``-style, the same symbols the compile pass's bucket
+  lattice resolves) has no divisibility guard (``sym % axis_size``)
+  against the mesh axis it shards over, and no ``# shard-spec:``
+  assertion standing in for one.
+
+Escape hatches (plain line comments, reviewed like code):
+
+- ``# shard-ok: <reason>`` — suppress any SHARD finding on that line.
+- ``# shard-spec: SYM % AXIS`` — asserts SYM is divisible by the size
+  of mesh axis AXIS (satisfies SHARD004 where the guard lives behind
+  an abstraction the dataflow cannot see through).  The runtime shard
+  sanitizer (``SKYTPU_SHARD_SANITIZER``, analysis/sanitizers.py) will
+  catch a lie the same way the compile sanitizer does.
+
+The registry (``REGISTRY``) is the certified substrate ROADMAP item 2
+shards the paged KV pool against: per module, the mesh attribute, the
+large buffers with their declared logical specs, and the divisibility
+contracts.  ``declared_specs()`` exports it for the docs table and the
+tier-1 snapshot test; ``render_markdown()`` generates the
+sharding-contract table in docs/architecture.md.
+"""
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import compile_budget, dataflow
+from skypilot_tpu.analysis.findings import Finding
+
+PASS_UNKNOWN_AXIS = 'SHARD001'
+PASS_REPLICATED_BUFFER = 'SHARD002'
+PASS_HOST_TRANSFER = 'SHARD003'
+PASS_INDIVISIBLE_DIM = 'SHARD004'
+
+# The single source of truth for the mesh vocabulary.
+MESH_FILE = 'skypilot_tpu/parallel/mesh.py'
+
+# Mesh-using modules the pass sweeps (plus any file in REGISTRY).
+SHARD_FILES = frozenset({
+    'skypilot_tpu/infer/engine.py',
+    'skypilot_tpu/models/llama.py',
+    'skypilot_tpu/train/trainer.py',
+    'skypilot_tpu/parallel/mesh.py',
+    'skypilot_tpu/parallel/pipeline.py',
+    'skypilot_tpu/ops/flash_attention.py',
+    'skypilot_tpu/ops/ring_attention.py',
+})
+
+# Fallback vocabulary for unit fixtures that do not ship a mesh.py.
+DEFAULT_MESH_AXES = ('stage', 'data', 'fsdp', 'seq', 'tensor')
+DEFAULT_LOGICAL_AXES = frozenset({
+    'batch', 'activation_batch', 'activation_seq', 'activation_embed',
+    'activation_heads', 'activation_kv', 'activation_mlp', 'embed',
+    'mlp', 'heads', 'kv_heads', 'qkv_embed', 'vocab', 'vocab_table',
+    'embed_table', 'expert', 'norm',
+})
+
+_OK_RE = re.compile(r'#\s*shard-ok\b')
+_SPEC_RE = re.compile(r'#\s*shard-spec:\s*(\w+)\s*%\s*(\w+)')
+
+# Parameter names whose tuple-of-string arguments are LOGICAL axes.
+_AXES_PARAMS = frozenset({'axes', 'kernel_axes', 'logical_axes'})
+
+# Call forms whose string arguments are MESH axes (positional index of
+# the axis-name argument).
+_MESH_AXIS_CALLS = {
+    'axis_size': 0, 'axis_index': 0, 'ppermute': 1, 'pshuffle': 1,
+}
+
+# Fresh large allocations (the unsharded-def classifier for SHARD002).
+_ALLOC_CALLS = frozenset({
+    'init_cache', 'init_paged_cache', 'zeros', 'ones', 'full', 'empty',
+})
+
+# Host-transfer catalogue — the JIT001 sync set (jit_boundary.py).
+_HOST_CALLS = frozenset({
+    'np.asarray', 'np.array', 'numpy.asarray', 'numpy.array',
+    'jax.device_get',
+})
+_HOST_METHODS = frozenset({'item', 'tolist', 'block_until_ready'})
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferSpec:
+    """One registry-declared large buffer.
+
+    spec: declared logical axes per dim (None = replicated dim), or
+    None meaning "per-leaf via logical_axis_rules" (a param pytree).
+    divisibility: (symbol, mesh_axis) contracts — the symbol's size
+    must be guarded divisible by the axis size wherever the buffer is
+    sharded over it.
+    """
+    name: str
+    spec: Optional[Tuple[Optional[str], ...]]
+    divisibility: Tuple[Tuple[str, str], ...] = ()
+
+    def spec_str(self) -> str:
+        if self.spec is None:
+            return 'logical_axis_rules (per-leaf, mesh-fitted)'
+        return 'P(' + ', '.join('None' if a is None else a
+                                for a in self.spec) + ')'
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuleContract:
+    """Declared sharding contract of one mesh-using module."""
+    mesh_attr: str
+    buffers: Tuple[BufferSpec, ...]
+
+
+# The declarative registry: the certified substrate the TP plane (and
+# ROADMAP item 2's sharded KV pool) is checked against.  cache is
+# [B,Hkv,S,D] dense / [N,Hkv,bs,D] paged — kv-heads on dim 1 either
+# way, sharded like the weights' 'kv_heads' logical axis; params are
+# born sharded per-leaf through the logical rule table and fitted to
+# the mesh (indivisible dims replicate, see engine._fit_sharding).
+REGISTRY: Dict[str, ModuleContract] = {
+    'skypilot_tpu/infer/engine.py': ModuleContract(
+        mesh_attr='_mesh',
+        buffers=(
+            BufferSpec('cache', (None, 'kv_heads', None, None),
+                       divisibility=(('num_kv_heads', 'tensor'),)),
+            BufferSpec('params', None),
+        ),
+    ),
+}
+
+
+def declared_specs() -> Dict[str, Dict[str, str]]:
+    """Registry export for the docs table and the tier-1 snapshot
+    test: {module: {buffer: declared spec string}}."""
+    return {
+        path: {b.name: b.spec_str() for b in mc.buffers}
+        for path, mc in sorted(REGISTRY.items())
+    }
+
+
+# --------------------------------------------------------- vocabulary
+
+def mesh_vocabulary(mesh_text: Optional[str]):
+    """Parse (MESH_AXES, logical-axis names, rule entries) out of
+    parallel/mesh.py.  rule entries are (logical, target, line) with
+    target a mesh axis string, tuple of them, or None."""
+    if mesh_text is None:
+        return DEFAULT_MESH_AXES, set(DEFAULT_LOGICAL_AXES), []
+    tree = ast.parse(mesh_text)
+    axes: Tuple[str, ...] = ()
+    rules: List[Tuple[str, object, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign))):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        value = node.value
+        if value is None:
+            continue
+        if 'MESH_AXES' in names and isinstance(value, ast.Tuple):
+            axes = tuple(e.value for e in value.elts
+                         if isinstance(e, ast.Constant) and
+                         isinstance(e.value, str))
+        if '_BASE_RULES' in names and isinstance(value, ast.List):
+            for elt in value.elts:
+                if not (isinstance(elt, ast.Tuple) and
+                        len(elt.elts) == 2 and
+                        isinstance(elt.elts[0], ast.Constant)):
+                    continue
+                tgt = elt.elts[1]
+                if isinstance(tgt, ast.Constant):
+                    target = tgt.value          # str or None
+                elif isinstance(tgt, ast.Tuple):
+                    target = tuple(e.value for e in tgt.elts
+                                   if isinstance(e, ast.Constant))
+                else:
+                    continue
+                rules.append((elt.elts[0].value, target, elt.lineno))
+    if not axes:
+        axes = DEFAULT_MESH_AXES
+    logical = {name for name, _, _ in rules} or set(DEFAULT_LOGICAL_AXES)
+    return axes, logical, rules
+
+
+# --------------------------------------------------------- ast helpers
+
+def _last_seg(name: Optional[str]) -> Optional[str]:
+    return None if name is None else name.rsplit('.', 1)[-1]
+
+
+def _str_elems(node: ast.AST) -> List[Tuple[str, int]]:
+    """String literals directly inside a constant/tuple/list expression
+    (ints, None and unresolvable names are skipped)."""
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append((node.value, node.lineno))
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e.lineno))
+            elif isinstance(e, (ast.Tuple, ast.List)):
+                out.extend(_str_elems(e))
+    return out
+
+
+def _partitionspec_aliases(tree: ast.AST) -> Set[str]:
+    """Names bound to jax.sharding.PartitionSpec anywhere in the module
+    (``P = jax.sharding.PartitionSpec``, import aliases, function-local
+    ``p = ...`` included — collisions are unlikely and conservative)."""
+    aliases = {'PartitionSpec'}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                _last_seg(dataflow.dotted_name(node.value)) == \
+                'PartitionSpec':
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    aliases.add(tgt.id)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == 'PartitionSpec' and a.asname:
+                    aliases.add(a.asname)
+    return aliases
+
+
+def _is_sharding_apply(expr: ast.AST) -> bool:
+    """True when the expression applies an explicit sharding anywhere
+    inside it: ``jax.device_put(x, sharding)`` (2-arg form),
+    ``with_sharding_constraint``, ``named_sharding(...)``, or a
+    ``jax.jit(..., out_shardings=...)``."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        last = _last_seg(dataflow.dotted_name(node.func))
+        if last == 'device_put' and len(node.args) >= 2 and not (
+                isinstance(node.args[1], ast.Constant) and
+                node.args[1].value is None):
+            return True
+        if last in ('with_sharding_constraint', 'named_sharding'):
+            return True
+        if last == 'jit' and any(kw.arg in ('out_shardings',
+                                            'in_shardings')
+                                 for kw in node.keywords):
+            return True
+    return False
+
+
+def _sharding_methods(index: dataflow.ModuleIndex) -> Set[str]:
+    """Simple names of module functions whose body applies a sharding
+    (one interprocedural level: ``self.params = self._shard(...)``)."""
+    out: Set[str] = set()
+    for qual, info in index.functions.items():
+        if _is_sharding_apply(info.node):
+            out.add(qual.rsplit('.', 1)[-1])
+    return out
+
+
+def _scopes(index: dataflow.ModuleIndex) -> List[ast.AST]:
+    """Every function node plus the module for top-level statements."""
+    return [info.node for info in index.functions.values()]
+
+
+def _sharded_locals(fn_node: ast.AST, methods: Set[str]) -> Set[str]:
+    """Local names with at least one sharding-applying definition."""
+    out: Set[str] = set()
+    for name, exprs in dataflow.local_defs(fn_node).items():
+        for expr in exprs:
+            if _is_sharding_apply(expr):
+                out.add(name)
+                break
+            call = expr
+            if isinstance(call, ast.Call):
+                last = _last_seg(dataflow.dotted_name(call.func))
+                if last in methods:
+                    out.add(name)
+                    break
+    return out
+
+
+# ------------------------------------------------------------ checks
+
+def _check_module(rel: str, text: str, mesh_axes: Sequence[str],
+                  logical_axes: Set[str],
+                  contract: Optional[ModuleContract]) -> List[Finding]:
+    try:
+        index = dataflow.ModuleIndex(rel, text)
+    except SyntaxError:
+        return []
+    lines = index.lines
+    findings: List[Finding] = []
+
+    def ok(lineno: int) -> bool:
+        return 0 < lineno <= len(lines) and \
+            bool(_OK_RE.search(lines[lineno - 1]))
+
+    def mesh_check(ax: str, lineno: int, ctx: str) -> None:
+        if ax not in mesh_axes and not ok(lineno):
+            findings.append(Finding(
+                rel, lineno, PASS_UNKNOWN_AXIS,
+                f"{ctx} names mesh axis '{ax}' which no constructed "
+                f'Mesh defines (MESH_AXES={tuple(mesh_axes)}); it '
+                'would silently resolve to replicated'))
+
+    def logical_check(ax: str, lineno: int, ctx: str) -> None:
+        if ax not in logical_axes and not ok(lineno):
+            findings.append(Finding(
+                rel, lineno, PASS_UNKNOWN_AXIS,
+                f"{ctx} names logical axis '{ax}' with no rule in "
+                "parallel/mesh.py logical_axis_rules; first-match "
+                'resolution silently replicates it'))
+
+    ps_aliases = _partitionspec_aliases(index.tree)
+
+    for node in ast.walk(index.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # str default of a parameter named axis_name is a mesh axis
+            # (the collective kernels' calling convention).
+            args = node.args
+            pos = args.posonlyargs + args.args
+            for arg, dflt in zip(pos[len(pos) - len(args.defaults):],
+                                 args.defaults):
+                if arg.arg == 'axis_name' and \
+                        isinstance(dflt, ast.Constant) and \
+                        isinstance(dflt.value, str):
+                    mesh_check(dflt.value, dflt.lineno,
+                               f"default of '{node.name}(axis_name=)'")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dataflow.dotted_name(node.func)
+        last = _last_seg(callee)
+        if last in ps_aliases:
+            for arg in node.args:
+                for ax, ln in _str_elems(arg):
+                    mesh_check(ax, ln, 'PartitionSpec')
+        elif last == 'named_sharding':
+            for arg in node.args[1:]:
+                for ax, ln in _str_elems(arg):
+                    logical_check(ax, ln, 'named_sharding')
+        elif last in ('with_logical_constraint',
+                      'with_logical_partitioning'):
+            if len(node.args) >= 2:
+                for ax, ln in _str_elems(node.args[1]):
+                    logical_check(ax, ln, last)
+        elif last in _MESH_AXIS_CALLS:
+            idx = _MESH_AXIS_CALLS[last]
+            if len(node.args) > idx and \
+                    isinstance(node.args[idx], ast.Constant) and \
+                    isinstance(node.args[idx].value, str):
+                mesh_check(node.args[idx].value, node.args[idx].lineno,
+                           f'{last}()')
+        for kw in node.keywords:
+            if kw.arg == 'axis_name' and \
+                    isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                mesh_check(kw.value.value, kw.value.lineno,
+                           f'{last or "call"}(axis_name=)')
+            elif kw.arg in _AXES_PARAMS:
+                for ax, ln in _str_elems(kw.value):
+                    logical_check(ax, ln, f'{last or "call"}'
+                                          f'({kw.arg}=)')
+        # Positional tuple-of-str args binding to a module-local
+        # function's parameter named axes/kernel_axes/logical_axes.
+        if last in {q.rsplit('.', 1)[-1] for q in index.functions}:
+            info = index.find(last)
+            if info is not None:
+                params = info.params
+                if params and params[0] == 'self':
+                    params = params[1:]
+                for i, arg in enumerate(node.args):
+                    if i < len(params) and params[i] in _AXES_PARAMS:
+                        for ax, ln in _str_elems(arg):
+                            logical_check(
+                                ax, ln,
+                                f'{last}({params[i]}=)')
+
+    if contract is not None:
+        findings.extend(_check_contract(rel, text, index, contract,
+                                        mesh_axes, ok))
+    findings.extend(_check_host_transfers(rel, index, contract, ok))
+    return findings
+
+
+def _attr_defs(index: dataflow.ModuleIndex,
+               attr: str) -> List[Tuple[ast.expr, int, ast.AST]]:
+    """Every ``self.<attr> = <expr>`` in the module: (expr, line,
+    enclosing function node)."""
+    out = []
+    for info in index.functions.values():
+        for node in dataflow._walk_no_nested(info.node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == 'self' and tgt.attr == attr:
+                        out.append((node.value, node.lineno, info.node))
+    return out
+
+
+def _check_contract(rel: str, text: str, index: dataflow.ModuleIndex,
+                    contract: ModuleContract,
+                    mesh_axes: Sequence[str], ok) -> List[Finding]:
+    findings: List[Finding] = []
+    has_mesh = bool(re.search(
+        rf'self\.{re.escape(contract.mesh_attr)}\b', text))
+    if not has_mesh:
+        return findings
+    methods = _sharding_methods(index)
+    roots = {r.name for r in compile_budget.discover_roots(text)}
+    spec_annots = {(m.group(1), m.group(2))
+                   for m in _SPEC_RE.finditer(text)}
+
+    # Which buffers are passed to a jit root call (self._root(...)).
+    root_args: Set[str] = set()
+    for node in ast.walk(index.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == 'self' and node.func.attr in roots:
+            for arg in node.args:
+                name = dataflow.dotted_name(arg)
+                if name and name.startswith('self.'):
+                    root_args.add(name.split('.')[1])
+                elif isinstance(arg, ast.Name):
+                    root_args.add(arg.id)
+
+    # SHARD002: a registry buffer with defs but no sharding-applying
+    # def anywhere, reaching a jit root, in a mesh-bearing module.
+    for buf in contract.buffers:
+        defs = _attr_defs(index, buf.name)
+        if not defs or buf.name not in root_args:
+            continue
+        sharded = False
+        for expr, _, fn_node in defs:
+            if _is_sharding_apply(expr):
+                sharded = True
+                break
+            if isinstance(expr, ast.Call):
+                last = _last_seg(dataflow.dotted_name(expr.func))
+                if last in methods:
+                    sharded = True
+                    break
+            if isinstance(expr, ast.Name) and \
+                    expr.id in _sharded_locals(fn_node, methods):
+                sharded = True
+                break
+        if not sharded and not any(ok(line) for _, line, _ in defs):
+            findings.append(Finding(
+                rel, defs[0][1], PASS_REPLICATED_BUFFER,
+                f"large buffer 'self.{buf.name}' reaches jit root(s) "
+                f'with no sharding application on any def while this '
+                f'module constructs a mesh (declared spec '
+                f'{buf.spec_str()}): fully replicated under tensor>1 '
+                'is an HBM blow-up'))
+
+    # SHARD004: declared divisibility contracts need a `sym % axis`
+    # guard (or a # shard-spec: assertion).  Only meaningful when the
+    # module actually applies shardings.
+    apply_lines = [node.lineno for node in ast.walk(index.tree)
+                   if isinstance(node, ast.Call) and
+                   _is_sharding_apply(node)]
+    if not apply_lines:
+        return findings
+    axis_vars = _axis_size_vars(index.tree, mesh_axes)
+    guards = _divisibility_guards(index.tree, axis_vars)
+    for buf in contract.buffers:
+        for sym, axis in buf.divisibility:
+            if (sym, axis) in spec_annots or (sym, axis) in guards:
+                continue
+            line = min(apply_lines)
+            if ok(line):
+                continue
+            findings.append(Finding(
+                rel, line, PASS_INDIVISIBLE_DIM,
+                f"buffer '{buf.name}' shards symbolic dim '{sym}' over "
+                f"mesh axis '{axis}' with no divisibility guard "
+                f"('{sym} % <{axis} size>' check) and no "
+                f"'# shard-spec: {sym} % {axis}' assertion: an "
+                'indivisible dim silently replicates (or mis-shards) '
+                'at placement'))
+    return findings
+
+
+def _axis_size_vars(tree: ast.AST,
+                    mesh_axes: Sequence[str]) -> Dict[str, str]:
+    """Local/attr names holding a mesh-axis size: assigned from
+    ``....get('<axis>', ...)``, ``...shape['<axis>']`` or
+    ``lax.axis_size('<axis>')``."""
+    out: Dict[str, str] = {}
+
+    def axis_of(expr: ast.AST) -> Optional[str]:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                last = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) \
+                    else _last_seg(dataflow.dotted_name(node.func))
+                if last in ('get', 'axis_size') and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        node.args[0].value in mesh_axes:
+                    return node.args[0].value
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    node.slice.value in mesh_axes:
+                name = dataflow.dotted_name(node.value)
+                if name and name.endswith('shape'):
+                    return node.slice.value
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        axis = axis_of(node.value)
+        if axis is None:
+            continue
+        for tgt in node.targets:
+            name = dataflow.dotted_name(tgt)
+            if name:
+                out[name] = axis
+    return out
+
+
+def _divisibility_guards(tree: ast.AST,
+                         axis_vars: Dict[str, str]
+                         ) -> Set[Tuple[str, str]]:
+    """(symbol, axis) pairs guarded by a ``sym % axis_size_var``
+    expression anywhere in the module (if-tests, asserts, raises)."""
+    guards: Set[Tuple[str, str]] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.BinOp) and
+                isinstance(node.op, ast.Mod)):
+            continue
+        left = dataflow.dotted_name(node.left)
+        if left is None:
+            continue
+        # The divisor may be wrapped (max(tp, 1)): any axis-size name
+        # anywhere inside the right operand counts.
+        for sub in ast.walk(node.right):
+            name = dataflow.dotted_name(sub)
+            axis = axis_vars.get(name) if name else None
+            if axis is not None:
+                guards.add((left.rsplit('.', 1)[-1], axis))
+    return guards
+
+
+def _check_host_transfers(rel: str, index: dataflow.ModuleIndex,
+                          contract: Optional[ModuleContract],
+                          ok) -> List[Finding]:
+    """SHARD003: host transfers on values whose def-chain carries an
+    explicit sharding application."""
+    findings: List[Finding] = []
+    methods = _sharding_methods(index)
+    sharded_attrs: Set[str] = set()
+    if contract is not None:
+        for buf in contract.buffers:
+            for expr, _, fn_node in _attr_defs(index, buf.name):
+                if _is_sharding_apply(expr):
+                    sharded_attrs.add(buf.name)
+                    break
+
+    def is_sharded(expr: ast.AST, local: Set[str]) -> bool:
+        name = dataflow.dotted_name(expr)
+        if name is None:
+            return False
+        if name in local:
+            return True
+        parts = name.split('.')
+        return len(parts) >= 2 and parts[0] == 'self' and \
+            parts[1] in sharded_attrs
+
+    def flag(lineno: int, what: str) -> None:
+        if not ok(lineno):
+            findings.append(Finding(
+                rel, lineno, PASS_HOST_TRANSFER,
+                f'{what} on a value whose def-chain carries a '
+                'NamedSharding: a host transfer of a device-sharded '
+                'array is a hidden cross-device all-gather (annotate '
+                '# shard-ok: <reason> if the gather is intended)'))
+
+    for info in index.functions.values():
+        local = _sharded_locals(info.node, methods)
+        if not local and not sharded_attrs:
+            continue
+        for node in dataflow._walk_no_nested(info.node):
+            if isinstance(node, ast.Call):
+                callee = dataflow.dotted_name(node.func)
+                last = _last_seg(callee)
+                if (callee in _HOST_CALLS or last == 'device_get') \
+                        and node.args and \
+                        is_sharded(node.args[0], local):
+                    flag(node.lineno, f'{callee or last}()')
+                elif last in _HOST_METHODS and \
+                        isinstance(node.func, ast.Attribute) and \
+                        is_sharded(node.func.value, local):
+                    flag(node.lineno, f'.{last}()')
+                elif last in ('bool', 'float', 'int') and node.args \
+                        and is_sharded(node.args[0], local):
+                    flag(node.lineno, f'{last}()')
+            elif isinstance(node, (ast.If, ast.While)) and \
+                    is_sharded(node.test, local):
+                flag(node.test.lineno, 'implicit bool')
+    return findings
+
+
+# ------------------------------------------------------------- driver
+
+def check_tree(files: Dict[str, str],
+               registry: Optional[Dict[str, ModuleContract]] = None
+               ) -> List[Finding]:
+    """The skycheck ``shard`` tree pass: vocabulary from mesh.py, then
+    every mesh-using module checked against it + the registry."""
+    if registry is None:
+        registry = REGISTRY
+    mesh_axes, logical_axes, rules = mesh_vocabulary(
+        files.get(MESH_FILE))
+    findings: List[Finding] = []
+    # Rule-target drift inside the vocabulary itself: a _BASE_RULES
+    # entry mapping to an axis MESH_AXES does not define.
+    for name, target, line in rules:
+        targets = target if isinstance(target, tuple) else (target,)
+        for ax in targets:
+            if ax is not None and ax not in mesh_axes:
+                findings.append(Finding(
+                    MESH_FILE, line, PASS_UNKNOWN_AXIS,
+                    f"logical rule '{name}' maps to mesh axis '{ax}' "
+                    f'which MESH_AXES does not define '
+                    f'({tuple(mesh_axes)})'))
+    for rel in sorted(files):
+        if rel not in SHARD_FILES and rel not in registry:
+            continue
+        findings.extend(_check_module(rel, files[rel], mesh_axes,
+                                      logical_axes,
+                                      registry.get(rel)))
+    return findings
+
+
+def render_markdown(files: Dict[str, str]) -> str:
+    """The generated sharding-contract table for docs/architecture.md."""
+    mesh_axes, _, rules = mesh_vocabulary(files.get(MESH_FILE))
+    rows = ['| module | buffer | declared spec (logical axes) | '
+            'divisibility contract |',
+            '|---|---|---|---|']
+    for path, mc in sorted(REGISTRY.items()):
+        for buf in mc.buffers:
+            div = ', '.join(f'`{s} % {a}`' for s, a in buf.divisibility)
+            rows.append(f'| `{path}` | `{buf.name}` | '
+                        f'`{buf.spec_str()}` | {div or "—"} |')
+    header = (f'Mesh axes: `{tuple(mesh_axes)}`; '
+              f'{len(rules)} logical-axis rules '
+              '(`parallel/mesh.py:_BASE_RULES`).\n\n')
+    return header + '\n'.join(rows) + '\n'

@@ -12,8 +12,11 @@ the keys every registered consumer reads, then reports:
   keys read by a consumer that may face a dense replica).  These are
   the live drift bugs.
 - **WIRE002** (baseline tier): a produced key nothing consumes.  Most
-  are legitimate operator/dashboard surface — pinned in
-  ``skycheck_baseline.txt`` so only *new* unconsumed keys surface.
+  are legitimate operator/dashboard surface — annotate the producing
+  line ``# wire-ok: <reason>`` to declare that on the record (the
+  reason is mandatory prose, reviewed like code) instead of carrying
+  the finding in ``skycheck_baseline.txt`` forever; unannotated new
+  orphans still surface.
 - **WIRE003** (error tier): one key produced with conflicting concrete
   value types across branches/producers of the same surface.
 
@@ -28,6 +31,7 @@ generated table in docs/architecture.md.
 """
 import ast
 import dataclasses
+import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.analysis import dataflow
@@ -36,6 +40,20 @@ from skypilot_tpu.analysis.findings import Finding
 PASS_CONSUMED_NOT_PRODUCED = 'WIRE001'
 PASS_PRODUCED_NOT_CONSUMED = 'WIRE002'
 PASS_TYPE_CONFLICT = 'WIRE003'
+
+# `# wire-ok: <reason>` on a producing line: the key is deliberately
+# operator/dashboard-only surface — suppress its WIRE002 orphan
+# finding at the declaration site.
+_WIRE_OK_RE = re.compile(r'#\s*wire-ok\b')
+
+
+def _wire_ok(files: Dict[str, str], path: str, line: int) -> bool:
+    text = files.get(path)
+    if text is None:
+        return False
+    lines = text.splitlines()
+    return 0 < line <= len(lines) and \
+        bool(_WIRE_OK_RE.search(lines[line - 1]))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -364,6 +382,8 @@ def check_tree(files: Dict[str, str],
             if key not in sc.consumed:
                 ppath, pline = sc.producer_of.get(
                     key, (sc.producer_path, 1))
+                if _wire_ok(files, ppath, pline):
+                    continue
                 findings.append(Finding(
                     ppath, pline, PASS_PRODUCED_NOT_CONSUMED,
                     f"surface '{sc.name}': key '{key}' produced but "

@@ -1542,12 +1542,12 @@ class InferenceEngine:
         rs = self.radix_stats
         lookups = rs['lookups']
         radix = {
-            'enabled': self._radix is not None,
-            'hits': rs['hits'],
-            'lookups': lookups,
+            'enabled': self._radix is not None,  # wire-ok: operator dashboard field
+            'hits': rs['hits'],  # wire-ok: operator dashboard field
+            'lookups': lookups,  # wire-ok: operator dashboard field
             'hit_rate': (rs['hits'] / lookups) if lookups else 0.0,
-            'nodes': self._radix.nodes if self._radix else 0,
-            'evictions': rs['evictions'],
+            'nodes': self._radix.nodes if self._radix else 0,  # wire-ok: operator dashboard field
+            'evictions': rs['evictions'],  # wire-ok: operator dashboard field
         }
         if not self._paged:
             # Same key set as the paged branch: prefix_affinity keys
@@ -1566,10 +1566,10 @@ class InferenceEngine:
         usable = self._num_blocks - 1
         free = len(self._free_blocks)
         return {
-            'layout': 'paged',
+            'layout': 'paged',  # wire-ok: operator dashboard field
             'block_size': self.cfg.kv_block_size,
-            'blocks_total': usable,
-            'blocks_free': free,
+            'blocks_total': usable,  # wire-ok: operator dashboard field
+            'blocks_free': free,  # wire-ok: operator dashboard field
             'occupancy': ((usable - free) / usable) if usable else 0.0,
             'radix': radix,
         }
@@ -1654,22 +1654,22 @@ class InferenceEngine:
         }
         return {
             'kv': kv,
-            'serving': bool(self._serving),
+            'serving': bool(self._serving),  # wire-ok: external monitoring field
             # deprecated aliases of kv.*
             'kv_layout': 'paged',
-            'block_size': bs_,
+            'block_size': bs_,  # wire-ok: deprecated alias, external readers
             'blocks_total': usable,
             'blocks_free': free,
             'blocks_allocated': usable - free,
             'blocks_shared': shared,
             'blocks_prefix': prefix_blocks,
             'shared_refs_saved': kv['blocks']['shared_refs_saved'],
-            'kv_bytes_per_block': int(block_bytes),
-            'kv_bytes_total': int(self._num_blocks * block_bytes),
-            'kv_bytes_resident': int((usable - free) * block_bytes),
+            'kv_bytes_per_block': int(block_bytes),  # wire-ok: deprecated alias, external readers
+            'kv_bytes_total': int(self._num_blocks * block_bytes),  # wire-ok: deprecated alias, external readers
+            'kv_bytes_resident': int((usable - free) * block_bytes),  # wire-ok: deprecated alias, external readers
             'admission_deferred': self.paged_stats['deferred'],
             'prefix_block_hits': self.paged_stats['prefix_block_hits'],
-            'faults': dict(self.fault_stats),
+            'faults': dict(self.fault_stats),  # wire-ok: external monitoring field
             'qos': self._qos_section(),
         }
 
@@ -3775,12 +3775,14 @@ class InferenceEngine:
                     moved = True
             if not moved:
                 # Quiesce point: nothing in flight moved this pass, so
-                # the block pool's refcounts must balance exactly and
+                # the block pool's refcounts must balance exactly,
                 # every jit root's compile count must sit within its
-                # provable bound (each no-op unless its sanitizer
-                # gate / SKYTPU_SANITIZERS is on).
+                # provable bound, and the live root inputs must hold
+                # their declared shardings (each no-op unless its
+                # sanitizer gate / SKYTPU_SANITIZERS is on).
                 sanitizers.maybe_check_block_conservation(self)
                 sanitizers.maybe_check_compile_budget(self)
+                sanitizers.maybe_check_shard_layout(self)
                 time.sleep(idle_sleep)
 
     def warmup_decode(self, tokens: Sequence[int]) -> None:

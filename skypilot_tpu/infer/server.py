@@ -342,11 +342,11 @@ class InferenceServer:
             status = 'ok'
         doc: Dict[str, object] = {
             'status': status,
-            'model_ready': model_ready,
-            'loop_alive': loop_alive,
+            'model_ready': model_ready,  # wire-ok: external health probes
+            'loop_alive': loop_alive,  # wire-ok: external health probes
             'draining': self.draining.is_set(),
-            'drained': self.drained.is_set(),
-            'inflight': self.gen_inflight,
+            'drained': self.drained.is_set(),  # wire-ok: external health probes
+            'inflight': self.gen_inflight,  # wire-ok: external health probes
             # Stable key set: None until the engine can answer — probe
             # consumers must never key-miss on a starting replica.
             'kv': None,
@@ -654,23 +654,23 @@ def _make_handler(server: InferenceServer):
                             'finish_reason': value.finish_reason,
                         }
                         if value.logprobs is not None:
-                            final['logprobs'] = value.logprobs
+                            final['logprobs'] = value.logprobs  # wire-ok: client-facing API field
                         if value.prompt_logprobs is not None:
-                            final['prompt_logprobs'] = \
-                                value.prompt_logprobs
+                            final['prompt_logprobs'] = (  # wire-ok: client API
+                                value.prompt_logprobs)
                         if value.error:
                             final['error'] = value.error
                         if value.error_class:
-                            final['error_class'] = value.error_class
+                            final['error_class'] = value.error_class  # wire-ok: client-facing API field
                         if server.tokenizer is not None:
-                            final['text'] = server.tokenizer.decode(
+                            final['text'] = server.tokenizer.decode(  # wire-ok: client-facing API field
                                 value.output_tokens)
                         emit(final)
                     else:   # timeout — acknowledge what was streamed
-                        emit({'done': True, 'error': 'timed out',
-                              'finish_reason': 'error',
-                              'output_tokens': streamed,
-                              'ttft_s': 0.0, 'latency_s': 0.0})
+                        emit({'done': True, 'error': 'timed out',  # wire-ok: client-facing API field
+                              'finish_reason': 'error',  # wire-ok: client-facing API field
+                              'output_tokens': streamed,  # wire-ok: client-facing API field
+                              'ttft_s': 0.0, 'latency_s': 0.0})  # wire-ok: client-facing API field
             except (BrokenPipeError, ConnectionResetError):
                 # Client went away mid-stream: closing the generator
                 # runs submit_stream's finally, which cancels into the
@@ -705,37 +705,37 @@ def _make_handler(server: InferenceServer):
                 eng = server.engine
                 st = eng.stats()
                 self._json(200, {
-                    'slots_active': sum(s is not None
+                    'slots_active': sum(s is not None  # wire-ok: operator metrics surface
                                         for s in eng._slots),
                     'num_slots': eng.cfg.num_slots,
-                    'queue_depth': server._queue.qsize(),
-                    'awaiting_first_token': len(server._awaiting_first),
-                    'shed_count': server.shed_count,
-                    'draining': server.draining.is_set(),
-                    'gen_inflight': server.gen_inflight,
-                    'drain_refused': server.drain_refused,
+                    'queue_depth': server._queue.qsize(),  # wire-ok: operator metrics surface
+                    'awaiting_first_token': len(server._awaiting_first),  # wire-ok: operator metrics surface
+                    'shed_count': server.shed_count,  # wire-ok: operator metrics surface
+                    'draining': server.draining.is_set(),  # wire-ok: operator metrics surface
+                    'gen_inflight': server.gen_inflight,  # wire-ok: operator metrics surface
+                    'drain_refused': server.drain_refused,  # wire-ok: operator metrics surface
                     'spec': dict(eng.spec_stats),
                     # THE structured KV section: layout, blocks, bytes,
                     # prefix + radix caching (hits/hit_rate/
                     # tokens_reused/nodes/blocks_held/evictions),
                     # admission — engine.stats()['kv'].
-                    'kv': st['kv'],
+                    'kv': st['kv'],  # wire-ok: operator metrics surface
                     # Deprecated aliases of kv.* (old dashboards):
-                    'prefix': dict(eng.prefix_stats),
-                    'resident_prefixes': len(eng._prefixes),
-                    'kv_cache': st,
-                    'adapters': sorted(eng.adapters),
-                    'prefill_chunk': eng.cfg.prefill_chunk,
-                    'chunking_slots': len(eng._chunking),
-                    'chunk': dict(eng.chunk_stats),
+                    'prefix': dict(eng.prefix_stats),  # wire-ok: operator metrics surface
+                    'resident_prefixes': len(eng._prefixes),  # wire-ok: operator metrics surface
+                    'kv_cache': st,  # wire-ok: operator metrics surface
+                    'adapters': sorted(eng.adapters),  # wire-ok: operator metrics surface
+                    'prefill_chunk': eng.cfg.prefill_chunk,  # wire-ok: operator metrics surface
+                    'chunking_slots': len(eng._chunking),  # wire-ok: operator metrics surface
+                    'chunk': dict(eng.chunk_stats),  # wire-ok: operator metrics surface
                     # Failure/recovery counters (engine.fault_stats):
                     # internal_errors, deadline_evictions, loop_restarts,
                     # quarantined_batches, nonfinite_lanes.
-                    'faults': dict(eng.fault_stats),
+                    'faults': dict(eng.fault_stats),  # wire-ok: operator metrics surface
                     # QoS plane (engine.stats()['qos']): scheduler
                     # depths per class, preemptions, sheds, per-tenant
                     # admitted/shed.
-                    'qos': st.get('qos'),
+                    'qos': st.get('qos'),  # wire-ok: operator metrics surface
                 })
             else:
                 self._json(404, {'error': 'not found'})

@@ -173,7 +173,7 @@ class ServeController:
                 'affinity': lb_affinity.get(endpoint),
                 'latency': lb_latency.get(endpoint),
             })
-        return {'service': self.service_name, 'version': self.version,
+        return {'service': self.service_name, 'version': self.version,  # wire-ok: CLI/debug surface
                 'replicas': replicas,
                 'qos': lb_tenant_qos}
 

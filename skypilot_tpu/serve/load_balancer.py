@@ -926,7 +926,7 @@ class SkyTpuLoadBalancer:
                 relay.resumed = True
                 try:
                     relay.emit_event({
-                        'done': True, 'resumed': True,
+                        'done': True, 'resumed': True,  # wire-ok: client-facing API field
                         'output_tokens': list(relay.streamed),
                         'finish_reason': 'length',
                         'ttft_s': 0.0, 'latency_s': 0.0})
@@ -972,14 +972,14 @@ class SkyTpuLoadBalancer:
         with self._stats_lock:
             counters = dict(self._counters)
         counters.update({
-            'breaker_opens': breaker_opens,
+            'breaker_opens': breaker_opens,  # wire-ok: operator metrics surface
             'breaker_open_now': open_now,
             'draining_replicas': draining,
-            'outstanding': outstanding,
-            'ready_replicas': list(self.policy.ready_replicas),
+            'outstanding': outstanding,  # wire-ok: operator metrics surface
+            'ready_replicas': list(self.policy.ready_replicas),  # wire-ok: operator metrics surface
             'policy': self.policy.stats(),
-            'qos': self.limiter.stats(),
-            'replica_latency': self._latency_summary(),
+            'qos': self.limiter.stats(),  # wire-ok: operator metrics surface
+            'replica_latency': self._latency_summary(),  # wire-ok: operator metrics surface
         })
         return counters
 

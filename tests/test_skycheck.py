@@ -14,7 +14,7 @@ import pytest
 from skypilot_tpu.analysis import (block_lifecycle, compile_budget,
                                    dataflow, determinism, jit_boundary,
                                    layering, lock_discipline, sanitizers,
-                                   wire_contract)
+                                   shard_contract, wire_contract)
 from skypilot_tpu.analysis.findings import (Finding, load_baseline,
                                             new_findings)
 from skypilot_tpu.analysis.walker import iter_py_files
@@ -572,6 +572,24 @@ def test_wire002_produced_never_consumed():
     assert findings[0].path == 'skypilot_tpu/infer/prod.py'
 
 
+def test_wire002_wire_ok_annotation_suppresses():
+    """A `# wire-ok: <reason>` comment on the producing line accepts
+    an externally-consumed key instead of pinning it in the baseline
+    forever (how the PR 9 orphan backlog was burned down)."""
+    findings = _wire_fixture(
+        '''
+        def make():
+            return {
+                'used': 1,
+                'orphan': 2,  # wire-ok: external dashboard field
+            }
+        ''', '''
+        def use(doc):
+            return doc['used']
+        ''')
+    assert _ids(findings) == []
+
+
 def test_wire003_type_conflict():
     findings = _wire_fixture(
         '''
@@ -926,7 +944,7 @@ def test_driver_json_output(tmp_path):
     assert payload['new'] and '[DET001]' in payload['new'][0]
     # Every pass reports its own wall time for the tier-1 ledger.
     for name in ('lock', 'jit', 'layer', 'det', 'block', 'compile',
-                 'wire'):
+                 'wire', 'shard'):
         info = payload['passes'][name]
         assert info['seconds'] >= 0.0
         assert isinstance(info['findings'], int)
@@ -1001,3 +1019,289 @@ def test_architecture_wire_table_fresh():
     assert embedded == fresh, (
         'docs/architecture.md wire-contract table is stale; replace the '
         'block between the markers with:\n' + fresh)
+
+
+# --------------------------------------------------- sharding contracts
+
+_MESH_TEXT = None
+
+
+def _mesh_text():
+    global _MESH_TEXT
+    if _MESH_TEXT is None:
+        with open(os.path.join(REPO, shard_contract.MESH_FILE),
+                  encoding='utf-8') as f:
+            _MESH_TEXT = f.read()
+    return _MESH_TEXT
+
+
+def _shard_files():
+    files = {}
+    for rel in sorted(shard_contract.SHARD_FILES |
+                      {shard_contract.MESH_FILE}):
+        with open(os.path.join(REPO, rel), encoding='utf-8') as f:
+            files[rel] = f.read()
+    return files
+
+
+def _shard(rel, body):
+    """Run the shard pass on one fixture module + the REAL mesh
+    vocabulary (so axis names resolve exactly as in tier-1)."""
+    return shard_contract.check_tree({
+        shard_contract.MESH_FILE: _mesh_text(),
+        rel: textwrap.dedent(body),
+    })
+
+
+def test_shard001_unknown_mesh_axis():
+    defect = '''
+        import jax
+        P = jax.sharding.PartitionSpec
+        def f(mesh, x):
+            spec = P('tensr', None)
+            return spec
+    '''
+    findings = _shard('skypilot_tpu/parallel/pipeline.py', defect)
+    assert _ids(findings) == ['SHARD001']
+    assert "'tensr'" in findings[0].message
+    clean = defect.replace("'tensr'", "'tensor'")
+    assert _shard('skypilot_tpu/parallel/pipeline.py', clean) == []
+
+
+def test_shard001_unknown_logical_axis():
+    findings = _shard('skypilot_tpu/parallel/pipeline.py', '''
+        from skypilot_tpu.parallel.mesh import named_sharding
+        def f(mesh):
+            return named_sharding(mesh, None, 'kv_headz', None, None)
+    ''')
+    assert _ids(findings) == ['SHARD001']
+    assert "'kv_headz'" in findings[0].message
+
+
+def test_shard001_rule_target_drift_in_mesh_itself():
+    """Renaming a mesh axis without updating _BASE_RULES flags every
+    logical rule whose target axis no longer exists."""
+    bad_mesh = _mesh_text().replace("'tensor')", "'tensor2')", 1)
+    assert "'tensor2')" in bad_mesh
+    findings = shard_contract.check_tree(
+        {shard_contract.MESH_FILE: bad_mesh})
+    assert findings and set(_ids(findings)) == {'SHARD001'}
+    assert all(f.path == shard_contract.MESH_FILE for f in findings)
+
+
+def test_shard002_replicated_root_buffer():
+    defect = '''
+        import jax
+        class Eng:
+            def __init__(self, mesh, step):
+                self._mesh = mesh
+                self.cache = init_paged_cache(1, 2, 3)
+                self._decode = jax.jit(step)
+            def run(self, params):
+                return self._decode(params, self.cache)
+    '''
+    findings = _shard('skypilot_tpu/infer/engine.py', defect)
+    assert _ids(findings) == ['SHARD002']
+    assert "'self.cache'" in findings[0].message
+    # One sharding application on a def discharges the contract (the
+    # module-level shard-spec comment carries the SHARD004 guard).
+    clean = '''
+        import jax
+        # shard-spec: num_kv_heads % tensor
+        class Eng:
+            def __init__(self, mesh, step, sh):
+                self._mesh = mesh
+                self.cache = init_paged_cache(1, 2, 3)
+                self.cache = [(jax.device_put(k, sh),
+                               jax.device_put(v, sh))
+                              for k, v in self.cache]
+                self._decode = jax.jit(step)
+            def run(self, params):
+                return self._decode(params, self.cache)
+    '''
+    assert _shard('skypilot_tpu/infer/engine.py', clean) == []
+
+
+def test_shard003_host_transfer_on_sharded_value():
+    defect = '''
+        import jax
+        import numpy as np
+        def f(x, sh):
+            y = jax.device_put(x, sh)
+            return np.asarray(y)
+    '''
+    findings = _shard('skypilot_tpu/parallel/pipeline.py', defect)
+    assert _ids(findings) == ['SHARD003']
+    assert 'np.asarray' in findings[0].message
+    clean = defect.replace('np.asarray(y)', 'np.asarray(x)')
+    assert _shard('skypilot_tpu/parallel/pipeline.py', clean) == []
+
+
+def test_shard004_unguarded_divisibility():
+    defect = '''
+        import jax
+        from skypilot_tpu.parallel.mesh import named_sharding
+        class Eng:
+            def __init__(self, mesh, cache):
+                self._mesh = mesh
+                sh = named_sharding(mesh, None, 'kv_heads', None, None)
+                self.cache = [jax.device_put(c, sh) for c in cache]
+    '''
+    findings = _shard('skypilot_tpu/infer/engine.py', defect)
+    assert _ids(findings) == ['SHARD004']
+    assert 'num_kv_heads' in findings[0].message
+    # The engine's real guard shape: axis size read off the mesh, then
+    # an explicit modulo check before any sharding is applied.
+    clean = '''
+        import jax
+        from skypilot_tpu.parallel.mesh import named_sharding
+        class Eng:
+            def __init__(self, mesh, cache, cfg):
+                self._mesh = mesh
+                tp = dict(mesh.shape).get('tensor', 1)
+                if cfg.num_kv_heads % max(tp, 1):
+                    raise ValueError('indivisible')
+                sh = named_sharding(mesh, None, 'kv_heads', None, None)
+                self.cache = [jax.device_put(c, sh) for c in cache]
+    '''
+    assert _shard('skypilot_tpu/infer/engine.py', clean) == []
+
+
+def test_shard_ok_annotation_suppresses():
+    findings = _shard('skypilot_tpu/parallel/pipeline.py', '''
+        import jax
+        P = jax.sharding.PartitionSpec
+        def f(mesh, x):
+            spec = P('tensr', None)  # shard-ok: exercised by fixture
+            return spec
+    ''')
+    assert findings == []
+
+
+def test_shard_mesh_axis_parity():
+    """The engine's TP mesh, parallel/mesh.py's helpers and the shard
+    registry must agree on ONE axis vocabulary: a constructed Mesh's
+    axis names == MESH_AXES == the parsed vocabulary, and every axis
+    the registry declares exists in it."""
+    import jax
+
+    from skypilot_tpu.parallel import MeshSpec, make_mesh
+    from skypilot_tpu.parallel import mesh as mesh_mod
+    axes, logical, rules = shard_contract.mesh_vocabulary(_mesh_text())
+    assert tuple(axes) == mesh_mod.MESH_AXES
+    built = make_mesh(MeshSpec(), devices=jax.devices()[:1])
+    assert tuple(built.axis_names) == tuple(axes)
+    rule_names = {name for name, _, _ in rules}
+    assert rule_names <= logical
+    for mc in shard_contract.REGISTRY.values():
+        for buf in mc.buffers:
+            for ax in (buf.spec or ()):
+                assert ax is None or ax in logical, ax
+            for _, mesh_ax in buf.divisibility:
+                assert mesh_ax in axes, mesh_ax
+
+
+def test_shard_real_tree_clean():
+    """The live TP plane satisfies its own contracts: zero shard
+    findings on the real mesh-using modules (nothing baselined)."""
+    findings = shard_contract.check_tree(_shard_files())
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_shard_declared_specs_snapshot():
+    """Registry export, pinned: per-root declared specs feeding the
+    docs table.  A row changing here is a layout contract change —
+    update the pin (and docs/architecture.md) in the same PR."""
+    assert shard_contract.declared_specs() == {
+        'skypilot_tpu/infer/engine.py': {
+            'cache': 'P(None, kv_heads, None, None)',
+            'params': 'logical_axis_rules (per-leaf, mesh-fitted)',
+        },
+    }
+
+
+def test_shard_sanitizer_no_mesh_noop():
+    class E:
+        _mesh = None
+    assert sanitizers.check_shard_layout(E()) == {}
+
+
+def test_shard_sanitizer_gating(monkeypatch):
+    class Boom:
+        @property
+        def _mesh(self):
+            raise AssertionError('engine touched while gated off')
+    monkeypatch.delenv('SKYTPU_SHARD_SANITIZER', raising=False)
+    monkeypatch.delenv('SKYTPU_SANITIZERS', raising=False)
+    assert not sanitizers.shard_sanitizer_enabled()
+    sanitizers.maybe_check_shard_layout(Boom())   # gate off: no-op
+    monkeypatch.setenv('SKYTPU_SANITIZERS', '1')  # umbrella: all four
+    assert sanitizers.shard_sanitizer_enabled()
+    with pytest.raises(AssertionError):
+        sanitizers.maybe_check_shard_layout(Boom())
+
+
+def test_architecture_shard_table_fresh():
+    """docs/architecture.md embeds the generated sharding-contract
+    table between <!-- shard-contract:begin/end --> markers; it must
+    match a fresh render of the registry + mesh vocabulary."""
+    doc = os.path.join(REPO, 'docs', 'architecture.md')
+    with open(doc, encoding='utf-8') as f:
+        text = f.read()
+    begin = '<!-- shard-contract:begin -->'
+    end = '<!-- shard-contract:end -->'
+    assert begin in text and end in text
+    embedded = text.split(begin, 1)[1].split(end, 1)[0].strip()
+    fresh = shard_contract.render_markdown(_shard_files()).strip()
+    assert embedded == fresh, (
+        'docs/architecture.md shard-contract table is stale; replace '
+        'the block between the markers with:\n' + fresh)
+
+
+# ------------------------------------------------- driver: CLI surface
+
+def test_driver_rejects_unknown_pass():
+    """A typo'd --passes must fail loudly with the available list,
+    not silently run nothing."""
+    r = _run_skycheck('--passes', 'bogus,wire')
+    assert r.returncode == 2
+    assert 'unknown pass(es): bogus' in r.stderr
+    assert ('available: lock, jit, layer, det, block, compile, '
+            'wire, shard') in r.stderr
+
+
+def _git(cwd, *args):
+    return subprocess.run(['git', '-C', str(cwd), *args],
+                          capture_output=True, text=True, check=True)
+
+
+def test_driver_changed_scope(tmp_path):
+    """--changed restricts the per-file passes to git-modified and
+    untracked files; without a work tree it falls back (with a
+    warning) to the full sweep."""
+    import json as json_mod
+    repo = tmp_path / 'repo'
+    _violation_tree(repo, n=1)              # bad0.py, committed clean
+    _git(repo, 'init', '-q')
+    _git(repo, '-c', 'user.email=t@t', '-c', 'user.name=t',
+         'add', '-A')
+    _git(repo, '-c', 'user.email=t@t', '-c', 'user.name=t',
+         'commit', '-qm', 'seed')
+    _violation_tree(repo, n=2)              # bad1.py appears untracked
+    r = _run_skycheck('--root', str(repo), '--changed',
+                      '--passes', 'det', '--json', '-')
+    payload = json_mod.loads(r.stdout)
+    assert payload['files_checked'] == 1
+    assert payload['passes']['det']['findings'] == 1
+    assert 'bad1.py' in payload['new'][0]
+    # Full sweep sees both violations.
+    r = _run_skycheck('--root', str(repo),
+                      '--passes', 'det', '--json', '-')
+    assert json_mod.loads(r.stdout)['files_checked'] == 2
+    # No work tree: fall back to the full sweep, loudly.
+    plain = tmp_path / 'plain'
+    _violation_tree(plain, n=1)
+    r = _run_skycheck('--root', str(plain), '--changed',
+                      '--passes', 'det', '--json', '-')
+    assert 'running the full sweep' in r.stderr
+    assert json_mod.loads(r.stdout)['files_checked'] == 1
