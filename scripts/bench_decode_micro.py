@@ -39,6 +39,17 @@ prefills only the (1 - overlap) suffix (bucketed), so both the
 analytic prefill compute and the measured TTFT fall with overlap.
 
   python scripts/bench_decode_micro.py --radix --out BENCH_MICRO_r08.json
+
+--tp mode (CPU-dryrun safe): the head-sharded paged pool's
+tensor-parallel scaling story.  The pool pages carry
+P(None, 'kv_heads', None, None): each chip holds Hkv/tp heads of every
+block, so per-chip KV read bytes per decode step fall as 1/tp while the
+replica's pool block budget (and with it max concurrent slots) grows
+~linearly in tp — the analytic sweep quantifies both at the target
+model's geometry, and the measured tiny-model sweep drives the REAL
+single-chip vs tp=2 paged decode roots and checks greedy identity.
+
+  python scripts/bench_decode_micro.py --tp --out BENCH_MICRO_r09.json
 """
 import argparse
 import dataclasses
@@ -246,6 +257,192 @@ def _measure_tiny_sweep(args, fills, steps=4, reps=5):
             'model': 'tiny 2-layer llama (float32)', 'rows': rows}
 
 
+def tp_report(args):
+    """--tp mode: analytic per-chip KV bandwidth + replica capacity vs
+    tensor degree at the target geometry, plus a measured tiny-model
+    single-chip vs tp=2 paged sweep on the current backend."""
+    import numpy as np
+
+    from skypilot_tpu.infer.engine import resolve_cache_dtype
+    from skypilot_tpu.models import get_model_config
+
+    mc = get_model_config(args.model)
+    m = args.max_cache_len
+    bs = args.block_size
+    dt = np.dtype(resolve_cache_dtype(args.cache_dtype))
+    row_bytes = 2 * mc.num_kv_heads * mc.head_dim_ * dt.itemsize * \
+        mc.num_layers
+    typical = args.typical_len
+    blocks_per_slot = -(-typical // bs)
+    nb = _pow2_bucket(blocks_per_slot, m // bs)
+    # Per decode step, per slot, a chip gathers its Hkv/tp heads of the
+    # bucketed ceil(len/block)*block rows: the HBM-bound attention term.
+    full_read = nb * bs * row_bytes
+    weights_bytes = int(args.weights_gb * (1 << 30))
+    sweep = []
+    base_slots = None
+    for tp in args.tp_sweep:
+        if mc.num_kv_heads % tp:
+            sweep.append({'tp': tp, 'supported': False,
+                          'reason': f'num_kv_heads {mc.num_kv_heads} % '
+                                    f'{tp} != 0'})
+            continue
+        # A tp-replica owns tp chips: weights shard over all of them
+        # (weights_gb total, 1/tp per chip) and the pool pages shard on
+        # kv_heads, so the replica's KV budget is the whole slice's HBM
+        # minus ONE copy of the weights.
+        kv_budget = int(tp * args.hbm_gb * (1 << 30)) - weights_bytes
+        pool_blocks = kv_budget // (bs * row_bytes)
+        slots = int(pool_blocks // blocks_per_slot)
+        if base_slots is None:
+            base_slots = max(slots, 1)
+        row = {
+            'tp': tp,
+            'supported': True,
+            'per_chip_kv_read_bytes_per_step': full_read // tp,
+            'kv_read_fraction_of_tp1': round(1.0 / tp, 4),
+            'per_chip_weights_bytes': weights_bytes // tp,
+            'replica_kv_budget_bytes': kv_budget,
+            'pool_blocks': int(pool_blocks),
+            'max_slots_paged': slots,
+            'capacity_gain_vs_tp1': round(slots / base_slots, 2),
+        }
+        sweep.append(row)
+        print(f'tp={tp}: per-chip KV read {full_read // tp:>12d} B/step '
+              f'(1/{tp} of tp=1), {slots:5d} slots at typical len '
+              f'{typical} ({row["capacity_gain_vs_tp1"]:.2f}x)',
+              flush=True)
+
+    measured = None
+    if not args.no_measure:
+        measured = _measure_tp_sweep(args)
+    out = {
+        'description':
+            f'Head-sharded paged KV pool vs tensor degree at '
+            f'{args.model} geometry (Hkv={mc.num_kv_heads}, '
+            f'D={mc.head_dim_}, layers={mc.num_layers}, {dt.name} '
+            'cache). Pool pages carry P(None, kv_heads, None, None): '
+            'per-chip KV read bytes per decode step scale 1/tp (each '
+            'chip gathers only its Hkv/tp heads, chip-local), and the '
+            'replica KV budget is the whole slice HBM minus one '
+            '(sharded) weight copy, so slot capacity grows ~linearly '
+            'in tp. measured_tiny_sweep drives the REAL single-chip '
+            'vs tp=2 paged decode roots on the current backend and '
+            'checks greedy identity (CPU dryrun: direction-of-effect, '
+            'not chip TPOT).',
+        'model': args.model,
+        'max_cache_len': m,
+        'block_size': bs,
+        'typical_resident_len': typical,
+        'hbm_gb_per_chip': args.hbm_gb,
+        'weights_gb': args.weights_gb,
+        'kv_row_bytes': row_bytes,
+        'tp_sweep': sweep,
+        'measured_tiny_sweep': measured,
+    }
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, 'w') as f:
+            json.dump(out, f, indent=2)
+        print(f'wrote {args.out}')
+
+
+def _measure_tp_sweep(args, steps=4, reps=5):
+    """Single-chip vs tp=2 paged decode dispatch on a tiny llama: the
+    measured counterpart of the analytic tp sweep, through the SAME
+    jitted roots serving uses.  Also asserts greedy identity and the
+    per-chip pool accounting."""
+    import jax
+
+    if jax.device_count() < 2:
+        print('tp measured sweep skipped: <2 devices', flush=True)
+        return None
+
+    import jax.numpy as jnp
+
+    from skypilot_tpu.analysis import sanitizers
+    from skypilot_tpu.infer import InferConfig, InferenceEngine, Request
+    from skypilot_tpu.models.llama import LlamaConfig
+    from skypilot_tpu.parallel import tp_mesh
+
+    m = min(args.max_cache_len, 256)
+    bs = args.block_size
+    b = 8
+    cfg_m = LlamaConfig(name='tp-micro', vocab_size=256,
+                        hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_seq_len=m, tie_embeddings=True,
+                        dtype='float32')
+    common = dict(num_slots=b, max_cache_len=m, prefill_buckets=(64,),
+                  decode_steps=steps, cache_dtype=jnp.float32,
+                  kv_block_size=bs, max_new_tokens=8)
+    single = InferenceEngine(cfg_m, InferConfig(**common))
+    tp = InferenceEngine(cfg_m, InferConfig(**common),
+                         params=single.params, mesh=tp_mesh(2))
+    # Greedy identity through the full paged path.
+    reqs = [Request(tokens=[3 + i, 7, 11, 2 * i + 1], max_new_tokens=6)
+            for i in range(4)]
+    import copy as _copy
+    out_s = single.generate([_copy.deepcopy(r) for r in reqs])
+    out_t = tp.generate([_copy.deepcopy(r) for r in reqs])
+    identical = all(a.output_tokens == c.output_tokens
+                    for a, c in zip(out_s, out_t))
+    assert identical, 'tp=2 greedy stream diverged from single-chip'
+    print(f'greedy identity tp=2 vs single-chip: ok '
+          f'({len(reqs)} requests)', flush=True)
+
+    tokens = jnp.ones((b,), jnp.int32)
+    temps = jnp.zeros((b,), jnp.float32)
+    adapters = jnp.full((b,), -1, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    fill = min(args.typical_len, m - steps - 1)
+    lengths = jnp.full((b,), fill, jnp.int32)
+    rows = []
+    for name, eng in (('single', single), ('tp2', tp)):
+        for i in range(b):
+            eng._ensure_blocks(i, min(fill + steps, m))
+        nb = eng._nb_bucket(-(-(fill + steps) // bs))
+        tables = eng._lane_tables(range(b), nb)
+
+        def dispatch():
+            out = eng._paged_decode(eng.params, eng.cache, tokens,
+                                    lengths, temps, key, adapters,
+                                    tables, steps)
+            eng.cache = out[3]
+            return out[0]
+
+        _ = float(dispatch()[0, 0, 0])           # compile + sync
+        t0 = time.time()
+        for _ in range(reps):
+            _ = float(dispatch()[0, 0, 0])
+        ms = (time.time() - t0) / reps * 1e3
+        for i in range(b):
+            eng._free_slot_blocks(i)
+        kv = eng.stats()['kv']
+        rows.append({'engine': name, 'tp': kv['tp'],
+                     'dispatch_ms': round(ms, 2),
+                     'tpot_ms': round(ms / steps, 3),
+                     'pool_bytes_total': kv['bytes']['total'],
+                     'pool_bytes_per_chip': kv['bytes']['per_chip_total']})
+        print(f'measured {name}: {ms:7.2f} ms/dispatch, pool '
+              f'{kv["bytes"]["per_chip_total"]} B/chip', flush=True)
+    assert rows[1]['pool_bytes_per_chip'] * 2 == rows[1]['pool_bytes_total']
+    if sanitizers.shard_sanitizer_enabled():
+        for eng in (single, tp):
+            report = sanitizers.check_shard_layout(eng)
+            print(f'shard layout ok: {report}', flush=True)
+    if sanitizers.compile_sanitizer_enabled():
+        for eng in (single, tp):
+            counts = sanitizers.check_compile_budget(eng)
+            touched = {k: v for k, v in counts.items() if v[0]}
+            print(f'compile budget ok: '
+                  f'{ {k: f"{mm}/{bd}" for k, (mm, bd) in touched.items()} }',
+                  flush=True)
+    return {'batch': b, 'decode_steps': steps, 'filled_len': fill,
+            'greedy_identity': identical,
+            'model': 'tiny 2-layer llama (float32)', 'rows': rows}
+
+
 def radix_report(args):
     """--radix mode: measured TTFT sweep vs prefix-overlap fraction on
     a tiny model, radix caching on vs off, plus the analytic
@@ -369,6 +566,12 @@ def main():
     ap.add_argument('--radix', action='store_true',
                     help='radix prefix-caching TTFT-vs-overlap sweep '
                          'instead of the dispatch-cost fit (CPU-safe)')
+    ap.add_argument('--tp', action='store_true',
+                    help='head-sharded paged pool vs tensor degree: '
+                         'per-chip bandwidth + capacity model and a '
+                         'measured tp=2 identity sweep (CPU-safe)')
+    ap.add_argument('--tp-sweep', type=int, nargs='+',
+                    default=[1, 2, 4, 8])
     ap.add_argument('--block-size', type=int, default=16)
     ap.add_argument('--fill-sweep', type=int, nargs='+',
                     default=[32, 64, 128, 256, 384])
@@ -391,6 +594,19 @@ def main():
         return
     if args.radix:
         radix_report(args)
+        return
+    if args.tp:
+        # The measured sweep needs >=2 devices; on the CPU dryrun that
+        # means the virtual multi-device platform (no-op on real TPU
+        # hosts or when the operator already set the flag).
+        import os
+        if os.environ.get('JAX_PLATFORMS', '') == 'cpu' and \
+                '--xla_force_host_platform_device_count' not in \
+                os.environ.get('XLA_FLAGS', ''):
+            os.environ['XLA_FLAGS'] = (
+                os.environ.get('XLA_FLAGS', '') +
+                ' --xla_force_host_platform_device_count=8').strip()
+        tp_report(args)
         return
 
     import jax

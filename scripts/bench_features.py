@@ -482,6 +482,61 @@ def bench_lb_affinity(n_replicas_sweep=(1, 2, 4, 8), groups: int = 31,
             'rows': rows}
 
 
+def bench_tp_capacity(tp_sweep=(1, 2, 4, 8), hbm_gb=16.0,
+                      weights_gb=7.0, block_size=16, typical_len=256,
+                      max_cache_len=512):
+    """Model-free TP capacity section (no jax, no engines): the
+    head-sharded paged pool's fleet economics at 7B geometry.  A tp
+    replica owns tp chips; pool pages shard P(None, kv_heads, None,
+    None) so its KV budget is the whole slice's HBM minus ONE (sharded)
+    weight copy, while per-chip KV read bytes per decode step fall as
+    1/tp.  The tradeoff this quantifies: tp chips spent on ONE tp
+    replica buy MORE concurrent slots than the same chips spent on tp
+    single-chip DP replicas (the weight copies they'd each carry become
+    pool), at the price of per-replica all-reduce latency — the serve
+    plane lets both coexist behind one LB (BENCH_MICRO_r09 has the
+    measured tp=2 identity/dispatch sweep)."""
+    # 7B fp8-KV geometry: Hkv=32, D=128, 32 layers, 1-byte cache rows.
+    hkv, d, layers, itemsize = 32, 128, 32, 1
+    row_bytes = 2 * hkv * d * itemsize * layers
+    blocks_per_slot = -(-typical_len // block_size)
+    nb = 1
+    while nb < blocks_per_slot and nb < max_cache_len // block_size:
+        nb *= 2
+    full_read = nb * block_size * row_bytes
+    rows = []
+    base = None
+    for tp in tp_sweep:
+        if hkv % tp:
+            rows.append({'tp': tp, 'supported': False})
+            continue
+        kv_budget = int(tp * hbm_gb * (1 << 30)) - \
+            int(weights_gb * (1 << 30))
+        slots_tp = int(kv_budget // (block_size * row_bytes)
+                       // blocks_per_slot)
+        if base is None:
+            base = max(slots_tp, 1)
+        # Same tp chips as independent single-chip DP replicas: each
+        # carries its own full weight copy.
+        dp_budget = int(hbm_gb * (1 << 30)) - int(weights_gb * (1 << 30))
+        slots_dp = tp * int(dp_budget // (block_size * row_bytes)
+                            // blocks_per_slot)
+        rows.append({
+            'tp': tp,
+            'per_chip_kv_read_bytes_per_step': full_read // tp,
+            'slots_one_tp_replica': slots_tp,
+            'slots_tp_single_chip_dp_replicas': slots_dp,
+            'tp_vs_dp_slot_gain': round(slots_tp / max(slots_dp, 1), 2),
+            'capacity_gain_vs_tp1': round(slots_tp / base, 2),
+        })
+    return {'hbm_gb_per_chip': hbm_gb, 'weights_gb': weights_gb,
+            'block_size': block_size, 'typical_resident_len': typical_len,
+            'kv_row_bytes': row_bytes,
+            'metric': 'max concurrent slots from the paged-pool block '
+                      'budget (typical resident length per slot)',
+            'rows': rows}
+
+
 def bench_qos_scheduler(backlog: int = 2000, reps: int = 3):
     """Scheduler-level QoS microbench (no jax, no engines): replay a
     synthetic 2x-overload trace through the real FifoScheduler and
@@ -615,6 +670,8 @@ def main():
     print(json.dumps(result['radix_prefix_cache']))
     result['lb_affinity'] = bench_lb_affinity()
     print(json.dumps(result['lb_affinity']))
+    result['tp_capacity'] = bench_tp_capacity()
+    print(json.dumps(result['tp_capacity']))
     result['qos_scheduler'] = bench_qos_scheduler()
     print(json.dumps(result['qos_scheduler']))
     if args.out:

@@ -28,6 +28,7 @@ Exit code: 0 = all episodes passed, 1 = any property violated.
 """
 import argparse
 import copy
+import functools
 import json
 import os
 import queue
@@ -37,6 +38,16 @@ import time
 from http.client import HTTPConnection
 
 sys.path.insert(0, '.')
+
+# On the CPU dryrun, give the process a virtual multi-device platform
+# BEFORE jax loads so the multi-replica sweep can include a tp=2
+# replica (no-op on real TPU hosts or when the operator set the flag).
+if os.environ.get('JAX_PLATFORMS', '') == 'cpu' and \
+        '--xla_force_host_platform_device_count' not in \
+        os.environ.get('XLA_FLAGS', ''):
+    os.environ['XLA_FLAGS'] = (
+        os.environ.get('XLA_FLAGS', '') +
+        ' --xla_force_host_platform_device_count=8').strip()
 
 import jax
 import jax.numpy as jnp
@@ -176,15 +187,18 @@ def episode(eng: InferenceEngine, seed: int, n: int) -> list:
 # ------------------------------------------------ multi-replica sweep
 
 
-def _replica_engine() -> InferenceEngine:
+def _replica_engine(tp: int = 0) -> InferenceEngine:
+    from skypilot_tpu.parallel import tp_mesh
     mc = LlamaConfig(name='chaos-replica', vocab_size=101,
                      hidden_size=32, intermediate_size=64, num_layers=2,
                      num_heads=4, num_kv_heads=2, max_seq_len=128,
                      tie_embeddings=True, dtype='float32')
     cfg = InferConfig(num_slots=4, max_cache_len=64,
                       prefill_buckets=(8, 16, 32), max_new_tokens=32,
-                      cache_dtype=jnp.float32, decode_steps=4)
-    eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+                      cache_dtype=jnp.float32, decode_steps=4,
+                      kv_block_size=8)
+    eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0),
+                          mesh=tp_mesh(tp))
     # Stretch generations across loop iterations so kills land while
     # streams are genuinely in flight (sleep only; tokens unaffected).
     eng.arm_faults(FaultPlan(seed=0, specs=[
@@ -285,9 +299,17 @@ def multi_replica_sweep(n_replicas: int, seeds, n_requests: int,
     from skypilot_tpu.infer.chaos import ChaosFleet, SeededKiller
 
     os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    # Mixed fleet: the last replica runs tensor-parallel (tp=2) when
+    # the platform has the chips — the LB, breaker, failover, and the
+    # sanitizers must treat a head-sharded replica exactly like its
+    # single-chip peers (byte-identical streams, same wire surface).
+    tp_last = 2 if (n_replicas > 1 and len(jax.devices()) >= 2) else 0
+    factories = [_replica_engine] * (n_replicas - 1) + \
+        [functools.partial(_replica_engine, tp=tp_last)]
     print(f'replica chaos: {n_replicas} replicas seeds={seeds} '
-          f'requests/episode={n_requests} policy={policy_name}')
-    fleet = ChaosFleet(_replica_engine, n_replicas,
+          f'requests/episode={n_requests} policy={policy_name} '
+          f'tp_last={tp_last or 1}')
+    fleet = ChaosFleet(factories, n_replicas,
                        policy_name=policy_name)
     fleet.start()
     failures = []

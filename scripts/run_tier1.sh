@@ -40,6 +40,7 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_skycheck.py \
     --require tests/test_lb_affinity.py \
     --require tests/test_qos.py \
+    --require tests/test_tp_paged.py \
     --skycheck-json "$SKYJSON" \
     --extra-seconds "bench_dryrun:$BENCH_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
@@ -55,7 +56,11 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 SKYTPU_SHARD_
 # finish its in-flight stream with zero 5xx at the LB.  Runs under
 # prefix_affinity routing: byte-identity + failover must hold under
 # the affinity policy too (least_load is covered by the pytest suite).
-timeout -k 10 300 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 SKYTPU_SHARD_SANITIZER=1 \
+# One fleet replica is tensor-parallel (tp=2 dryrun) and the sweep
+# runs under ALL FOUR sanitizers — lock order, block conservation,
+# compile budget, and the shard-layout check that proves the
+# head-sharded paged pool's committed leaves at drain.
+timeout -k 10 300 env JAX_PLATFORMS=cpu SKYTPU_SANITIZERS=1 \
     python scripts/chaos_smoke.py --multi-replica 3 --seeds 0 1 \
     --requests 8 --policy prefix_affinity || rc=1
 exit "$rc"

@@ -373,6 +373,12 @@ def check_shard_layout(engine: Any) -> Dict[str, int]:
     - every cache leaf: exactly ``named_sharding(mesh, None,
       'kv_heads', None, None)`` fitted to the leaf shape (indivisible
       dims replicate, engine._fit_sharding);
+    - a PAGED pool additionally proves its geometry: every per-layer
+      leaf is ``[num_blocks, Hkv, block_size, D]`` (the allocator's
+      global block-id space — dim 0 must match ``engine._num_blocks``
+      exactly, or host tables index off the end of the device pool)
+      and its committed shard holds ``Hkv // tp`` heads per chip, the
+      head-local layout the chip-local gathers rely on;
     - every param leaf: committed to THIS mesh (a leaf resharded onto
       a stray mesh, or left on one device, is drift);
     - under ``tensor>1``: at least one param leaf actually sharded —
@@ -390,6 +396,12 @@ def check_shard_layout(engine: Any) -> Dict[str, int]:
     errors: List[str] = []
     declared = mesh_lib.named_sharding(mesh, None, 'kv_heads', None,
                                        None)
+    mesh_devices = set(mesh.devices.flat)
+    tensor = dict(mesh.shape).get('tensor', 1)
+    paged = bool(getattr(engine, '_paged', False))
+    # Paged requires the llama family, so num_kv_heads exists there;
+    # other families (dense-only) never reach the geometry checks.
+    hkv = engine.model_config.num_kv_heads if paged else 0
     cache_leaves = 0
     for li, (k, v) in enumerate(getattr(engine, 'cache', ()) or ()):
         for tag, leaf in (('k', k), ('v', v)):
@@ -403,8 +415,33 @@ def check_shard_layout(engine: Any) -> Dict[str, int]:
                     f'cache layer {li} {tag}: committed sharding '
                     f'{got} != declared {expect.spec} '
                     f'(registry: P(None, kv_heads, None, None))')
-    mesh_devices = set(mesh.devices.flat)
-    tensor = dict(mesh.shape).get('tensor', 1)
+                continue
+            if not paged:
+                continue
+            # Paged-pool geometry: the host allocator hands out GLOBAL
+            # block ids in [0, _num_blocks) and the radix tree shares
+            # them by refcount — a pool whose dim 0 drifted from the
+            # allocator's id space corrupts silently (tables gather
+            # the wrong pages), so assert it exactly, along with the
+            # block width and the per-chip head count the chip-local
+            # gather relies on.
+            if tuple(leaf.shape) != (engine._num_blocks, hkv,
+                                     engine.cfg.kv_block_size,
+                                     engine.model_config.head_dim_):
+                errors.append(
+                    f'paged pool layer {li} {tag}: leaf shape '
+                    f'{tuple(leaf.shape)} != allocator geometry '
+                    f'({engine._num_blocks}, {hkv}, '
+                    f'{engine.cfg.kv_block_size}, '
+                    f'{engine.model_config.head_dim_})')
+            elif hkv % max(tensor, 1) == 0 and \
+                    _shard_shape(got, leaf.shape)[1] != hkv // tensor:
+                errors.append(
+                    f'paged pool layer {li} {tag}: committed shard '
+                    f'holds {_shard_shape(got, leaf.shape)[1]} kv '
+                    f'heads per chip, declared layout owns '
+                    f'{hkv // tensor} (Hkv={hkv} over tensor='
+                    f'{tensor})')
     param_leaves = jax.tree.leaves(getattr(engine, 'params', {}))
     sharded = 0
     for leaf in param_leaves:
@@ -430,6 +467,7 @@ def check_shard_layout(engine: Any) -> Dict[str, int]:
             'shard layout drifted from the declared registry:\n  '
             + '\n  '.join(errors[:8]))
     return {'cache_leaves': cache_leaves,
+            'paged_pool_leaves': cache_leaves if paged else 0,
             'param_leaves': len(param_leaves),
             'param_leaves_sharded': sharded,
             'tensor_degree': tensor}

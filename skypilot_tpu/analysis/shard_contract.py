@@ -121,6 +121,22 @@ class BufferSpec:
     name: str
     spec: Optional[Tuple[Optional[str], ...]]
     divisibility: Tuple[Tuple[str, str], ...] = ()
+    # attr: the ``self.<attr>`` holding the buffer when it differs from
+    # the display name (two registry rows may prove two allocation
+    # paths of ONE attribute — e.g. the dense cache and the paged
+    # pool both live in ``self.cache``).
+    attr: Optional[str] = None
+    # alloc: anchor the SHARD002 proof to the functions that build the
+    # buffer through THIS allocation call (``init_paged_cache``-style).
+    # Without it, any sharding-applying def anywhere satisfies the
+    # check; with it, every function containing the anchor allocation
+    # must itself carry a sharding-applying def of the attr — so the
+    # paged pool's layout is proven independently of the dense path.
+    alloc: Optional[str] = None
+
+    @property
+    def attr_name(self) -> str:
+        return self.attr or self.name
 
     def spec_str(self) -> str:
         if self.spec is None:
@@ -148,6 +164,16 @@ REGISTRY: Dict[str, ModuleContract] = {
         buffers=(
             BufferSpec('cache', (None, 'kv_heads', None, None),
                        divisibility=(('num_kv_heads', 'tensor'),)),
+            # The paged block pool ([num_blocks, Hkv, block_size, D]
+            # per layer) shares self.cache with the dense layout but
+            # gets its OWN proof row anchored on init_paged_cache: the
+            # function (re)building the pool must apply the head
+            # sharding itself, so dropping the device_put from the
+            # paged branch can never hide behind the dense path's.
+            BufferSpec('cache[paged pool]',
+                       (None, 'kv_heads', None, None),
+                       divisibility=(('num_kv_heads', 'tensor'),),
+                       attr='cache', alloc='init_paged_cache'),
             BufferSpec('params', None),
         ),
     ),
@@ -451,10 +477,24 @@ def _check_contract(rel: str, text: str, index: dataflow.ModuleIndex,
 
     # SHARD002: a registry buffer with defs but no sharding-applying
     # def anywhere, reaching a jit root, in a mesh-bearing module.
+    # An alloc-anchored buffer narrows the proof to the functions that
+    # actually build it through that allocation call (the paged pool's
+    # init_paged_cache), so one path's device_put cannot vouch for
+    # another's.
     for buf in contract.buffers:
-        defs = _attr_defs(index, buf.name)
-        if not defs or buf.name not in root_args:
+        defs = _attr_defs(index, buf.attr_name)
+        if not defs or buf.attr_name not in root_args:
             continue
+        if buf.alloc is not None:
+            alloc_fns = {
+                id(fn_node) for expr, _, fn_node in defs
+                if any(isinstance(c, ast.Call) and
+                       _last_seg(dataflow.dotted_name(c.func)) ==
+                       buf.alloc
+                       for c in ast.walk(expr))}
+            defs = [d for d in defs if id(d[2]) in alloc_fns]
+            if not defs:
+                continue
         sharded = False
         for expr, _, fn_node in defs:
             if _is_sharding_apply(expr):
@@ -472,7 +512,8 @@ def _check_contract(rel: str, text: str, index: dataflow.ModuleIndex,
         if not sharded and not any(ok(line) for _, line, _ in defs):
             findings.append(Finding(
                 rel, defs[0][1], PASS_REPLICATED_BUFFER,
-                f"large buffer 'self.{buf.name}' reaches jit root(s) "
+                f"large buffer 'self.{buf.attr_name}' "
+                f"(registry row '{buf.name}') reaches jit root(s) "
                 f'with no sharding application on any def while this '
                 f'module constructs a mesh (declared spec '
                 f'{buf.spec_str()}): fully replicated under tensor>1 '
@@ -485,7 +526,7 @@ def _check_contract(rel: str, text: str, index: dataflow.ModuleIndex,
                    if isinstance(node, ast.Call) and
                    _is_sharding_apply(node)]
     if not apply_lines:
-        return findings
+        return _dedup(findings)
     axis_vars = _axis_size_vars(index.tree, mesh_axes)
     guards = _divisibility_guards(index.tree, axis_vars)
     for buf in contract.buffers:
@@ -503,7 +544,21 @@ def _check_contract(rel: str, text: str, index: dataflow.ModuleIndex,
                 f"'# shard-spec: {sym} % {axis}' assertion: an "
                 'indivisible dim silently replicates (or mis-shards) '
                 'at placement'))
-    return findings
+    return _dedup(findings)
+
+
+def _dedup(findings: List[Finding]) -> List[Finding]:
+    """Two registry rows proving one attribute (dense cache + paged
+    pool) can flag the same defect line twice; one finding per
+    (line, pass) is enough for the ratchet."""
+    deduped: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for f in findings:
+        key = (f.path, f.line, f.pass_id)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(f)
+    return deduped
 
 
 def _axis_size_vars(tree: ast.AST,
@@ -577,9 +632,9 @@ def _check_host_transfers(rel: str, index: dataflow.ModuleIndex,
     sharded_attrs: Set[str] = set()
     if contract is not None:
         for buf in contract.buffers:
-            for expr, _, fn_node in _attr_defs(index, buf.name):
+            for expr, _, fn_node in _attr_defs(index, buf.attr_name):
                 if _is_sharding_apply(expr):
-                    sharded_attrs.add(buf.name)
+                    sharded_attrs.add(buf.attr_name)
                     break
 
     def is_sharded(expr: ast.AST, local: Set[str]) -> bool:

@@ -131,6 +131,9 @@ SURFACES: Tuple[SurfaceSpec, ...] = (
                      vars=None),
             Consumer(_POLICIES, 'PrefixAffinityPolicy._load_bound',
                      vars=None, exclude_vars=('radix',)),
+            # The LB probe records kv.tp per replica (TP vs DP fleet
+            # composition), relayed to the controller via lb_sync.
+            Consumer(_LB, '_probe_replica_once', vars=('kv',)),
         ),
     ),
     # The radix sub-document of /healthz.kv: the affinity load bound

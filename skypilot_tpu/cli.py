@@ -646,15 +646,25 @@ def serve():
                    'many milliseconds (requires max_replicas in the '
                    'service spec; mutually exclusive with '
                    'target_qps_per_replica).')
+@click.option('--tp-size', default=None, type=int,
+              help='Tensor-parallel degree per replica (overrides '
+                   'resources.tp_size in the YAML): each replica '
+                   'head-shards its KV cache over this many chips, '
+                   'multiplying per-replica KV capacity by the same '
+                   'factor. TP and single-chip replicas coexist behind '
+                   'the same load balancer.')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_up(entrypoint, service_name, workdir, cloud, tpus, cpus,
              memory, use_spot, region, zone, num_nodes, env, lb_policy,
-             qos_policy, slo_ttft_ms, yes):
+             qos_policy, slo_ttft_ms, tp_size, yes):
     """Bring up a service from a task YAML with a `service:` section."""
     import dataclasses as _dc
     from skypilot_tpu import serve as serve_lib
     task = _make_task(entrypoint, None, workdir, cloud, tpus, cpus, memory,
                       use_spot, region, zone, num_nodes, env)
+    if tp_size is not None:
+        task.set_resources(
+            [r.copy(tp_size=tp_size) for r in task.resources])
     if (qos_policy is not None or slo_ttft_ms is not None) and \
             task.service is None:
         raise click.UsageError(

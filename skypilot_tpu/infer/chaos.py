@@ -24,7 +24,7 @@ Used by `scripts/chaos_smoke.py --multi-replica N` and
 import socket
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Sequence, Union
 
 from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu import logsys
@@ -185,12 +185,26 @@ class ChaosFleet:
     breaker/probe machinery under test, not set management.
     """
 
-    def __init__(self, make_engine: Callable[[], InferenceEngine],
+    def __init__(self,
+                 make_engine: Union[Callable[[], InferenceEngine],
+                                    Sequence[Callable[[],
+                                                      InferenceEngine]]],
                  n_replicas: int, policy_name: str = 'least_load',
                  host: str = '127.0.0.1'):
+        # One factory for a homogeneous fleet, or one PER replica for a
+        # mixed one (e.g. a tp=2 replica next to single-chip ones — the
+        # serve plane must treat both identically behind the LB).
+        if callable(make_engine):
+            factories = [make_engine] * n_replicas
+        else:
+            factories = list(make_engine)
+            if len(factories) != n_replicas:
+                raise ValueError(
+                    f'{len(factories)} engine factories for '
+                    f'{n_replicas} replicas')
         self.replicas = [
-            KillableReplica(make_engine, free_port(host), host=host)
-            for _ in range(n_replicas)
+            KillableReplica(factory, free_port(host), host=host)
+            for factory in factories
         ]
         self.policy = LoadBalancingPolicy.make(policy_name)
         self.policy.set_ready_replicas([r.url for r in self.replicas])

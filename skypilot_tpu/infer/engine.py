@@ -484,12 +484,18 @@ class InferenceEngine:
                 'InferenceEngine supports the Llama, Mixtral and GPT-2 '
                 'families (KV-cache decode path); got '
                 f'{type(model_config).__name__}')
+        # Tensor degree of this replica (1 when unsharded): divides the
+        # per-chip KV byte accounting in stats()/kv_health() and rides
+        # /healthz so the serve plane can tell TP replicas from DP ones
+        # in a mixed fleet.
+        self._tp = 1
         if mesh is not None:
             tp = dict(mesh.shape).get('tensor', 1)
             if model_config.num_kv_heads % max(tp, 1):
                 raise ValueError(
                     f'num_kv_heads {model_config.num_kv_heads} not '
                     f'divisible by tensor degree {tp}')
+            self._tp = max(tp, 1)
         if self.cfg.max_cache_len > model_config.max_seq_len:
             raise ValueError(
                 f'max_cache_len {self.cfg.max_cache_len} exceeds model '
@@ -1226,6 +1232,29 @@ class InferenceEngine:
             paged attention path (llama family only)."""
             return {'paged_tables': tables, 'paged_block_size': bs}
 
+        # Head-sharded pool pinning: under a mesh every paged root
+        # constrains the pool to the registry layout P(None, 'kv_heads',
+        # None, None) on entry AND exit, so XLA keeps block gathers and
+        # scatter-writes chip-local to the owned heads and the donated
+        # buffers never pay a relayout between dispatches.  Block ids
+        # stay global (the host allocator, tables and radix tree are
+        # topology-oblivious) — only the pages are distributed.
+        if self._mesh is not None and self._paged:
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            pool_sharding = self._fit_sharding(
+                self.cache[0][0].shape,
+                mesh_lib.named_sharding(self._mesh, None, 'kv_heads',
+                                        None, None))
+
+            def pin_pool(cache):
+                return [
+                    (jax.lax.with_sharding_constraint(k, pool_sharding),
+                     jax.lax.with_sharding_constraint(v, pool_sharding))
+                    for k, v in cache]
+        else:
+            def pin_pool(cache):
+                return cache
+
         def paged_prefill(params, tokens, starts, true_pos, cache,
                           tables, temps, rng, adapter_ids, want_plp):
             """The ONE paged prefill dispatch: forwards tokens [P, W] at
@@ -1240,6 +1269,7 @@ class InferenceEngine:
             lane's allocated blocks land in the dump block; rows there
             are beyond every query position, so the attention mask
             never sees them."""
+            cache = pin_pool(cache)
             w = tokens.shape[1]
             positions = starts[:, None] + jnp.arange(w)[None]
             logits, cache = model.apply(params, tokens, positions, cache,
@@ -1267,7 +1297,7 @@ class InferenceEngine:
                 prompt_packed = jnp.zeros((tokens.shape[0], 0,
                                            1 + 2 * topk), jnp.float32)
             return (pack_head(first, first_lp, *first_top),
-                    prompt_packed, cache)
+                    prompt_packed, pin_pool(cache))
 
         def paged_decode(params, cache, tokens, lengths, temps, rng,
                          adapter_ids, tables, steps):
@@ -1297,13 +1327,15 @@ class InferenceEngine:
 
             keys = jax.random.split(rng, steps)
             (cache, last, lens), (toks, lps, gtoks, glps) = jax.lax.scan(
-                one_step, (cache, tokens, lengths), keys)
-            return pack_head(toks, lps, gtoks, glps), last, lens, cache
+                one_step, (pin_pool(cache), tokens, lengths), keys)
+            return (pack_head(toks, lps, gtoks, glps), last, lens,
+                    pin_pool(cache))
 
         def paged_spec_verify(params, cache, tokens, lengths, temps,
                               rng, adapter_ids, tables):
             """Speculative verify over the block pool (see spec_verify
             for the accept contract)."""
+            cache = pin_pool(cache)
             k = tokens.shape[1]
             positions = lengths[:, None] + jnp.arange(k)[None]
             logits, cache = model.apply(params, tokens, positions, cache,
@@ -1317,7 +1349,8 @@ class InferenceEngine:
                               greedy).astype(jnp.int32)
             preds_lp = chosen_logprob(logits, preds)
             t_ids, t_lps = topk_lp(logits)
-            return pack_head(preds, preds_lp, t_ids, t_lps), cache
+            return (pack_head(preds, preds_lp, t_ids, t_lps),
+                    pin_pool(cache))
 
         def paged_copy_blocks(cache, src, dsts):
             """Copy pool block `src` into every block of dsts [G], per
@@ -1326,13 +1359,13 @@ class InferenceEngine:
             table reference).  Pad dsts entries may repeat a real dst:
             duplicate scatters write identical bytes."""
             new = []
-            for kp, vp in cache:
+            for kp, vp in pin_pool(cache):
                 kb = jnp.broadcast_to(kp[src][None],
                                       (dsts.shape[0],) + kp.shape[1:])
                 vb = jnp.broadcast_to(vp[src][None],
                                       (dsts.shape[0],) + vp.shape[1:])
                 new.append((kp.at[dsts].set(kb), vp.at[dsts].set(vb)))
-            return new
+            return pin_pool(new)
 
         self._paged_prefill = jax.jit(paged_prefill, donate_argnums=(4,),
                                       static_argnums=(9,))
@@ -1561,6 +1594,7 @@ class InferenceEngine:
                 'blocks_total': 0,
                 'blocks_free': 0,
                 'occupancy': 0.0,
+                'tp': self._tp,
                 'radix': radix,
             }
         usable = self._num_blocks - 1
@@ -1571,6 +1605,7 @@ class InferenceEngine:
             'blocks_total': usable,  # wire-ok: operator dashboard field
             'blocks_free': free,  # wire-ok: operator dashboard field
             'occupancy': ((usable - free) / usable) if usable else 0.0,
+            'tp': self._tp,
             'radix': radix,
         }
 
@@ -1588,12 +1623,21 @@ class InferenceEngine:
         prefix = {**self.prefix_stats,
                   'resident': len(self._prefixes)}
         radix = self._radix_section()
+        # The cache is head-sharded over the tensor axis (dense and
+        # paged alike), so each chip holds bytes ÷ tp: the per_chip_*
+        # keys are the numbers HBM capacity planning needs — reporting
+        # global pool bytes as if every chip held them would overstate
+        # occupancy by the tensor degree.
+        tp = self._tp
         if not self._paged:
             total = self.cfg.num_slots * self.cfg.max_cache_len
             kv = {
                 'layout': 'dense',
+                'tp': tp,
                 'bytes': {'total': total * row_bytes,
-                          'resident': total * row_bytes},
+                          'resident': total * row_bytes,
+                          'per_chip_total': total * row_bytes // tp,
+                          'per_chip_resident': total * row_bytes // tp},
                 'prefix': prefix,
                 'radix': radix,
             }
@@ -1632,6 +1676,7 @@ class InferenceEngine:
         prefix['blocks'] = prefix_blocks
         kv = {
             'layout': 'paged',
+            'tp': tp,
             'blocks': {
                 'size': bs_,
                 'total': usable,
@@ -1647,6 +1692,10 @@ class InferenceEngine:
                 'per_block': int(block_bytes),
                 'total': int(self._num_blocks * block_bytes),
                 'resident': int((usable - free) * block_bytes),
+                'per_chip_total':
+                    int(self._num_blocks * block_bytes) // tp,
+                'per_chip_resident':
+                    int((usable - free) * block_bytes) // tp,
             },
             'admission': {'deferred': self.paged_stats['deferred']},
             'prefix': prefix,

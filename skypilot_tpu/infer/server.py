@@ -1693,13 +1693,11 @@ def run(model: str = 'llama-1b', host: str = '0.0.0.0', port: int = 8100,
                       qos=qos,
                       qos_tenant_weights=parse_tenant_weights(
                           qos_tenant_weights))
-    mesh = None
-    if tensor_parallel and tensor_parallel > 1:
-        import jax
-
-        from skypilot_tpu.parallel import MeshSpec, make_mesh
-        mesh = make_mesh(MeshSpec(tensor=tensor_parallel),
-                         devices=jax.devices()[:tensor_parallel])
+    # The ONE mesh-construction path every TP replica shares (server
+    # entrypoint, chaos harness, tests): parallel.tp_mesh returns None
+    # for degree <= 1, so DP and TP replicas flow through one line.
+    from skypilot_tpu.parallel import tp_mesh
+    mesh = tp_mesh(tensor_parallel or 0)
     engine = InferenceEngine(model_config, cfg, params=params, mesh=mesh)
     serve(engine, host=host, port=port, tokenizer=tokenizer,
           max_projected_ttft_s=max_ttft, max_queue=max_queue,
@@ -1722,8 +1720,16 @@ def main() -> None:
                              'serve real pretrained weights')
     parser.add_argument('--cache-dtype', default='bfloat16',
                         choices=['bfloat16', 'fp8'])
-    parser.add_argument('--tensor-parallel', type=int, default=0,
-                        help='shard the model over N local chips')
+    parser.add_argument('--tensor-parallel', type=int,
+                        # The serve-plane replica manager exports the
+                        # task's resources.tp_size here, so a replica
+                        # launched by `skytpu serve up --tp-size N`
+                        # shards itself without the YAML having to
+                        # thread the flag through its run command.
+                        default=int(os.environ.get(
+                            'SKYTPU_SERVE_TP_SIZE', '0') or 0),
+                        help='shard the model over N local chips '
+                             '(default: $SKYTPU_SERVE_TP_SIZE or 0)')
     parser.add_argument('--draft-len', type=int, default=0,
                         help='speculative decoding: prompt-lookup draft '
                              'tokens per dispatch (0 disables)')

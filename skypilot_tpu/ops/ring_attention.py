@@ -32,6 +32,13 @@ from jax import lax
 
 from skypilot_tpu.ops.flash_attention import flash_attention
 
+# jax moved shard_map out of experimental in 0.6; accept both spellings
+# (a fresh param init under a tensor mesh routes through it, so a TP
+# serve replica without a checkpoint crashes here on older jax).
+_shard_map = getattr(jax, 'shard_map', None)
+if _shard_map is None:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 _NEG_INF = -1e30
 
 
@@ -219,11 +226,11 @@ def sequence_parallel_attention(q: jax.Array,
         spec = p(('data', 'fsdp'), 'tensor', None, None)
         fn = functools.partial(flash_attention, causal=causal, scale=scale,
                                window=window)
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec)(q, k, v)
+        return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)(q, k, v)
     inner = ring_attention if mode == 'ring' else ulysses_attention
     fn = functools.partial(inner, axis_name='seq', causal=causal,
                            scale=scale)
     spec = p(('data', 'fsdp'), 'tensor', 'seq', None)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)

@@ -7,7 +7,7 @@ ICI/DCN-aware Mesh, with XLA emitting the collectives.
 from skypilot_tpu.parallel.mesh import (MeshSpec,
                                         initialize_distributed_from_env,
                                         make_mesh, logical_axis_rules,
-                                        mesh_context)
+                                        mesh_context, tp_mesh)
 
 __all__ = ['MeshSpec', 'initialize_distributed_from_env', 'make_mesh',
-           'logical_axis_rules', 'mesh_context']
+           'logical_axis_rules', 'mesh_context', 'tp_mesh']

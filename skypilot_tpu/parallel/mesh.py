@@ -142,6 +142,29 @@ def named_sharding(mesh: jax.sharding.Mesh,
         mesh, jax.sharding.PartitionSpec(*(resolve(a) for a in logical_axes)))
 
 
+def tp_mesh(tensor_parallel: int,
+            devices: Optional[Sequence] = None
+            ) -> Optional[jax.sharding.Mesh]:
+    """The ONE way a serving replica builds its tensor-parallel mesh —
+    shared by the HTTP server entrypoint, the chaos harness and the
+    tests so every TP replica in a fleet agrees on device order (the
+    first N local devices: innermost axis on the fastest ICI links).
+
+    Returns None for degree <= 1: an unsharded engine takes mesh=None,
+    so data-parallel and tensor-parallel replicas flow through one
+    code path and differ only in this return value.
+    """
+    if tensor_parallel is None or tensor_parallel <= 1:
+        return None
+    devs = list(devices if devices is not None else jax.devices())
+    if tensor_parallel > len(devs):
+        raise ValueError(
+            f'tensor_parallel {tensor_parallel} exceeds the {len(devs)} '
+            'visible device(s); a mesh needs one chip per shard')
+    return make_mesh(MeshSpec(tensor=tensor_parallel),
+                     devices=devs[:tensor_parallel])
+
+
 def host_local_device_count() -> int:
     return jax.local_device_count()
 

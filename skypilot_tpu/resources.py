@@ -49,6 +49,7 @@ class Resources:
         labels: Optional[Dict[str, str]] = None,
         reservation: Optional[str] = None,
         autostop: Optional[Dict[str, Any]] = None,
+        tp_size: Optional[int] = None,
     ):
         self._version = self._VERSION
         self._cloud = cloud.lower() if cloud else None
@@ -71,6 +72,7 @@ class Resources:
         self._labels = dict(labels) if labels else None
         self._reservation = reservation
         self._autostop = autostop
+        self._tp_size = int(tp_size) if tp_size is not None else None
         self._validate()
 
     # ------------------------------------------------------------ properties
@@ -146,6 +148,17 @@ class Resources:
     @property
     def autostop(self) -> Optional[Dict[str, Any]]:
         return self._autostop
+
+    @property
+    def tp_size(self) -> Optional[int]:
+        """Tensor-parallel degree each serving replica shards over.
+
+        None means unsharded (single-chip engine).  Consumed by the serve
+        plane: ReplicaManager exports it as SKYTPU_SERVE_TP_SIZE so the
+        replica's inference server builds a tp mesh and head-shards its
+        paged KV pool.
+        """
+        return self._tp_size
 
     @property
     def is_tpu(self) -> bool:
@@ -228,6 +241,9 @@ class Resources:
                     raise exceptions.InvalidResourcesError(
                         f'Invalid port spec {p!r}; expected "8080" or '
                         f'"10000-10010".')
+        if self._tp_size is not None and self._tp_size < 1:
+            raise exceptions.InvalidResourcesError(
+                f'tp_size must be >= 1, got {self._tp_size}.')
 
     # ---------------------------------------------------------------- costs
 
@@ -339,6 +355,7 @@ class Resources:
             labels=self._labels,
             reservation=self._reservation,
             autostop=self._autostop,
+            tp_size=self._tp_size,
         )
         fields.update(override)
         return Resources(**fields)
@@ -354,7 +371,7 @@ class Resources:
             'cloud', 'accelerator', 'accelerators', 'accelerator_args',
             'cpus', 'memory', 'instance_type', 'use_spot', 'job_recovery',
             'region', 'zone', 'image_id', 'disk_size', 'ports', 'labels',
-            'reservation', 'autostop', 'any_of'
+            'reservation', 'autostop', 'any_of', 'tp_size'
         }
         unknown = set(config) - known
         if unknown:
@@ -407,6 +424,7 @@ class Resources:
         put('labels', self._labels)
         put('reservation', self._reservation)
         put('autostop', self._autostop)
+        put('tp_size', self._tp_size)
         return cfg
 
     # ------------------------------------------------------------- dunders

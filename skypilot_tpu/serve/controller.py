@@ -58,6 +58,11 @@ class ServeController:
         # percentile summaries.
         self._lb_tenant_qos: dict = {}  # guarded-by: _lb_lock
         self._lb_latency: dict = {}  # guarded-by: _lb_lock
+        # Per-replica tensor degree from the LB's /healthz probes
+        # (engine kv.tp): 1 = data-parallel, N = an N-chip TP replica.
+        # Surfaced per replica in state_snapshot() so operators can see
+        # mixed TP/DP fleet composition at a glance.
+        self._lb_tp: dict = {}  # guarded-by: _lb_lock
 
     # ----------------------------------------------------------- HTTP API
 
@@ -70,12 +75,14 @@ class ServeController:
             affinity = payload.get('replica_affinity')
             tenant_qos = payload.get('tenant_qos')
             latency = payload.get('replica_latency')
+            replica_tp = payload.get('replica_tp')
             if isinstance(latency, dict):
                 self.autoscaler.collect_latency_information(latency)
             if isinstance(inflight, dict) or isinstance(draining, list) \
                     or isinstance(affinity, dict) \
                     or isinstance(tenant_qos, dict) \
-                    or isinstance(latency, dict):
+                    or isinstance(latency, dict) \
+                    or isinstance(replica_tp, dict):
                 with self._lb_lock:
                     if isinstance(inflight, dict):
                         self._lb_inflight = {
@@ -93,6 +100,11 @@ class ServeController:
                         self._lb_latency = {
                             str(k): v for k, v in latency.items()
                             if isinstance(v, dict)}
+                    if isinstance(replica_tp, dict):
+                        self._lb_tp = {
+                            str(k): int(v)
+                            for k, v in replica_tp.items()
+                            if isinstance(v, (int, float))}
             return {
                 'ready_replica_urls':
                     serve_state.ready_replica_endpoints(self.service_name)
@@ -157,6 +169,7 @@ class ServeController:
             lb_affinity = dict(self._lb_affinity)
             lb_tenant_qos = dict(self._lb_tenant_qos)
             lb_latency = dict(self._lb_latency)
+            lb_tp = dict(self._lb_tp)
         replicas = []
         for r in serve_state.get_replicas(self.service_name):
             endpoint = r.get('endpoint')
@@ -172,6 +185,9 @@ class ServeController:
                 'draining': endpoint in lb_draining,
                 'affinity': lb_affinity.get(endpoint),
                 'latency': lb_latency.get(endpoint),
+                # None until the LB's first probe of this replica
+                # reports kv.tp (1 = DP, N = N-chip tensor parallel).
+                'tp': lb_tp.get(endpoint),
             })
         return {'service': self.service_name, 'version': self.version,  # wire-ok: CLI/debug surface
                 'replicas': replicas,

@@ -167,6 +167,10 @@ class SkyTpuLoadBalancer:
         self._health_lock = sanitizers.instrument_lock(
             threading.Lock(), 'serve.load_balancer._health_lock')
         self._health: Dict[str, _ReplicaHealth] = {}  # guarded-by: _health_lock
+        # Tensor degree each replica advertises through /healthz kv.tp
+        # (1 = unsharded/DP): synced to the controller so the fleet
+        # snapshot shows which replicas are tensor-parallel.
+        self._replica_tp: Dict[str, int] = {}  # guarded-by: _health_lock
         self._stats_lock = sanitizers.instrument_lock(
             threading.Lock(), 'serve.load_balancer._stats_lock')
         self._counters = {  # guarded-by: _stats_lock
@@ -289,6 +293,14 @@ class SkyTpuLoadBalancer:
         # healthz document (hit rate raises the load bound, near-full
         # occupancy penalizes the replica).
         self.policy.observe_replica(url, doc)
+        kv = doc.get('kv')
+        if isinstance(kv, dict):
+            # kv.tp: the engine's tensor degree — a TP replica owns
+            # 1/tp of the KV heads per chip; recorded so the
+            # controller's fleet snapshot distinguishes TP from DP
+            # replicas behind one LB.
+            with self._health_lock:
+                self._replica_tp[url] = int(kv.get('tp') or 1)
         state = doc.get('status')
         self._mark_draining(url, bool(doc.get('draining')) or
                             state == 'draining')
@@ -321,6 +333,7 @@ class SkyTpuLoadBalancer:
             inflight = {u: h.outstanding for u, h in self._health.items()}
             draining = sorted(u for u, h in self._health.items()
                               if h.draining)
+            replica_tp = dict(self._replica_tp)
         body = json.dumps({'request_timestamps': timestamps,
                            'replica_inflight': inflight,
                            'replica_draining': draining,
@@ -328,6 +341,7 @@ class SkyTpuLoadBalancer:
                                self.policy.stats().get('per_replica', {}),
                            'tenant_qos': self.limiter.stats(),
                            'replica_latency': self._latency_summary(),
+                           'replica_tp': replica_tp,
                            }).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',

@@ -101,10 +101,17 @@ class ReplicaManager:
             task.set_resources([r.copy(use_spot=True) for r in resources])
         cloud = resources[0].cloud if resources else None
         port = self._replica_port(replica_id, cloud)
-        task.update_envs({
+        envs = {
             'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
             'SKYTPU_SERVE_REPLICA_PORT': str(port),
-        })
+        }
+        tp_size = resources[0].tp_size if resources else None
+        if tp_size is not None and tp_size > 1:
+            # The inference server reads this as its --tensor-parallel
+            # default, so tp replicas shard without the task YAML having
+            # to thread the flag into its run command.
+            envs['SKYTPU_SERVE_TP_SIZE'] = str(tp_size)
+        task.update_envs(envs)
         return task
 
     def _launch_replica(self, replica_id: int, cluster: str,

@@ -37,6 +37,7 @@ _RESOURCES_SCHEMA = {
                       {'type': 'object'}]
         },
         'any_of': {'type': 'array', 'items': {'type': 'object'}},
+        'tp_size': {'type': 'integer', 'minimum': 1},
     },
 }
 

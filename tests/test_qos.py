@@ -521,7 +521,7 @@ def test_controller_ingests_qos_and_latency_sync():
         threading.Lock(), 'serve.controller._lb_lock.test')
     ctl._lb_inflight, ctl._lb_draining = {}, set()
     ctl._lb_affinity, ctl._lb_tenant_qos = {}, {}
-    ctl._lb_latency = {}
+    ctl._lb_latency, ctl._lb_tp = {}, {}
     payload = {
         'request_timestamps': [],
         'tenant_qos': {'default_rate': 0.0,

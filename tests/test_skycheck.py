@@ -670,7 +670,7 @@ def test_wire_drift_fix_dense_kv_health_keys():
     sc = _contract_by_name()['/healthz.kv']
     assert sc.produced.always == {
         'block_size', 'blocks_free', 'blocks_total', 'layout',
-        'occupancy', 'radix'}
+        'occupancy', 'radix', 'tp'}
 
 
 def test_wire_drift_fix_dense_stats_flat_aliases():
@@ -1122,6 +1122,38 @@ def test_shard002_replicated_root_buffer():
     assert _shard('skypilot_tpu/infer/engine.py', clean) == []
 
 
+def test_shard002_alloc_anchor_isolates_paged_pool_proof():
+    """The paged-pool registry row anchors on init_paged_cache: a
+    sharding application on the DENSE rebuild path must not vouch for
+    a paged rebuild that forgot its device_put (one attribute, two
+    allocation paths, two proofs)."""
+    defect = '''
+        import jax
+        # shard-spec: num_kv_heads % tensor
+        class Eng:
+            def __init__(self, mesh, step, sh):
+                self._mesh = mesh
+                self.cache = [(jax.device_put(k, sh),
+                               jax.device_put(v, sh))
+                              for k, v in init_cache(1, 2)]
+                self._decode = jax.jit(step)
+            def rebuild_paged(self):
+                self.cache = init_paged_cache(1, 2, 3)
+            def run(self, params):
+                return self._decode(params, self.cache)
+    '''
+    findings = _shard('skypilot_tpu/infer/engine.py', defect)
+    assert _ids(findings) == ['SHARD002']
+    assert 'paged pool' in findings[0].message
+    # Sharding applied in the SAME function as the anchor allocation
+    # discharges the paged row.
+    clean = defect.replace(
+        'self.cache = init_paged_cache(1, 2, 3)',
+        'self.cache = [(jax.device_put(k, sh), jax.device_put(v, sh)) '
+        'for k, v in init_paged_cache(1, 2, 3)]')
+    assert _shard('skypilot_tpu/infer/engine.py', clean) == []
+
+
 def test_shard003_host_transfer_on_sharded_value():
     defect = '''
         import jax
@@ -1215,6 +1247,7 @@ def test_shard_declared_specs_snapshot():
     assert shard_contract.declared_specs() == {
         'skypilot_tpu/infer/engine.py': {
             'cache': 'P(None, kv_heads, None, None)',
+            'cache[paged pool]': 'P(None, kv_heads, None, None)',
             'params': 'logical_axis_rules (per-leaf, mesh-fitted)',
         },
     }

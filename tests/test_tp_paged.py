@@ -1,0 +1,370 @@
+"""Head-sharded paged KV pool: tensor-parallel replicas (tier-1 CPU).
+
+The contract under test (infer/engine.py + parallel/mesh.py + serve/*):
+a tp=2 paged engine is OBSERVABLY IDENTICAL to the single-chip paged
+engine — same greedy tokens, same logprobs, same scheduling — while its
+pool pages shard P(None, 'kv_heads', None, None) over the mesh and the
+host-side allocator/radix/QoS planes stay topology-oblivious.  The
+serve plane treats TP replicas as first-class: resources.tp_size flows
+through the replica manager into the server env, /healthz.kv.tp flows
+through the LB sync into GET /controller/state.
+
+Everything here is CPU dryrun on the conftest 8-device virtual
+platform: one tiny 2-layer model, params built ONCE, fixed seeds.
+"""
+import copy
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
+                                       Request)  # noqa: E402
+from skypilot_tpu.models.llama import LlamaConfig  # noqa: E402
+from skypilot_tpu.parallel import tp_mesh  # noqa: E402
+
+
+@pytest.fixture(scope='module')
+def tiny_config():
+    return LlamaConfig(name='tp-paged-test', vocab_size=101,
+                       hidden_size=32, intermediate_size=64,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       max_seq_len=128, tie_embeddings=True,
+                       dtype='float32')
+
+
+# One config for the whole identity suite: paged + chunked prefill +
+# radix so every test below exercises the pool through its hardest
+# scheduling paths, and the two engines compile ONCE per module.
+COMMON = dict(num_slots=4, max_cache_len=64, prefill_buckets=(8, 16, 32),
+              max_new_tokens=8, cache_dtype=jnp.float32, kv_block_size=8,
+              prefill_chunk=8, auto_prefix_cache=True,
+              decode_lookahead=True)
+
+
+@pytest.fixture(scope='module')
+def shared_params(tiny_config):
+    eng = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          rng=jax.random.PRNGKey(0))
+    return eng.params
+
+
+@pytest.fixture(scope='module')
+def pair(tiny_config, shared_params):
+    """(single-chip, tp=2) paged engines sharing weights and seed.
+
+    Module-scoped: both sides see the SAME request sequence across
+    tests (pytest runs this file in order), so their radix caches
+    evolve identically and identity holds test-to-test.
+    """
+    single = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                             params=shared_params,
+                             rng=jax.random.PRNGKey(7))
+    tp = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                         params=shared_params,
+                         rng=jax.random.PRNGKey(7), mesh=tp_mesh(2))
+    return single, tp
+
+
+def _reqs(seed, n, max_prompt=30, max_new=8, ids=True):
+    import random
+    r = random.Random(seed)
+    return [Request(request_id=str(i) if ids else None,
+                    tokens=[r.randrange(1, 101)
+                            for _ in range(r.randrange(3, max_prompt))],
+                    max_new_tokens=r.randrange(1, max_new))
+            for i in range(n)]
+
+
+def _serve(eng, jobs, timeout=120):
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    t = threading.Thread(
+        target=eng.generate_stream,
+        args=(q, lambda res: results.__setitem__(res.request_id, res),
+              stop), daemon=True)
+    t.start()
+    try:
+        for job in jobs:
+            q.put(copy.deepcopy(job))
+        deadline = time.time() + timeout
+        while len(results) < len(jobs) and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert len(results) == len(jobs)
+    return results
+
+
+def _assert_identical(out_s, out_t):
+    for a, b in zip(out_s, out_t):
+        assert a.output_tokens == b.output_tokens
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_allclose(a.logprobs, b.logprobs, atol=1e-5)
+
+
+# ---------------------------------------------------- engine identity
+
+
+def test_tp_paged_offline_identity_and_pool_layout(pair, tiny_config):
+    single, tp = pair
+    # Pool pages shard on the kv-heads axis; block ids stay global.
+    k0, v0 = tp.cache[0]
+    hkv = tiny_config.num_kv_heads
+    assert k0.shape[1] == hkv
+    assert k0.sharding.shard_shape(k0.shape)[1] == hkv // 2
+    assert v0.sharding.shard_shape(v0.shape)[1] == hkv // 2
+    # Allocator geometry identical to the single-chip engine: the
+    # host-side planes are topology-oblivious.
+    assert tp._num_blocks == single._num_blocks
+    assert k0.shape == single.cache[0][0].shape
+
+    reqs = _reqs(0, 6, ids=False)
+    out_s = single.generate([copy.deepcopy(r) for r in reqs])
+    out_t = tp.generate([copy.deepcopy(r) for r in reqs])
+    _assert_identical(out_s, out_t)
+    st = tp.stats()
+    assert st['kv_layout'] == 'paged'
+    # Radix keeps resident prefixes allocated; the host-side allocator
+    # must agree exactly with the single-chip engine's.
+    assert st['blocks_allocated'] == single.stats()['blocks_allocated']
+
+
+def test_tp_paged_serving_chunked_prefill_identity(pair):
+    """Bursty serving with prompts beyond the largest bucket (32): the
+    chunked-prefill path round-trips the sharded pool every chunk, and
+    the tp engine must make the SAME scheduling decisions."""
+    single, tp = pair
+    reqs = _reqs(11, 8, max_prompt=45)
+    res_s = _serve(single, reqs)
+    res_t = _serve(tp, reqs)
+    for req in reqs:
+        a, b = res_s[req.request_id], res_t[req.request_id]
+        assert a.output_tokens == b.output_tokens, req.request_id
+        assert a.finish_reason == b.finish_reason
+    assert (tp.stats()['blocks_allocated'] ==
+            single.stats()['blocks_allocated'])
+
+
+def test_tp_paged_radix_shared_prefix_identity(pair):
+    """Prefix sharing over the sharded pool: shared blocks are shared
+    PAGES on every chip, refcounts stay host-side and global."""
+    single, tp = pair
+    prefix = [(3 * j) % 97 + 1 for j in range(16)]
+    reqs = [Request(request_id=f'p{i}', tokens=prefix + [50 + i],
+                    max_new_tokens=6) for i in range(4)]
+    # First request alone seeds the radix tree (inserts happen at
+    # completion); the rest must hit its resident prefix blocks.
+    res_s = _serve(single, reqs[:1])
+    res_s.update(_serve(single, reqs[1:]))
+    res_t = _serve(tp, reqs[:1])
+    res_t.update(_serve(tp, reqs[1:]))
+    for req in reqs:
+        assert (res_s[req.request_id].output_tokens ==
+                res_t[req.request_id].output_tokens), req.request_id
+    # Radix actually shared pages, and bookkeeping matches single-chip
+    # exactly: the tree is host-side and topology-oblivious.
+    assert tp.radix_stats['hits'] > 0
+    assert tp.radix_stats == single.radix_stats
+    st = tp.stats()
+    assert st['blocks_allocated'] == single.stats()['blocks_allocated']
+
+
+def test_tp_paged_per_chip_accounting_and_sanitizer(pair):
+    from skypilot_tpu.analysis.sanitizers import check_shard_layout
+    single, tp = pair
+    for eng, deg in ((single, 1), (tp, 2)):
+        kv = eng.kv_health()
+        assert kv['tp'] == deg
+        st = eng.stats()
+        assert st['kv']['tp'] == deg
+        b = st['kv']['bytes']
+        assert b['per_chip_total'] == b['total'] // deg
+        assert b['per_chip_resident'] == b['resident'] // deg
+    # Same pool, half the bytes per chip at tp=2.
+    assert (tp.stats()['kv']['bytes']['per_chip_total'] * 2 ==
+            single.stats()['kv']['bytes']['per_chip_total'])
+    rep = check_shard_layout(tp)
+    assert rep['tensor_degree'] == 2
+    assert rep['paged_pool_leaves'] == len(tp.cache) * 2
+
+
+def test_tp_qos_preemption_park_resume_identity(tiny_config,
+                                                shared_params):
+    """A part-prefilled batch prompt on the tp=2 engine parks at its
+    chunk boundary for an interactive arrival, then resumes suffix-only
+    off its own radix blocks — BOTH streams byte-identical to an
+    uncontended single-chip qos-off run.  Park/resume never moves
+    pages; slot-exit and re-admission are pure host bookkeeping."""
+    from skypilot_tpu.infer.faults import FaultPlan, FaultSpec
+    qos_cfg = dict(num_slots=1, max_cache_len=128,
+                   prefill_buckets=(8, 16), max_new_tokens=8,
+                   cache_dtype=jnp.float32, kv_block_size=8,
+                   prefill_chunk=8, auto_prefix_cache=True)
+    ref = InferenceEngine(tiny_config, InferConfig(**qos_cfg),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    eng = InferenceEngine(tiny_config, InferConfig(qos=True, **qos_cfg),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7), mesh=tp_mesh(2))
+    batch = Request(request_id='batch',
+                    tokens=[(7 * j) % 97 + 1 for j in range(60)],
+                    max_new_tokens=8, priority='batch')
+    inter = Request(request_id='inter', tokens=[9, 4, 2, 8],
+                    max_new_tokens=8, priority='interactive')
+    ref_out = {**_serve(ref, [copy.deepcopy(batch)]),
+               **_serve(ref, [copy.deepcopy(inter)])}
+    # Stall every loop pass so the interactive arrival deterministically
+    # lands while the 60-token prompt is mid-chunk.
+    eng.arm_faults(FaultPlan(seed=0, specs=[
+        FaultSpec(site='stall', prob=1.0, stall_s=0.03)]))
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    t = threading.Thread(
+        target=eng.generate_stream,
+        args=(q, lambda r: results.__setitem__(r.request_id, r), stop),
+        daemon=True)
+    t.start()
+    try:
+        q.put(copy.deepcopy(batch))
+        deadline = time.time() + 60
+        while not eng._chunking and time.time() < deadline:
+            time.sleep(0.002)
+        assert eng._chunking, 'batch prompt never started chunking'
+        q.put(copy.deepcopy(inter))
+        while len(results) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        eng.disarm_faults()
+    assert len(results) == 2, results.keys()
+    assert eng.qos_stats['preemptions'] >= 1
+    for rid in ('batch', 'inter'):
+        assert results[rid].finish_reason == ref_out[rid].finish_reason
+        assert results[rid].output_tokens == ref_out[rid].output_tokens, rid
+
+
+# ------------------------------------------------------- serve plane
+
+
+def test_tp_mesh_helper_validates():
+    from skypilot_tpu.parallel import tp_mesh as helper
+    assert helper(0) is None
+    assert helper(1) is None
+    mesh = helper(2)
+    assert mesh.devices.size == 2
+    with pytest.raises(ValueError, match='visible device'):
+        helper(99)
+
+
+class _HealthStub(BaseHTTPRequestHandler):
+    """Minimal replica: answers /healthz like a tp=2 paged engine."""
+    doc = {'status': 'ok', 'kv': {'layout': 'paged', 'block_size': 8,
+                                  'blocks_total': 32, 'blocks_free': 32,
+                                  'occupancy': 0.0, 'tp': 2,
+                                  'radix': None}}
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        import json
+        body = json.dumps(self.doc).encode()
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_lb_probe_records_replica_tp():
+    """The LB health probe reads /healthz.kv.tp so its controller sync
+    can label TP vs single-chip replicas in a mixed fleet."""
+    from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+    from skypilot_tpu.serve.load_balancing_policies import (
+        RoundRobinPolicy)
+    httpd = ThreadingHTTPServer(('127.0.0.1', 0), _HealthStub)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f'http://127.0.0.1:{httpd.server_port}'
+        policy = RoundRobinPolicy()
+        policy.set_ready_replicas([url])
+        lb = SkyTpuLoadBalancer(None, 0, policy)
+        lb._probe_replica_once(url)
+        assert lb._replica_tp == {url: 2}
+    finally:
+        httpd.shutdown()
+
+
+def test_controller_state_exposes_per_replica_tp():
+    """GET /controller/state carries each replica's tensor degree (None
+    until the LB's first probe reports it) so operators can see mixed
+    TP/DP fleets."""
+    import unittest.mock as mock
+
+    from skypilot_tpu.analysis import sanitizers
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve.controller import ServeController
+    from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+    spec = SkyTpuServiceSpec(min_replicas=2)
+    ctl = ServeController.__new__(ServeController)
+    ctl.service_name = 'svc-tp'
+    ctl.spec = spec
+    ctl.version = 1
+    ctl.autoscaler = autoscalers.Autoscaler.make(spec)
+    ctl._lb_lock = sanitizers.instrument_lock(
+        threading.Lock(), 'serve.controller._lb_lock.tp-test')
+    ctl._lb_inflight, ctl._lb_draining = {}, set()
+    ctl._lb_affinity, ctl._lb_tenant_qos = {}, {}
+    ctl._lb_latency, ctl._lb_tp = {}, {}
+    payload = {'request_timestamps': [],
+               'replica_tp': {'http://r1:9': 2}}
+    with mock.patch('skypilot_tpu.serve.serve_state.'
+                    'ready_replica_endpoints', return_value=[]):
+        ctl._handle('/controller/load_balancer_sync', payload)
+    replicas = [{'replica_id': 1, 'status': 'READY', 'version': 1,
+                 'is_spot': 0, 'endpoint': 'http://r1:9'},
+                {'replica_id': 2, 'status': 'READY', 'version': 1,
+                 'is_spot': 0, 'endpoint': 'http://r2:9'}]
+    with mock.patch('skypilot_tpu.serve.serve_state.get_replicas',
+                    return_value=replicas):
+        snap = ctl.state_snapshot()
+    by_id = {r['replica_id']: r for r in snap['replicas']}
+    assert by_id[1]['tp'] == 2
+    assert by_id[2]['tp'] is None          # not probed yet
+
+
+def test_resources_tp_size_flows_into_replica_env(tmp_path):
+    """resources.tp_size round-trips YAML and lands in the replica's
+    SKYTPU_SERVE_TP_SIZE env (the server's --tensor-parallel default),
+    so `skytpu serve up --tp-size 2` shards without the task YAML
+    threading any flag."""
+    import yaml
+
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+    r = Resources(cloud='local', tp_size=2)
+    assert Resources.from_yaml_config(r.to_yaml_config()).tp_size == 2
+    assert r.copy(tp_size=4).tp_size == 4      # the CLI override path
+
+    spec = SkyTpuServiceSpec(min_replicas=1)
+    cfg = {'run': 'echo serve', 'resources': {'cloud': 'local'}}
+    for tp_size, expect in ((2, '2'), (None, None)):
+        if tp_size is not None:
+            cfg['resources']['tp_size'] = tp_size
+        else:
+            cfg['resources'].pop('tp_size', None)
+        task_yaml = tmp_path / f'task-{tp_size}.yaml'
+        task_yaml.write_text(yaml.safe_dump(cfg))
+        mgr = ReplicaManager('svc-tp-env', spec, str(task_yaml))
+        task = mgr._build_replica_task(1, use_spot=False)
+        assert task.envs.get('SKYTPU_SERVE_TP_SIZE') == expect
+        assert task.envs['SKYTPU_SERVE_REPLICA_ID'] == '1'
