@@ -196,7 +196,8 @@ def _replica_engine(tp: int = 0) -> InferenceEngine:
     cfg = InferConfig(num_slots=4, max_cache_len=64,
                       prefill_buckets=(8, 16, 32), max_new_tokens=32,
                       cache_dtype=jnp.float32, decode_steps=4,
-                      kv_block_size=8)
+                      kv_block_size=8, auto_prefix_cache=True,
+                      host_kv_bytes=32 << 20)
     eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0),
                           mesh=tp_mesh(tp))
     # Stretch generations across loop iterations so kills land while
@@ -266,6 +267,18 @@ def _drain_exercise(fleet, references) -> list:
         time.sleep(0.01)
     if busy is None:
         return ['DRAIN: stream never reached a replica']
+    # Seed a hot radix prefix on the soon-to-drain replica: the LB
+    # must ship it to a survivor (warm failover) once it observes the
+    # drain, and the adopter must answer the matching prompt off the
+    # adopted blocks — byte-identical, suffix-only prefill.
+    hot = [7] * 24   # three full blocks at kv_block_size=8
+    hot_ref = None
+    try:
+        hot_ref = _finish_of(_stream_generate(
+            busy.port, {'tokens': hot + [90], 'max_new_tokens': 3,
+                        'stream': True}))['output_tokens']
+    except RuntimeError as e:
+        bad.append(f'DRAIN: hot seed request failed: {e}')
     conn = HTTPConnection('127.0.0.1', busy.port, timeout=10)
     conn.request('POST', '/drain', body=b'{"deadline_s": 60}')
     if conn.getresponse().status != 200:
@@ -287,6 +300,31 @@ def _drain_exercise(fleet, references) -> list:
         bad.append('DRAIN: in-flight stream diverged')
     if not busy.server.drained.wait(30):
         bad.append('DRAIN: replica never reported drained')
+    # Warm failover: a survivor must have adopted the drained
+    # replica's hot set, and replaying the hot prompt on the adopter
+    # must count a radix hit with byte-identical output.
+    survivors = [r for r in fleet.replicas if r is not busy]
+    adopter, wait_until = None, time.time() + 30
+    while time.time() < wait_until and adopter is None:
+        adopter = next(
+            (r for r in survivors
+             if r.server.engine.handoff_stats.get('adopted', 0) > 0),
+            None)
+        time.sleep(0.05)
+    if adopter is None:
+        bad.append('DRAIN: no survivor adopted the hot set')
+    elif hot_ref is not None:
+        hits0 = adopter.server.engine.radix_stats['hits']
+        try:
+            done = _finish_of(_stream_generate(
+                adopter.port, {'tokens': hot + [90],
+                               'max_new_tokens': 3, 'stream': True}))
+            if done['output_tokens'] != hot_ref:
+                bad.append('DRAIN: hot replay diverged on the adopter')
+            if adopter.server.engine.radix_stats['hits'] <= hits0:
+                bad.append('DRAIN: hot replay missed the adopted radix')
+        except RuntimeError as e:
+            bad.append(f'DRAIN: hot replay failed: {e}')
     conn = HTTPConnection('127.0.0.1', busy.port, timeout=10)
     conn.request('POST', '/drain', body=b'{"cancel": true}')
     conn.getresponse()

@@ -41,6 +41,7 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_lb_affinity.py \
     --require tests/test_qos.py \
     --require tests/test_tp_paged.py \
+    --require tests/test_kv_tier.py \
     --skycheck-json "$SKYJSON" \
     --extra-seconds "bench_dryrun:$BENCH_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
@@ -60,7 +61,7 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 SKYTPU_SHARD_
 # runs under ALL FOUR sanitizers — lock order, block conservation,
 # compile budget, and the shard-layout check that proves the
 # head-sharded paged pool's committed leaves at drain.
-timeout -k 10 300 env JAX_PLATFORMS=cpu SKYTPU_SANITIZERS=1 \
+timeout -k 10 420 env JAX_PLATFORMS=cpu SKYTPU_SANITIZERS=1 \
     python scripts/chaos_smoke.py --multi-replica 3 --seeds 0 1 \
     --requests 8 --policy prefix_affinity || rc=1
 exit "$rc"

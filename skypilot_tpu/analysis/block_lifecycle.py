@@ -14,6 +14,12 @@ mid-function jit dispatch can take:
 - **radix**: ``self._radix.insert(..., VAR, ..., own=True)`` — the
   radix tree takes over the allocation refs (duplicates are dereffed
   inside insert, eviction derefs the rest).
+- **spill**: ``self._host_tier.spill(..., VAR, ...)`` — the host-RAM
+  tier adopts the blocks across the tier boundary (its LRU/budget
+  trim is the eventual release).  A RESTORED block needs no special
+  kind: the restore path allocates fresh blocks through
+  ``_alloc_blocks`` and hands them to the radix sink, so it re-enters
+  the ordinary conservation proof.
 
 The static complement of the runtime refcount sanitizer
 (``sanitizers.check_block_conservation``): the sanitizer proves the
@@ -53,6 +59,7 @@ PASS_DOUBLE_FREE = 'BLOCK002'
 OWNED_FILES = (
     'skypilot_tpu/infer/engine.py',
     'skypilot_tpu/infer/radix.py',
+    'skypilot_tpu/infer/block_pool.py',
 )
 
 ALLOC_FUNCS = frozenset({'_alloc_blocks'})
@@ -64,10 +71,10 @@ RAISING_CALLS = frozenset({
     '_paged_prefill', '_paged_decode', '_paged_spec_verify',
     '_paged_copy_blocks', '_prefill_insert', '_chunk_prefill',
     '_decode', '_spec_verify', '_prefill_capture', '_prefix_prefill',
-    '_alloc_blocks',
+    '_alloc_blocks', '_paged_restore_blocks',
 })
 
-ALL_KINDS = frozenset({'free', 'table', 'entry', 'radix'})
+ALL_KINDS = frozenset({'free', 'table', 'entry', 'radix', 'spill'})
 
 _ANNOT_RE = re.compile(r'#\s*owns-blocks:\s*([a-z,\s]+)')
 
@@ -161,6 +168,16 @@ def _release_kind(stmt: ast.stmt, var: str
                        for kw in sub.keywords)
             if owns and any(_mentions_name(a, var) for a in sub.args):
                 return 'radix', sub.lineno
+    # spill: self._host_tier.spill(..., VAR, ...) — the host-RAM tier
+    # adopts the blocks across the tier boundary.
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == 'spill' and \
+                isinstance(sub.func.value, ast.Attribute) and \
+                sub.func.value.attr == '_host_tier':
+            if any(_mentions_name(a, var) for a in sub.args):
+                return 'spill', sub.lineno
     if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
         tgt = stmt.targets[0]
         if isinstance(tgt, ast.Subscript) and \

@@ -19,9 +19,12 @@ All are env-gated and cost nothing when off:
   slot-table entries + radix-tree nodes + registered-prefix entries
   holding it, and the free list is exactly the zero-refcount blocks.
   Violations raise ``BlockLeakError`` naming the first few offending
-  blocks.  The serving loop calls ``maybe_check_block_conservation``
-  on idle iterations; chaos_smoke and the fault tests call the checker
-  directly after drain.
+  blocks.  When the host KV tier is armed, its byte-ledger audit runs
+  under the same lock: ledger/entry drift or a budget overrun is a
+  leak across the tier boundary and fails the same check.  The serving
+  loop calls ``maybe_check_block_conservation`` on idle iterations;
+  chaos_smoke and the fault tests call the checker directly after
+  drain.
 - ``SKYTPU_COMPILE_SANITIZER=1`` — ``check_compile_budget(engine)``
   asserts, per jit root, that the number of XLA compilations the root
   has actually accumulated (``fn._cache_size()``) is within the
@@ -284,7 +287,14 @@ def check_block_conservation(engine: Any) -> Optional[Dict[str, int]]:
                 expected[int(b)] += 1
                 prefix_refs += 1
         free = [int(b) for b in engine._free_blocks]
-    errors: List[str] = []
+        # Host tier (when armed): its byte ledger is the tier-boundary
+        # half of the conservation law — a spilled entry whose bytes
+        # drifted from the ledger is a leak ACROSS the boundary the
+        # device-side refcounts can no longer see.
+        tier = getattr(engine, '_host_tier', None)
+        tier_errors = list(tier.audit()) if tier is not None else []
+        tier_entries = tier.entries if tier is not None else 0
+    errors: List[str] = tier_errors
     bad = [(b, refs[b], expected[b]) for b in range(n)
            if refs[b] != expected[b]]
     for b, got, want in bad[:5]:
@@ -312,7 +322,8 @@ def check_block_conservation(engine: Any) -> Optional[Dict[str, int]]:
         raise BlockLeakError(
             'block conservation violated:\n  ' + '\n  '.join(errors))
     return {'blocks': n - 1, 'free': len(free), 'slot_refs': slot_refs,
-            'radix_refs': radix_refs, 'prefix_refs': prefix_refs}
+            'radix_refs': radix_refs, 'prefix_refs': prefix_refs,
+            'host_tier_entries': tier_entries}
 
 
 def maybe_check_block_conservation(engine: Any) -> None:

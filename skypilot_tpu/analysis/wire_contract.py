@@ -136,6 +136,20 @@ SURFACES: Tuple[SurfaceSpec, ...] = (
             Consumer(_LB, '_probe_replica_once', vars=('kv',)),
         ),
     ),
+    # The host_tier sub-document of /healthz.kv (and /stats['kv']):
+    # host-RAM KV tier occupancy + spill/restore counters.  One
+    # producer serves BOTH the enabled and disabled branches with the
+    # same key set — branch stability is the contract.
+    SurfaceSpec(
+        '/healthz.kv.host_tier',
+        producers=(Producer(_ENGINE, '_host_tier_section',
+                            ('return',)),),
+        consumers=(
+            Consumer(_LB, '_probe_replica_once', vars=('ht',)),
+            Consumer(_LB, 'lb_stats', vars=('ht',)),
+            Consumer('tests/test_kv_tier.py', None, vars=('ht',)),
+        ),
+    ),
     # The radix sub-document of /healthz.kv: the affinity load bound
     # boosts its spill threshold by the fleet-average hit rate.
     SurfaceSpec(
@@ -154,6 +168,8 @@ SURFACES: Tuple[SurfaceSpec, ...] = (
             Consumer('tests/test_serve_failover.py', None,
                      vars=('stats', 'st')),
             Consumer('tests/test_lb_affinity.py', None,
+                     vars=('stats', 'st')),
+            Consumer('tests/test_kv_tier.py', None,
                      vars=('stats', 'st')),
             Consumer('scripts/bench_serve_lb.py', None,
                      vars=('stats',)),

@@ -1,0 +1,284 @@
+"""Paged KV block pool: allocator, refcounts, geometry, host tier.
+
+Extracted from engine.py (ROADMAP item 6's decomposition): the engine
+keeps the public `InferenceEngine` surface and the scheduling logic;
+this module owns the host-side pool bookkeeping —
+
+- :class:`BlockPool`: per-block refcounts, the free list, per-slot
+  block tables and the pool geometry (block size / count).  Pure
+  host-side numpy + python ints; it never touches the device.  All
+  methods run under the ENGINE lock (the pool has no lock of its own —
+  the engine's `_lock` already serializes every allocator call with
+  the dispatch path, and a second lock would only add ordering
+  hazards).
+- :class:`HostKVTier`: the second tier of the pool.  When radix
+  eviction would free a recently-referenced node's block, the engine
+  snapshots the block's rows to host RAM here (asynchronously, via
+  ``copy_to_host_async``) before the block id is recycled; the next
+  radix match restores the rows into fresh pool blocks with
+  ``jax.device_put`` overlapped with the suffix prefill.  Entries are
+  keyed by ``(adapter, token-prefix)`` — the TOPOLOGY-NEUTRAL form:
+  rows are stored as the global ``[L, Hkv, block_size, D]`` array
+  (gathered across chips on spill), so a block spilled from a tp=2
+  replica restores onto tp=1 or tp=4 unchanged.
+
+Refcount discipline is unchanged by the tier: a spill COPIES rows (the
+block is still freed by the ordinary eviction deref), and a restore
+allocates fresh blocks through the ordinary allocator — so the
+``SKYTPU_BLOCK_SANITIZER`` conservation law holds with the tier on.
+"""
+import collections
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Host-tier key: (adapter, token-prefix) for the block's FULL path from
+# the radix root — the same identity the radix tree gives the block, so
+# a restore can only ever resurrect rows for exactly the prefix that
+# produced them.
+TierKey = Tuple[Optional[str], Tuple[int, ...]]
+
+
+class BlockPool:
+    """Host-side allocator for the paged KV cache.  See the module
+    docstring; every method is called under the engine lock."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 max_blocks: int, num_slots: int):
+        self._num_blocks = num_blocks
+        self.block_size = block_size
+        # Blocks a single full-length request spans (table width).
+        self._max_blocks = max_blocks
+        # Refcounts per block (dump block 0 is permanently held), the
+        # free list, and per-slot block tables (+ allocated counts).
+        # Shared prefix blocks simply carry refcount > 1; freeing a
+        # slot decrefs every table entry.
+        self._block_refs = np.zeros((num_blocks,), np.int32)  # guarded-by: engine _lock
+        self._tables_np = np.zeros((num_slots, max_blocks), np.int32)  # guarded-by: engine _lock
+        self._slot_nblocks = np.zeros((num_slots,), np.int32)  # guarded-by: engine _lock
+        self._free_blocks: List[int] = []  # guarded-by: engine _lock
+        self.reset()
+
+    def reset(self) -> None:  # locked: engine
+        """Empty allocator: every block free except the reserved dump
+        block 0 (the quarantine path rebuilds the device pool and
+        resets this bookkeeping wholesale)."""
+        self._block_refs[:] = 0
+        self._block_refs[0] = 1
+        self._free_blocks = list(range(self._num_blocks - 1, 0, -1))
+        self._tables_np[:] = 0
+        self._slot_nblocks[:] = 0
+
+    def _alloc_blocks(self, k: int) -> List[int]:  # locked: engine
+        if k > len(self._free_blocks):
+            # Admission control reserves worst-case demand up front, so
+            # a running slot can never get here; reaching it means the
+            # accounting is broken.
+            raise RuntimeError(
+                f'KV block pool exhausted: need {k}, have '
+                f'{len(self._free_blocks)} free (admission accounting '
+                'bug)')
+        out = [self._free_blocks.pop() for _ in range(k)]
+        for b in out:
+            self._block_refs[b] = 1
+        return out
+
+    def _deref_block(self, b: int) -> None:  # locked: engine
+        if b == 0:
+            return
+        self._block_refs[b] -= 1
+        if self._block_refs[b] == 0:
+            self._free_blocks.append(b)
+
+    def _addref_block(self, b: int) -> None:  # locked: engine
+        """Refcount bump for a holder OTHER than a slot table (the
+        radix tree adopting a finishing slot's prompt blocks)."""
+        self._block_refs[b] += 1
+
+    def _ensure_blocks(self, slot: int, upto: int) -> None:  # locked: engine
+        """Grow the slot's table with fresh private blocks so rows
+        [0, upto) are resident (no-op when already covered)."""
+        need = min(-(-upto // self.block_size), self._max_blocks)
+        cur = int(self._slot_nblocks[slot])
+        if need <= cur:
+            return
+        ids = self._alloc_blocks(need - cur)  # owns-blocks: table
+        self._tables_np[slot, cur:need] = ids
+        self._slot_nblocks[slot] = need
+
+    def _append_shared_blocks(self, slot: int,  # locked: engine
+                              ids: Sequence[int]) -> None:
+        """Append a prefix's full blocks to the slot's table by
+        REFERENCE (refcount bump) — the copy-free prefix hit."""
+        cur = int(self._slot_nblocks[slot])
+        self._tables_np[slot, cur:cur + len(ids)] = ids
+        for b in ids:
+            self._block_refs[b] += 1
+        self._slot_nblocks[slot] = cur + len(ids)
+
+    def _free_slot_blocks(self, slot: int) -> None:  # locked: engine
+        n = int(self._slot_nblocks[slot])
+        for b in self._tables_np[slot, :n]:
+            self._deref_block(int(b))
+        self._tables_np[slot, :] = 0
+        self._slot_nblocks[slot] = 0
+
+
+class HostKVTier:
+    """Bounded host-RAM LRU of spilled KV blocks.  See the module
+    docstring; every method is called under the engine lock.
+
+    Spills are ASYNC: :meth:`spill` only kicks off per-layer
+    ``copy_to_host_async`` transfers and parks the device handles on a
+    pending list — the blocking ``np.asarray`` gather (a no-op once
+    the async copy landed) happens in :meth:`finalize`, which runs at
+    the next probe/export/idle-quiesce, never on the eviction path.
+    The device slices are fresh buffers (snapshotted before the block
+    id is recycled), so a later pool-donating dispatch cannot
+    invalidate them.
+    """
+
+    def __init__(self, budget_bytes: int, block_size: int,
+                 recency_window: int = 0):
+        self.budget_bytes = int(budget_bytes)
+        self.block_size = block_size
+        # Clock-tick window for "recently referenced": an evicted node
+        # older than this is dead-cold traffic not worth the copy.
+        self.recency_window = int(recency_window)
+        # key -> (k_rows, v_rows), each np [L, Hkv, block_size, D] in
+        # cache dtype; insertion order == LRU order (oldest first).
+        self._entries: 'collections.OrderedDict[TierKey, Tuple[np.ndarray, np.ndarray]]' = (
+            collections.OrderedDict())  # guarded-by: engine _lock
+        # (key, [k_dev per layer], [v_dev per layer]) copies in flight.
+        self._pending: List[Tuple[TierKey, list, list]] = []  # guarded-by: engine _lock
+        self._bytes = 0
+        self.stats = {'spills': 0, 'restores': 0, 'lookups': 0,  # guarded-by: engine _lock
+                      'hits': 0, 'evictions': 0, 'dropped': 0}
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    @property
+    def entries(self) -> int:
+        return len(self._entries)
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    def clear(self) -> None:  # locked: engine
+        self._entries.clear()
+        self._pending.clear()
+        self._bytes = 0
+
+    # ------------------------------------------------------------- spill
+
+    def spill(self, key: TierKey, k_slices: list, v_slices: list) -> None:  # locked: engine
+        """Enqueue one block's per-layer device row slices for async
+        host copy.  Non-blocking: the transfer streams while the chips
+        keep serving; finalize() lands it."""
+        for x in k_slices:
+            x.copy_to_host_async()
+        for x in v_slices:
+            x.copy_to_host_async()
+        self._pending.append((key, k_slices, v_slices))
+        self.stats['spills'] += 1
+
+    def finalize(self) -> None:  # locked: engine
+        """Land in-flight spills into the LRU map and trim to budget.
+        np.asarray blocks only until the already-started async copy
+        completes."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        for key, ks, vs in pending:
+            k_rows = np.stack([np.asarray(x) for x in ks])
+            v_rows = np.stack([np.asarray(x) for x in vs])
+            nbytes = k_rows.nbytes + v_rows.nbytes
+            if nbytes > self.budget_bytes:
+                self.stats['dropped'] += 1
+                continue
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[0].nbytes + old[1].nbytes
+            self._entries[key] = (k_rows, v_rows)
+            self._bytes += nbytes
+        while self._bytes > self.budget_bytes and self._entries:
+            _, (k_rows, v_rows) = self._entries.popitem(last=False)
+            self._bytes -= k_rows.nbytes + v_rows.nbytes
+            self.stats['evictions'] += 1
+
+    # ----------------------------------------------------------- restore
+
+    def contains(self, key: TierKey) -> bool:  # locked: engine
+        """Restore probe (counts toward the restore-hit rate)."""
+        self.finalize()
+        self.stats['lookups'] += 1
+        if key in self._entries:
+            self.stats['hits'] += 1
+            return True
+        return False
+
+    def take(self, key: TierKey) -> Optional[Tuple[np.ndarray, np.ndarray]]:  # locked: engine
+        """Pop an entry for restore (the rows move back into pool
+        blocks, so keeping the host copy would just double-count the
+        budget)."""
+        self.finalize()
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self._bytes -= entry[0].nbytes + entry[1].nbytes
+        return entry
+
+    def get(self, key: TierKey) -> Optional[Tuple[np.ndarray, np.ndarray]]:  # locked: engine
+        """Non-destructive read (hot-set export): LRU-touches."""
+        self.finalize()
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+        return entry
+
+    def keys_recent_first(self) -> List[TierKey]:  # locked: engine
+        self.finalize()
+        return list(reversed(self._entries))
+
+    # ------------------------------------------------------------- audit
+
+    def audit(self) -> List[str]:  # locked: engine
+        """Conservation-style self-check for the block sanitizer: the
+        byte ledger must equal the entries it claims to cover, and the
+        budget bound must hold.  Returns error strings (empty = ok)."""
+        self.finalize()
+        errors = []
+        actual = sum(k.nbytes + v.nbytes
+                     for k, v in self._entries.values())
+        if actual != self._bytes:
+            errors.append(
+                f'host tier byte ledger {self._bytes} != entry bytes '
+                f'{actual} (leak across the tier boundary)')
+        if self._bytes > self.budget_bytes:
+            errors.append(
+                f'host tier over budget: {self._bytes} > '
+                f'{self.budget_bytes}')
+        return errors
+
+    def stats_section(self) -> Dict[str, Any]:
+        """kv.host_tier rows (key set mirrored by the engine's
+        disabled-tier branch — wire-contract branch stability).  Read
+        LOCK-FREE from kv_health()/stats() like the other counters, so
+        no finalize here: in-flight copies report as in_flight."""
+        st = self.stats
+        lookups = st['lookups']
+        return {
+            'enabled': True,
+            'budget_bytes': self.budget_bytes,
+            'bytes': self._bytes,
+            'entries': len(self._entries),
+            'spills': st['spills'],
+            'restores': st['restores'],
+            'restore_hit_rate': (st['hits'] / lookups) if lookups else 0.0,
+            'in_flight': len(self._pending),
+            'evictions': st['evictions'],
+        }

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.analysis import sanitizers
+from skypilot_tpu.infer import block_pool as block_pool_mod
 from skypilot_tpu.infer import qos as qos_mod
 from skypilot_tpu.infer import scheduler as scheduler_mod
 from skypilot_tpu.infer.radix import RadixTree
@@ -212,6 +213,18 @@ class InferConfig:
     # have written.  Parity: vLLM automatic-prefix-caching / SGLang
     # RadixAttention at block granularity.
     auto_prefix_cache: bool = False
+    # Host-RAM KV tier (requires auto_prefix_cache): byte budget for
+    # the second tier of the paged pool.  When radix eviction would
+    # free a recently-referenced node's block, its rows are copied to
+    # host RAM asynchronously first (LRU within the budget); the next
+    # radix match restores them with jax.device_put overlapped with
+    # the suffix-only prefill, so the restore latency hides behind
+    # compute the request needs anyway.  The host form is topology-
+    # neutral (global [L, Hkv, block, D] rows), so a block spilled
+    # from a tp=2 replica restores onto tp=1 or tp=4.  0 disables.
+    # Greedy streams stay byte-identical with the tier on or off:
+    # restored rows are exact copies of the spilled cache-dtype rows.
+    host_kv_bytes: int = 0
     # Prefix KV caching: registered prefixes (system prompts) keep
     # their per-layer KV rows resident on device; a request whose
     # prompt starts with a registered prefix prefills ONLY its suffix —
@@ -683,29 +696,29 @@ class InferenceEngine:
             params = jax.tree.map(jnp.asarray, params)
         self.params = params
         b = self.cfg.num_slots
+        self._pool: Optional[block_pool_mod.BlockPool] = None
         if self._paged:
             bs_ = self.cfg.kv_block_size
-            self._max_blocks = self.cfg.max_cache_len // bs_
+            max_blocks = self.cfg.max_cache_len // bs_
             n_blocks = self.cfg.kv_blocks
             if n_blocks is None:
                 # Full provisioning (+1 dump block): admission never
                 # defers, so dense and paged engines schedule
                 # identically — the capacity win comes from RAISING
                 # num_slots over a fixed pool instead.
-                n_blocks = b * self._max_blocks + 1
-            if n_blocks < self._max_blocks + 1:
+                n_blocks = b * max_blocks + 1
+            if n_blocks < max_blocks + 1:
                 raise ValueError(
                     f'kv_blocks ({n_blocks}) must be >= max_cache_len/'
-                    f'kv_block_size + 1 ({self._max_blocks + 1}): one '
+                    f'kv_block_size + 1 ({max_blocks + 1}): one '
                     'full-length request must fit the pool')
-            self._num_blocks = n_blocks
-            # Host-side allocator: refcounts per block (dump block 0 is
-            # permanently held), a free list, and per-slot block tables
-            # (+ allocated counts).  Shared prefix blocks simply carry
-            # refcount > 1; freeing a slot decrefs every table entry.
-            self._block_refs = np.zeros((n_blocks,), np.int32)  # guarded-by: _lock
-            self._tables_np = np.zeros((b, self._max_blocks), np.int32)  # guarded-by: _lock
-            self._slot_nblocks = np.zeros((b,), np.int32)  # guarded-by: _lock
+            # Host-side allocator (infer/block_pool.py): refcounts,
+            # free list, per-slot block tables, pool geometry.  The
+            # engine exposes the historical _block_refs/_tables_np/...
+            # attribute names as read-only properties onto the pool so
+            # the sanitizers and tests keep one accounting view.
+            self._pool = block_pool_mod.BlockPool(n_blocks, bs_,
+                                                  max_blocks, b)
             self.paged_stats = {'deferred': 0, 'prefix_block_hits': 0}  # guarded-by: _lock
         # Automatic radix-tree prefix caching over the pool (None when
         # off).  Must exist before _reset_cache(), which drops the tree
@@ -716,6 +729,21 @@ class InferenceEngine:
                        else None)
         self.radix_stats = {'hits': 0, 'tokens_reused': 0, 'lookups': 0,  # guarded-by: _lock
                             'inserts': 0, 'evictions': 0}
+        if self.cfg.host_kv_bytes < 0:
+            raise ValueError(f'host_kv_bytes must be >= 0 '
+                             f'(got {self.cfg.host_kv_bytes})')
+        # Host-RAM KV tier: second tier of the pool, fed by radix
+        # eviction (so it requires the radix tree).  Survives
+        # _reset_cache() — host copies are keyed by token content, not
+        # pool state, so they stay valid across a quarantine rebuild.
+        self._host_tier = (block_pool_mod.HostKVTier(
+            self.cfg.host_kv_bytes, self.cfg.kv_block_size,
+            recency_window=max(64, 4 * self._pool._num_blocks))
+                           if self._radix is not None
+                           and self.cfg.host_kv_bytes > 0 else None)
+        # Drain-time hot-set handoff counters (export_hot_prefixes /
+        # adopt_prefixes), reported under kv.host_tier.
+        self.handoff_stats = {'exported': 0, 'adopted': 0}  # guarded-by: _lock
         self._reset_cache()
         # Requests dequeued but not admissible yet (paged admission
         # control); always present so the serving loop can poll it
@@ -812,11 +840,7 @@ class InferenceEngine:
                                           self._num_blocks,
                                           self.cfg.kv_block_size,
                                           self.cfg.cache_dtype)
-            self._block_refs[:] = 0
-            self._block_refs[0] = 1
-            self._free_blocks = list(range(self._num_blocks - 1, 0, -1))  # guarded-by: _lock
-            self._tables_np[:] = 0
-            self._slot_nblocks[:] = 0
+            self._pool.reset()
             self._prefixes.clear()
             if self._radix is not None:
                 # The tree's block references die with the pool; the
@@ -1251,9 +1275,22 @@ class InferenceEngine:
                     (jax.lax.with_sharding_constraint(k, pool_sharding),
                      jax.lax.with_sharding_constraint(v, pool_sharding))
                     for k, v in cache]
+
+            # Host-tier restore rows [L, G, Hkv, bs, D]: kv-heads on
+            # dim 2 shard like the pool's dim 1, so a device_put of the
+            # topology-neutral host form lands each chip's head shard
+            # directly (no all-gather on the restore path).  The G dim
+            # varies per call; only the FIXED hkv dim must divide, so
+            # one representative shape fits them all.
+            self._rows_sharding = self._fit_sharding(
+                (len(self.cache), 1) + self.cache[0][0].shape[1:],
+                mesh_lib.named_sharding(self._mesh, None, None,
+                                        'kv_heads', None, None))
         else:
             def pin_pool(cache):
                 return cache
+
+            self._rows_sharding = None
 
         def paged_prefill(params, tokens, starts, true_pos, cache,
                           tables, temps, rng, adapter_ids, want_plp):
@@ -1367,6 +1404,20 @@ class InferenceEngine:
                 new.append((kp.at[dsts].set(kb), vp.at[dsts].set(vb)))
             return pin_pool(new)
 
+        def paged_restore_blocks(cache, dsts, krows, vrows):
+            """Scatter host-restored rows into pool blocks dsts [G]:
+            krows/vrows [L, G, Hkv, bs, D] carry one tier entry per
+            real dst (the host-tier restore / hot-set adoption path).
+            Dispatched ASYNC before the suffix prefill, so the
+            host->device transfer and scatter hide behind compute the
+            request needs anyway.  Pad dsts entries repeat a real dst
+            with identical rows: duplicate scatters are idempotent."""
+            new = []
+            for li, (kp, vp) in enumerate(pin_pool(cache)):
+                new.append((kp.at[dsts].set(krows[li]),
+                            vp.at[dsts].set(vrows[li])))
+            return pin_pool(new)
+
         self._paged_prefill = jax.jit(paged_prefill, donate_argnums=(4,),
                                       static_argnums=(9,))
         self._paged_decode = jax.jit(paged_decode, donate_argnums=(1,),
@@ -1375,6 +1426,8 @@ class InferenceEngine:
                                           donate_argnums=(1,))
         self._paged_copy_blocks = jax.jit(paged_copy_blocks,
                                           donate_argnums=(0,))
+        self._paged_restore_blocks = jax.jit(paged_restore_blocks,
+                                             donate_argnums=(0,))
         self._prefill_insert = jax.jit(prefill_insert, donate_argnums=(4,),
                                        static_argnums=(9,))
         self._chunk_prefill = jax.jit(chunk_prefill, donate_argnums=(4,))
@@ -1388,6 +1441,37 @@ class InferenceEngine:
                                        donate_argnums=(6,))
 
     # ----------------------------------------------------- paged allocator
+    #
+    # The allocator itself lives in infer/block_pool.py (BlockPool);
+    # the engine keeps thin delegates (the scheduling code and the
+    # skycheck block pass track these call sites) and read-only
+    # property views of the pool's arrays under their historical names
+    # (the conservation sanitizer and the paged tests audit through
+    # them).  All still guarded by _lock.
+
+    @property
+    def _block_refs(self):
+        return self._pool._block_refs
+
+    @property
+    def _tables_np(self):
+        return self._pool._tables_np
+
+    @property
+    def _slot_nblocks(self):
+        return self._pool._slot_nblocks
+
+    @property
+    def _free_blocks(self):
+        return self._pool._free_blocks
+
+    @property
+    def _num_blocks(self) -> int:
+        return self._pool._num_blocks if self._pool is not None else 0
+
+    @property
+    def _max_blocks(self) -> int:
+        return self._pool._max_blocks if self._pool is not None else 1
 
     def _nb_bucket(self, needed: int) -> int:
         """Table width (in blocks) for a dispatch: the smallest power
@@ -1400,68 +1484,151 @@ class InferenceEngine:
         return min(nb, self._max_blocks)
 
     def _alloc_blocks(self, k: int) -> List[int]:  # locked: _lock
-        if k > len(self._free_blocks):
-            # Admission control reserves worst-case demand up front, so
-            # a running slot can never get here; reaching it means the
-            # accounting is broken.
-            raise RuntimeError(
-                f'KV block pool exhausted: need {k}, have '
-                f'{len(self._free_blocks)} free (admission accounting '
-                'bug)')
-        out = [self._free_blocks.pop() for _ in range(k)]
-        for b in out:
-            self._block_refs[b] = 1
-        return out
+        return self._pool._alloc_blocks(k)
 
     def _deref_block(self, b: int) -> None:  # locked: _lock
-        if b == 0:
-            return
-        self._block_refs[b] -= 1
-        if self._block_refs[b] == 0:
-            self._free_blocks.append(b)
+        self._pool._deref_block(b)
 
     def _addref_block(self, b: int) -> None:  # locked: _lock
-        """Refcount bump for a holder OTHER than a slot table (the
-        radix tree adopting a finishing slot's prompt blocks)."""
-        self._block_refs[b] += 1
+        self._pool._addref_block(b)
 
     def _evict_radix(self, need: int) -> int:  # locked: _lock
         """Evict unpinned radix LEAVES whose only reference is the
         tree's own (so the deref actually frees a block), LRU-first,
         until `need` blocks freed or nothing evictable remains.
-        Cascades as parents become leaves.  Caller holds the lock."""
+        Cascades as parents become leaves.  Caller holds the lock.
+
+        With the host tier armed, each victim's rows are spilled to
+        host RAM first (recency-gated, async) — the block id is still
+        freed here, so admission headroom is unchanged; only the rows
+        survive, to be restored into FRESH blocks on the next match."""
+        on_evict = (self._spill_blocks if self._host_tier is not None
+                    else None)
         freed = self._radix.evict(need, self._block_refs,
-                                  self._deref_block)
+                                  self._deref_block, on_evict=on_evict)
         self.radix_stats['evictions'] += freed
         return freed
 
-    def _ensure_blocks(self, slot: int, upto: int) -> None:  # locked: _lock
-        """Grow the slot's table with fresh private blocks so rows
-        [0, upto) are resident (no-op when already covered)."""
-        need = min(-(-upto // self.cfg.kv_block_size), self._max_blocks)
-        cur = int(self._slot_nblocks[slot])
-        if need <= cur:
+    def _spill_blocks(self, adapter: Optional[str], node) -> None:  # locked: _lock
+        """Radix-eviction spill hook: snapshot the victim block's rows
+        into the host tier BEFORE the deref recycles the block id.
+        The per-layer slices are fresh device buffers (not views into
+        the donated pool), so later pool-donating dispatches cannot
+        invalidate the in-flight host copy.  Dead-cold victims (not
+        referenced within the tier's recency window) skip the copy —
+        they were evicted because nobody wants them."""
+        tier = self._host_tier
+        if (self._radix.clock - node.last_used) > tier.recency_window:
             return
-        ids = self._alloc_blocks(need - cur)  # owns-blocks: table
-        self._tables_np[slot, cur:need] = ids
-        self._slot_nblocks[slot] = need
+        tokens = RadixTree.path_tokens(node)
+        blk = int(node.block)
+        ks = [kp[blk] for kp, _ in self.cache]
+        vs = [vp[blk] for _, vp in self.cache]
+        tier.spill((adapter, tokens), ks, vs)
+
+    def _ensure_blocks(self, slot: int, upto: int) -> None:  # locked: _lock
+        self._pool._ensure_blocks(slot, upto)
 
     def _append_shared_blocks(self, slot: int,  # locked: _lock
                               ids: Sequence[int]) -> None:
-        """Append a prefix's full blocks to the slot's table by
-        REFERENCE (refcount bump) — the copy-free prefix hit."""
-        cur = int(self._slot_nblocks[slot])
-        self._tables_np[slot, cur:cur + len(ids)] = ids
-        for b in ids:
-            self._block_refs[b] += 1
-        self._slot_nblocks[slot] = cur + len(ids)
+        self._pool._append_shared_blocks(slot, ids)
 
     def _free_slot_blocks(self, slot: int) -> None:  # locked: _lock
-        n = int(self._slot_nblocks[slot])
-        for b in self._tables_np[slot, :n]:
-            self._deref_block(int(b))
-        self._tables_np[slot, :] = 0
-        self._slot_nblocks[slot] = 0
+        self._pool._free_slot_blocks(slot)
+
+    # ------------------------------------------------------- host KV tier
+
+    def _restore_from_tier(self, req: Request, blocks: List[int],
+                           n: int) -> List[int]:  # locked: _lock
+        """Extend a radix match with blocks restored from the host
+        tier: probe successive block-aligned prefixes past the device
+        match, pop the hits, scatter their rows into freshly allocated
+        pool blocks (one async dispatch), and index them in the radix
+        tree — the caller then treats the extended match like any
+        other radix hit, so the restore transfer overlaps the
+        suffix-only prefill it just shortened.
+
+        Admission safety: the k restored blocks are appended to the
+        requesting slot's table by the radix-group start, substituting
+        one-for-one for private blocks the slot's admitted worst-case
+        demand already reserved — free-list headroom backing OTHER
+        running slots' reservations is untouched."""
+        tier = self._host_tier
+        bs_ = self.cfg.kv_block_size
+        limit = (n - 1) // bs_       # >= 1 suffix token must forward
+        keys: List[Any] = []
+        while len(blocks) + len(keys) < limit:
+            j = len(blocks) + len(keys)
+            key = (req.adapter,
+                   tuple(int(t) for t in req.tokens[:(j + 1) * bs_]))
+            if not tier.contains(key):
+                break
+            keys.append(key)
+        # Restore only when a suffix bucket still fits beside the
+        # extended match — otherwise the request would fall back to
+        # full prefill and strand the fresh blocks in the tree,
+        # breaking the one-for-one demand substitution above.
+        while keys:
+            start = (len(blocks) + len(keys)) * bs_
+            if (self._suffix_bucket(start, n - start) is not None
+                    and len(keys) <= len(self._free_blocks)):
+                break
+            keys.pop()
+        if not keys:
+            return blocks
+        rows = [tier.take(k) for k in keys]
+        if any(r is None for r in rows):   # unreachable under _lock
+            return blocks
+        end = (len(blocks) + len(keys)) * bs_
+        ids = self._adopt_host_rows(req.adapter, req.tokens[:end],
+                                    blocks, rows)
+        tier.stats['restores'] += len(ids)
+        return list(blocks) + ids
+
+    def _adopt_host_rows(self, adapter: Optional[str],  # locked: _lock
+                         tokens: Sequence[int],
+                         base_blocks: Sequence[int],
+                         rows) -> List[int]:
+        """Allocate pool blocks for host-serialized rows (tier restore
+        or hot-set adoption), scatter them in with ONE async
+        paged_restore_blocks dispatch, and index them in the radix
+        tree as the continuation of ``base_blocks``.  ``rows`` is a
+        list of (k, v) numpy pairs, each [L, Hkv, bs, D] in cache
+        dtype — the topology-neutral host form; device_put re-shards
+        them under this replica's mesh whatever the exporter's tp."""
+        bs_ = self.cfg.kv_block_size
+        hkv = self.model_config.num_kv_heads
+        hd = self.model_config.head_dim_
+        nl = len(self.cache)  # compile-shape: nl=const
+        dt = np.dtype(self.cfg.cache_dtype)
+        k = len(rows)
+        g = self._nb_bucket(k)
+        ids = self._alloc_blocks(k)  # owns-blocks: radix
+        try:
+            dsts = np.zeros((g,), np.int32)  # jit-ok: g = _nb_bucket(k), pow2-bucketed
+            kbuf = np.zeros((nl, g, hkv, bs_, hd), dt)  # jit-ok: g bucketed
+            vbuf = np.zeros((nl, g, hkv, bs_, hd), dt)  # jit-ok: g bucketed
+            for i in range(g):
+                # Pad lanes repeat the last real entry: duplicate
+                # scatters of identical rows are idempotent.
+                j = min(i, k - 1)
+                dsts[i] = ids[j]
+                kbuf[:, i] = rows[j][0]
+                vbuf[:, i] = rows[j][1]
+            kdev = jax.device_put(kbuf, self._rows_sharding)
+            vdev = jax.device_put(vbuf, self._rows_sharding)
+            with self._ctx():
+                self.cache = self._paged_restore_blocks(
+                    self.cache, jnp.asarray(dsts), kdev, vdev)
+        except BaseException:
+            for b in ids:
+                self._deref_block(b)
+            raise
+        self.radix_stats['inserts'] += self._radix.insert(
+            adapter, tokens, list(base_blocks) + ids,
+            addref=self._addref_block, deref=self._deref_block,
+            own=True)
+        return ids
 
     def _slot_cap_rows(self, n: int, max_new: int) -> int:
         """Worst-case filled rows of a request: prompt + generated
@@ -1555,6 +1722,197 @@ class InferenceEngine:
             'pinned': self._radix.pinned if self._radix else 0,
         }
 
+    def _host_tier_section(self) -> Dict[str, Any]:
+        """kv.host_tier for kv_health()/stats(): one key set whether
+        the tier exists or not, so wire consumers never key-miss on a
+        tierless replica.  Lock-free counter reads, like the rest."""
+        hs = self.handoff_stats
+        t = self._host_tier
+        if t is None:
+            sec = {
+                'enabled': False,
+                'budget_bytes': 0,
+                'bytes': 0,
+                'entries': 0,
+                'spills': 0,
+                'restores': 0,
+                'restore_hit_rate': 0.0,
+                'in_flight': 0,
+                'evictions': 0,
+            }
+        else:
+            sec = t.stats_section()
+        sec['exported'] = hs['exported']
+        sec['adopted'] = hs['adopted']
+        return sec
+
+    def export_hot_prefixes(self, max_prefixes: int = 8,
+                            max_blocks: int = 64) -> Dict[str, Any]:
+        """Serialize the hottest radix prefixes — device tree first
+        (still resident = hottest), then host-tier entries, most
+        recent first — into the topology-neutral wire form
+        adopt_prefixes() accepts: the drain-time hot-set handoff
+        payload (GET /hot_prefixes; the LB orchestrates the transfer
+        to the affinity-ring survivor during drain).  Blocking
+        device→host gathers, so this belongs on the drain path, not
+        the serving fast path."""
+        import base64
+        payload: Dict[str, Any] = {
+            'version': 1,
+            'model': self.cfg.model,
+            'block_size': self.cfg.kv_block_size,
+            'cache_dtype': np.dtype(self.cfg.cache_dtype).name,
+            'num_layers': len(self.cache),
+            'prefixes': [],
+        }
+        if self._radix is None:
+            return payload
+        bs_ = self.cfg.kv_block_size
+        with self._lock:
+            cands = []
+            leaves = [(ad, nd)
+                      for ad, nd in self._radix.walk_adapters()
+                      if not nd.children]
+            leaves.sort(key=lambda x: -x[1].last_used)
+            for ad, nd in leaves:
+                cands.append((ad, RadixTree.path_tokens(nd)))
+            if self._host_tier is not None:
+                cands.extend(self._host_tier.keys_recent_first())
+            # Drop candidates subsumed by an earlier (hotter) one.
+            chosen: List[Tuple[Optional[str], Tuple[int, ...]]] = []
+            for ad, toks in cands:
+                if len(chosen) >= max_prefixes:
+                    break
+                if any(a == ad and t[:len(toks)] == toks
+                       for a, t in chosen):
+                    continue
+                chosen.append((ad, toks))
+            budget = max_blocks
+            for ad, toks in chosen:
+                if budget <= 0:
+                    break
+                path = self._radix.peek(ad, toks, len(toks))
+                recs = []
+                for i in range(min(len(toks) // bs_, budget)):
+                    if i < len(path):
+                        # Device-resident: gather the block's global
+                        # rows across chips.
+                        blk = int(path[i])
+                        k_rows = np.stack([np.asarray(kp[blk])
+                                           for kp, _ in self.cache])
+                        v_rows = np.stack([np.asarray(vp[blk])
+                                           for _, vp in self.cache])
+                    else:
+                        entry = (self._host_tier.get(
+                            (ad, toks[:(i + 1) * bs_]))
+                            if self._host_tier is not None else None)
+                        if entry is None:
+                            break   # hole: a prefix must be contiguous
+                        k_rows, v_rows = entry
+                    recs.append({
+                        'k': base64.b64encode(
+                            k_rows.tobytes()).decode('ascii'),
+                        'v': base64.b64encode(
+                            v_rows.tobytes()).decode('ascii'),
+                    })
+                if not recs:
+                    continue
+                budget -= len(recs)
+                payload['prefixes'].append({
+                    'adapter': ad,
+                    'tokens': [int(t) for t in toks[:len(recs) * bs_]],
+                    'blocks': recs,
+                })
+            self.handoff_stats['exported'] += sum(
+                len(p['blocks']) for p in payload['prefixes'])
+        return payload
+
+    def adopt_prefixes(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Adopt a drained peer's serialized hot prefixes into this
+        engine's radix tree (the POST /adopt_blocks body): mid-stream
+        failover and scale-down then cost a suffix-only prefill
+        instead of a full re-prefill.  Topology-neutral: the rows
+        re-shard under THIS replica's mesh regardless of the
+        exporter's tp degree.  Payload-level mismatches raise
+        ValueError (a client error); per-prefix problems skip."""
+        import base64
+        if self._radix is None:
+            raise ValueError('replica has no radix cache '
+                             '(kv_block_size/auto_prefix_cache off)')
+        if int(payload.get('version', 0)) != 1:
+            raise ValueError(
+                f"unsupported hot-prefix payload version "
+                f"{payload.get('version')!r}")
+        bs_ = self.cfg.kv_block_size
+        if int(payload.get('block_size', 0)) != bs_:
+            raise ValueError(
+                f"block_size mismatch: payload "
+                f"{payload.get('block_size')!r}, engine {bs_}")
+        dt = np.dtype(self.cfg.cache_dtype)
+        if payload.get('cache_dtype') != dt.name:
+            raise ValueError(
+                f"cache_dtype mismatch: payload "
+                f"{payload.get('cache_dtype')!r}, engine {dt.name}")
+        if payload.get('model') != self.cfg.model:
+            raise ValueError(
+                f"model mismatch: payload {payload.get('model')!r}, "
+                f"engine {self.cfg.model!r}")
+        nl = len(self.cache)
+        if int(payload.get('num_layers', 0)) != nl:
+            raise ValueError(
+                f"num_layers mismatch: payload "
+                f"{payload.get('num_layers')!r}, engine {nl}")
+        mc = self.model_config
+        row_shape = (nl, mc.num_kv_heads, bs_, mc.head_dim_)
+        adopted_p = adopted_b = skipped = 0
+        with self._lock:
+            for pref in payload.get('prefixes', []):
+                tokens = [int(t) for t in pref.get('tokens', [])]
+                adapter = pref.get('adapter')
+                if (adapter is not None
+                        and adapter not in self._adapter_names):
+                    skipped += 1
+                    continue
+                try:
+                    rows = []
+                    for rec in pref.get('blocks', []):
+                        k_rows = np.frombuffer(
+                            base64.b64decode(rec['k']),
+                            dt).reshape(row_shape)
+                        v_rows = np.frombuffer(
+                            base64.b64decode(rec['v']),
+                            dt).reshape(row_shape)
+                        rows.append((k_rows, v_rows))
+                except (KeyError, TypeError, ValueError):
+                    skipped += 1
+                    continue
+                nruns = min(len(rows), len(tokens) // bs_)
+                if nruns < 1:
+                    skipped += 1
+                    continue
+                existing = self._radix.match(adapter, tokens,
+                                             nruns * bs_)
+                rows = rows[len(existing):nruns]
+                if not rows:
+                    continue            # already resident
+                # Adopted blocks are cache, not load: never eat into
+                # free-list headroom running slots' admission already
+                # reserved (a mid-flight _alloc_blocks must not fail).
+                headroom = (len(self._free_blocks)
+                            - self._blocks_outstanding())
+                rows = rows[:max(0, headroom)]
+                if not rows:
+                    skipped += 1
+                    continue
+                end = (len(existing) + len(rows)) * bs_
+                ids = self._adopt_host_rows(adapter, tokens[:end],
+                                            existing, rows)
+                adopted_p += 1
+                adopted_b += len(ids)
+            self.handoff_stats['adopted'] += adopted_b
+        return {'adopted_prefixes': adopted_p,
+                'adopted_blocks': adopted_b, 'skipped': skipped}
+
     @property
     def serving(self) -> bool:
         """True while the continuous-batching serving loop is alive
@@ -1596,6 +1954,7 @@ class InferenceEngine:
                 'occupancy': 0.0,
                 'tp': self._tp,
                 'radix': radix,
+                'host_tier': self._host_tier_section(),
             }
         usable = self._num_blocks - 1
         free = len(self._free_blocks)
@@ -1607,6 +1966,7 @@ class InferenceEngine:
             'occupancy': ((usable - free) / usable) if usable else 0.0,
             'tp': self._tp,
             'radix': radix,
+            'host_tier': self._host_tier_section(),
         }
 
     def stats(self) -> Dict[str, Any]:
@@ -1640,6 +2000,7 @@ class InferenceEngine:
                           'per_chip_resident': total * row_bytes // tp},
                 'prefix': prefix,
                 'radix': radix,
+                'host_tier': self._host_tier_section(),
             }
             return {
                 'kv': kv,
@@ -1700,6 +2061,7 @@ class InferenceEngine:
             'admission': {'deferred': self.paged_stats['deferred']},
             'prefix': prefix,
             'radix': radix,
+            'host_tier': self._host_tier_section(),
         }
         return {
             'kv': kv,
@@ -2429,6 +2791,11 @@ class InferenceEngine:
                 # the whole prompt is cached.
                 blocks = self._radix.match(req.adapter, req.tokens,
                                            n - 1)
+                if self._host_tier is not None:
+                    # Extend the match from the host tier: restored
+                    # blocks dispatch async here and the transfer
+                    # hides behind the suffix prefill below.
+                    blocks = self._restore_from_tier(req, blocks, n)
                 if not blocks:
                     rest.append(it)
                     continue
@@ -3823,6 +4190,11 @@ class InferenceEngine:
                         result_cb(res)
                     moved = True
             if not moved:
+                if self._host_tier is not None:
+                    # Land in-flight spill copies while idle so the
+                    # next restore probe never pays the gather.
+                    with self._lock:
+                        self._host_tier.finalize()
                 # Quiesce point: nothing in flight moved this pass, so
                 # the block pool's refcounts must balance exactly,
                 # every jit root's compile count must sit within its
@@ -3833,6 +4205,55 @@ class InferenceEngine:
                 sanitizers.maybe_check_compile_budget(self)
                 sanitizers.maybe_check_shard_layout(self)
                 time.sleep(idle_sleep)
+
+    def warmup(self) -> Dict[str, int]:
+        """Deterministic warmup-on-boot: compile the root x bucket
+        shapes the skycheck COMPILE pass enumerates — one monolithic
+        prefill per configured bucket, both decode-window variants,
+        the chunk kernel, the radix suffix path, and the speculative
+        verify — so a fresh scale-up replica serves its FIRST request
+        at steady-state TTFT instead of paying compiles in-band.
+        Runs through offline generate(): call it BEFORE
+        generate_stream starts (infer/server.py does, gated by
+        --warmup / SKYTPU_SERVE_WARMUP; both bench suites call it in
+        place of their old hand-warm loops)."""
+        dispatches = 0
+        buckets = list(self.cfg.prefill_buckets)
+        for bi, bkt in enumerate(buckets):
+            # Length == bucket lands exactly in that bucket; distinct
+            # token values keep later prompts off the radix fast path
+            # (each bucket must compile the MONOLITHIC prefill).
+            n = min(bkt, self.cfg.max_cache_len - 1)
+            self.generate([Request(tokens=[bi + 2] * n,
+                                   max_new_tokens=2)])
+            dispatches += 1
+        # Decode-window variants + the chunk kernel.
+        self.warmup_decode([1, 2, 3])
+        dispatches += 1
+        # Radix suffix path: anchor one cached block, then re-issue it
+        # with a suffix sized to land in EACH bucket, so the radix-hit
+        # prefill (dynamic start, suffix bucket) compiles for every
+        # suffix shape the COMPILE pass enumerates — not just the
+        # smallest one.
+        if self._radix is not None and buckets:
+            bs_ = self.cfg.kv_block_size
+            base = [2] * bs_
+            if bs_ + 3 <= self.cfg.max_cache_len:
+                self.generate([Request(tokens=base + [3],
+                                       max_new_tokens=2)])
+                dispatches += 1
+                for si, sb in enumerate(buckets):
+                    if bs_ + sb + 2 > self.cfg.max_cache_len:
+                        continue
+                    # Distinct suffix values per bucket keep the match
+                    # pinned at the one shared base block.
+                    sfx = base + [si + 4] * sb
+                    self.generate([Request(tokens=sfx,
+                                           max_new_tokens=2)])
+                    dispatches += 1
+        self._warm_spec(min(max(buckets[0] if buckets else 8, 8), 64))
+        return {'prefill_buckets': len(buckets),
+                'warmup_requests': dispatches}
 
     def warmup_decode(self, tokens: Sequence[int]) -> None:
         """Compile every decode-window variant outside the serving /

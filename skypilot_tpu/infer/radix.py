@@ -70,6 +70,12 @@ class RadixTree:
     def pinned(self) -> int:
         return self._pinned
 
+    @property
+    def clock(self) -> int:
+        """Current LRU tick — compared against a node's ``last_used``
+        to judge recency (the host-tier spill gate)."""
+        return self._clock
+
     def walk(self) -> Iterator[_Node]:
         for level in self._roots.values():
             stack = list(level.values())
@@ -77,6 +83,31 @@ class RadixTree:
                 nd = stack.pop()
                 yield nd
                 stack.extend(nd.children.values())
+
+    def walk_adapters(self) -> Iterator[Tuple[Optional[str], _Node]]:
+        """walk() with adapter identity — the per-root DFS loses which
+        root it started from, which the host tier (keys carry the
+        adapter) and hot-set export need back."""
+        for adapter, level in self._roots.items():
+            stack = list(level.values())
+            while stack:
+                nd = stack.pop()
+                yield adapter, nd
+                stack.extend(nd.children.values())
+
+    @staticmethod
+    def path_tokens(node: _Node) -> Tuple[int, ...]:
+        """The full token prefix a node's path spells (root run first)
+        — the node's topology-neutral identity for the host tier."""
+        runs = []
+        nd: Optional[_Node] = node
+        while nd is not None:
+            runs.append(nd.run)
+            nd = nd.parent
+        out: List[int] = []
+        for run in reversed(runs):
+            out.extend(run)
+        return tuple(out)
 
     # ------------------------------------------------------- operations
 
@@ -114,6 +145,26 @@ class RadixTree:
             if node is None:
                 break
             node.last_used = now
+            out.append(node.block)
+            level = node.children
+        return out
+
+    def peek(self, adapter: Optional[str], tokens: Sequence[int],
+             max_tokens: int) -> List[int]:
+        """match() without the LRU touch or clock tick: a read-only
+        probe for hot-set export/adoption, which must not reshuffle
+        recency while iterating candidates."""
+        bs = self.block_size
+        level = self._roots.get(adapter)
+        limit = min(len(tokens), max_tokens) // bs
+        out: List[int] = []
+        if not level or limit < 1:
+            return out
+        for i in range(limit):
+            run = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            node = level.get(run)
+            if node is None:
+                break
             out.append(node.block)
             level = node.children
         return out
@@ -161,24 +212,35 @@ class RadixTree:
         return created
 
     def evict(self, need: int, block_refs,
-              deref: Callable[[int], None]) -> int:
+              deref: Callable[[int], None],
+              on_evict: Optional[Callable[[Optional[str], _Node],
+                                          None]] = None) -> int:
         """Free up to ``need`` blocks by deleting unpinned LEAF nodes
         whose block refcount is exactly 1 (the tree holds the only
         reference, so the deref actually frees a block), LRU-first.
         Cascades: a parent becomes an eligible leaf once its children
-        are gone.  Returns the number of blocks freed."""
+        are gone.  Returns the number of blocks freed.
+
+        ``on_evict(adapter, node)`` fires BEFORE the deref, while the
+        victim's block rows are still the prefix's — the engine's
+        host-tier spill hook snapshots them there.  The callback must
+        not mutate the tree."""
         freed = 0
         while freed < need:
             victim: Optional[_Node] = None
-            for nd in self.walk():
+            victim_adapter: Optional[str] = None
+            for adapter, nd in self.walk_adapters():
                 if nd.children or nd.pinned:
                     continue
                 if block_refs[nd.block] != 1:
                     continue             # a slot still shares it
                 if victim is None or nd.last_used < victim.last_used:
                     victim = nd
+                    victim_adapter = adapter
             if victim is None:
                 return freed
+            if on_evict is not None:
+                on_evict(victim_adapter, victim)
             # holder is the parent's children dict (or an adapter
             # root), so this single delete detaches the node.
             del victim.holder[victim.run]

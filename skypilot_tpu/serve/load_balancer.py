@@ -40,6 +40,7 @@ opens, drains honored, streams resumed).
 import collections
 import json
 import math
+import os
 import socket
 import threading
 import time
@@ -171,6 +172,10 @@ class SkyTpuLoadBalancer:
         # (1 = unsharded/DP): synced to the controller so the fleet
         # snapshot shows which replicas are tensor-parallel.
         self._replica_tp: Dict[str, int] = {}  # guarded-by: _health_lock
+        # Host-RAM KV tier section each replica advertises through
+        # /healthz kv.host_tier: aggregated into /lb/stats and shown
+        # to the autoscaler/operator as fleet spill/restore pressure.
+        self._replica_host_tier: Dict[str, dict] = {}  # guarded-by: _health_lock
         self._stats_lock = sanitizers.instrument_lock(
             threading.Lock(), 'serve.load_balancer._stats_lock')
         self._counters = {  # guarded-by: _stats_lock
@@ -183,6 +188,11 @@ class SkyTpuLoadBalancer:
             'deadline_exhausted': 0,
             'probe_failures': 0,
             'rate_limited': 0,
+            # Drain-time hot-set handoff (warm failover): transfers
+            # attempted, prefixes adopted by survivors, failures.
+            'hot_handoffs': 0,
+            'handoff_prefixes': 0,
+            'handoff_failures': 0,
         }
         # LB-side QoS plane: per-tenant token buckets (serve/qos.py)
         # share the LB's injected clock so rate-limit tests replay
@@ -238,12 +248,27 @@ class SkyTpuLoadBalancer:
                 self._health[url] = h
             return h
 
+    @staticmethod
+    def _hot_handoff_enabled() -> bool:
+        return os.environ.get('SKYTPU_LB_HOT_HANDOFF', '1'
+                              ).strip().lower() not in ('0', 'false',
+                                                        'no', 'off')
+
     def _mark_draining(self, url: str, draining: bool) -> None:
         h = self._rep(url)
         with self._health_lock:
-            if draining and not h.draining:
+            fresh = draining and not h.draining
+            if fresh:
                 self._bump('drains_honored')
             h.draining = draining
+        if fresh and self._hot_handoff_enabled():
+            # Warm failover: while the drain finishes its in-flight
+            # work, ship the replica's hottest radix prefixes to the
+            # survivors the affinity ring routes them to.  Off-thread:
+            # _mark_draining runs on probe/proxy paths that must not
+            # block on device→host gathers.
+            threading.Thread(target=self._handoff_hot_set, args=(url,),
+                             daemon=True, name='lb-hot-handoff').start()
 
     def _adjust_outstanding(self, url: str, delta: int) -> None:
         h = self._rep(url)
@@ -301,6 +326,10 @@ class SkyTpuLoadBalancer:
             # replicas behind one LB.
             with self._health_lock:
                 self._replica_tp[url] = int(kv.get('tp') or 1)
+            ht = kv.get('host_tier')
+            if isinstance(ht, dict):
+                with self._health_lock:
+                    self._replica_host_tier[url] = dict(ht)
         state = doc.get('status')
         self._mark_draining(url, bool(doc.get('draining')) or
                             state == 'draining')
@@ -322,6 +351,108 @@ class SkyTpuLoadBalancer:
                     return
                 self._probe_replica_once(url)
             self._stop.wait(constants.lb_health_probe_interval())
+
+    # ------------------------------------------------- hot-set handoff
+
+    def _replica_json(self, url: str, path: str,
+                      body: Optional[dict] = None,
+                      timeout: float = 30.0) -> Optional[dict]:
+        """GET (body=None) or POST one JSON document to a replica;
+        None on any failure (connection, non-200, non-JSON)."""
+        parsed = urllib.parse.urlsplit(url)
+        conn = HTTPConnection(parsed.hostname, parsed.port,
+                              timeout=timeout)
+        try:
+            if body is None:
+                conn.request('GET', path,
+                             headers={'Host': parsed.netloc,
+                                      'Connection': 'close'})
+            else:
+                conn.request('POST', path,
+                             body=json.dumps(body).encode(),
+                             headers=self._replica_headers(url))
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return None
+            doc = json.loads(data)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, socket.timeout, HTTPException, ValueError,
+                UnicodeDecodeError):
+            return None
+        finally:
+            conn.close()
+
+    def _handoff_survivor(self, context: RequestContext,
+                          src: str) -> Optional[str]:
+        """Destination for one hot prefix: the affinity ring's owner
+        when it is alive and not draining (the replica its future
+        traffic routes to anyway), else the first usable survivor."""
+        owner = None
+        owner_fn = getattr(self.policy, 'owner_of', None)
+        if callable(owner_fn):
+            try:
+                owner = owner_fn(context)
+            except Exception:  # pylint: disable=broad-except
+                owner = None
+        with self._health_lock:
+            bad = {u for u, h in self._health.items()
+                   if h.draining or not h.breaker.available()}
+        bad.add(src)
+        if owner is not None and owner not in bad:
+            return owner
+        for u in self.policy.ready_replicas:
+            if u not in bad:
+                return u
+        return None
+
+    def _handoff_hot_set(self, src: str) -> None:
+        """Drain-time warm failover: pull the draining replica's hot
+        radix prefixes (GET /hot_prefixes) and ship each to the
+        survivor the affinity ring owns it to (POST /adopt_blocks),
+        so the next matching prompt prefills suffix-only instead of
+        from scratch (~full re-prefill of added p99 saved)."""
+        payload = self._replica_json(src, '/hot_prefixes')
+        if payload is None:
+            self._bump('handoff_failures')
+            return
+        prefixes = payload.get('prefixes')
+        if not isinstance(prefixes, list) or not prefixes:
+            return               # nothing hot to ship — not a failure
+        groups: Dict[str, List[dict]] = {}
+        for p in prefixes:
+            if not isinstance(p, dict) or \
+                    not isinstance(p.get('tokens'), list):
+                continue
+            adapter = p.get('adapter')
+            ctx = RequestContext(
+                tokens=[int(t) for t in p['tokens']],
+                adapter=adapter if isinstance(adapter, str) else None)
+            dst = self._handoff_survivor(ctx, src)
+            if dst is not None:
+                groups.setdefault(dst, []).append(p)
+        if not groups:
+            self._bump('handoff_failures')
+            return
+        header = {k: v for k, v in payload.items() if k != 'prefixes'}
+        shipped = 0
+        failed = False
+        for dst, batch in sorted(groups.items()):
+            doc = dict(header)
+            doc['prefixes'] = batch
+            res = self._replica_json(dst, '/adopt_blocks', body=doc)
+            if res is None:
+                failed = True
+                continue
+            adopted = res.get('adopted_prefixes')
+            shipped += int(adopted) if isinstance(adopted, int) else 0
+        self._bump('hot_handoffs')
+        if shipped:
+            self._bump('handoff_prefixes', shipped)
+        if failed:
+            self._bump('handoff_failures')
+        logger.info('LB: hot-set handoff from %s: %d prefixes adopted '
+                    'across %d survivor(s)', src, shipped, len(groups))
 
     # ------------------------------------------------------ controller sync
 
@@ -983,9 +1114,31 @@ class SkyTpuLoadBalancer:
             outstanding = {u: h.outstanding
                            for u, h in self._health.items()
                            if h.outstanding}
+            tiers = [dict(t) for t in self._replica_host_tier.values()]
+        # Fleet host-tier aggregate: occupancy + spill/restore traffic
+        # summed over tier-enabled replicas, hit rate averaged.
+        host_tier = {'replicas': 0, 'bytes': 0, 'spills': 0,
+                     'restores': 0, 'in_flight': 0, 'evictions': 0,
+                     'restore_hit_rate': 0.0}
+        rates: List[float] = []
+        for ht in tiers:
+            if not ht.get('enabled'):
+                continue
+            host_tier['replicas'] += 1
+            host_tier['bytes'] += int(ht.get('bytes') or 0)
+            host_tier['spills'] += int(ht.get('spills') or 0)
+            host_tier['restores'] += int(ht.get('restores') or 0)
+            host_tier['in_flight'] += int(ht.get('in_flight') or 0)
+            host_tier['evictions'] += int(ht.get('evictions') or 0)
+            rate = ht.get('restore_hit_rate')
+            if isinstance(rate, (int, float)):
+                rates.append(float(rate))
+        if rates:
+            host_tier['restore_hit_rate'] = sum(rates) / len(rates)
         with self._stats_lock:
             counters = dict(self._counters)
         counters.update({
+            'kv_host_tier': host_tier,
             'breaker_opens': breaker_opens,  # wire-ok: operator metrics surface
             'breaker_open_now': open_now,
             'draining_replicas': draining,

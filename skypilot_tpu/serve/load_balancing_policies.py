@@ -317,7 +317,15 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
                    occ >= self._occ_high else 0.0)
         return self._outstanding.get(url, 0) + penalty
 
-    def _load_bound(self, candidates: List[str]) -> float:  # locked: _lock
+    def _load_bound(self, candidates: List[str],
+                    url: str) -> float:  # locked: _lock
+        """Bounded-load cap for routing to ``url`` among
+        ``candidates``.  Classic bounded loads assume a uniform fleet;
+        ours is mixed (TP vs DP replicas), so each replica's share of
+        the total is weighted by its probed tensor-parallel degree —
+        a tp=2 replica serves decode faster than a tp=1 replica and
+        must not be capped at the tp=1 share.  Equal degrees
+        degenerate to the uniform 1/N bound."""
         total = sum(self._outstanding.get(c, 0) for c in candidates)
         rates = []
         for c in candidates:
@@ -327,7 +335,12 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
                 rates.append(float(radix['hit_rate']))
         fleet_hit = sum(rates) / len(rates) if rates else 0.0
         factor = self._load_factor + self._hit_rate_weight * fleet_hit
-        return factor * (total + 1) / len(candidates) + self._load_slack
+        tps: Dict[str, float] = {}
+        for c in candidates:
+            tp = (self._kv.get(c) or {}).get('tp')
+            tps[c] = float(tp) if isinstance(tp, int) and tp > 0 else 1.0
+        share = tps.get(url, 1.0) / sum(tps.values())
+        return factor * (total + 1) * share + self._load_slack
 
     # ------------------------------------------------------- residency
 
@@ -376,9 +389,9 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
             self._keyed += 1
             key = self._route_key(chain)
             owner = self._ring_owner(key)
-            bound = self._load_bound(candidates)
             if owner is not None and owner not in exclude and \
-                    self._eff_load(owner) < bound:
+                    self._eff_load(owner) < \
+                    self._load_bound(candidates, owner):
                 chosen = owner
             else:
                 # Owner dead/draining/tried or over the bound: prefer
@@ -392,7 +405,8 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
                     key=lambda u: (-self._seen_depth(chain, u),
                                    order.get(u, len(order)),
                                    self._eff_load(u)))
-                under = [u for u in ranked if self._eff_load(u) < bound]
+                under = [u for u in ranked if self._eff_load(u) <
+                         self._load_bound(candidates, u)]
                 chosen = under[0] if under else min(
                     candidates, key=self._eff_load)
             self._record_seen(chain, chosen)

@@ -111,6 +111,12 @@ class ReplicaManager:
             # default, so tp replicas shard without the task YAML having
             # to thread the flag into its run command.
             envs['SKYTPU_SERVE_TP_SIZE'] = str(tp_size)
+        # Scale-up replicas boot deterministic-warm: the server's
+        # --warmup default reads this, compiling every enumerated jit
+        # root×bucket shape before declaring ready, so the first
+        # request a fresh replica serves already runs at steady-state
+        # TTFT (no compile storm behind live traffic).
+        envs['SKYTPU_SERVE_WARMUP'] = '1'
         task.update_envs(envs)
         return task
 

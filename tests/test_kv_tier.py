@@ -1,0 +1,474 @@
+"""Host-RAM KV tier: spill/restore identity, cross-topology restore,
+LRU budget, warm failover (tier-1, CPU, tiny model).
+
+The contract under test (infer/block_pool.py + infer/engine.py +
+serve/*): with `host_kv_bytes > 0` the paged pool grows a second tier
+— radix eviction spills recently-referenced blocks' rows to host RAM,
+the next radix match restores them into fresh pool blocks overlapped
+with the suffix-only prefill — and greedy token streams are
+BYTE-IDENTICAL with the tier on or off, through every scheduling path
+(offline, serving, chunked prefill, QoS park/resume).  The host form
+is topology-neutral, so rows spilled from a tp=2 engine restore onto
+a single-chip one.  On drain, the LB ships the hottest prefixes to
+the affinity survivor (GET /hot_prefixes -> POST /adopt_blocks) so
+failover costs a suffix prefill, not a full re-prefill.
+
+Everything here is CPU dryrun on the conftest 8-device virtual
+platform: one tiny 2-layer model, params built ONCE, fixed seeds.
+"""
+import copy
+import json
+import queue
+import threading
+import time
+from http.client import HTTPConnection
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp  # noqa: E402
+
+from skypilot_tpu.infer.block_pool import HostKVTier  # noqa: E402
+from skypilot_tpu.infer.engine import (InferConfig, InferenceEngine,
+                                       Request)  # noqa: E402
+from skypilot_tpu.models.llama import LlamaConfig  # noqa: E402
+from skypilot_tpu.parallel import tp_mesh  # noqa: E402
+
+
+@pytest.fixture(scope='module')
+def tiny_config():
+    return LlamaConfig(name='kv-tier-test', vocab_size=101,
+                       hidden_size=32, intermediate_size=64,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       max_seq_len=128, tie_embeddings=True,
+                       dtype='float32')
+
+
+# A deliberately SMALL pool (12 usable blocks + dump) so radix
+# eviction — the tier's feed — fires under a handful of requests,
+# while admission still holds one worst-case request (8 blocks).
+COMMON = dict(num_slots=2, max_cache_len=64, prefill_buckets=(8, 16, 32),
+              max_new_tokens=8, cache_dtype=jnp.float32, kv_block_size=8,
+              kv_blocks=13, prefill_chunk=8, auto_prefix_cache=True)
+TIER_BYTES = 1 << 20
+
+# Hot prefix: 3 full blocks, re-referenced after eviction.
+HOT = [(5 * j) % 97 + 1 for j in range(24)]
+
+
+@pytest.fixture(scope='module')
+def shared_params(tiny_config):
+    eng = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          rng=jax.random.PRNGKey(0))
+    return eng.params
+
+
+@pytest.fixture(scope='module')
+def tier_pair(tiny_config, shared_params):
+    """(tier-off, tier-on) engines sharing weights and seed.
+
+    Module-scoped: both sides see the SAME request sequence across
+    tests (pytest runs this file in order), so their pools and radix
+    trees evolve identically and identity holds test-to-test.
+    """
+    base = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                           params=shared_params,
+                           rng=jax.random.PRNGKey(7))
+    tiered = InferenceEngine(tiny_config,
+                             InferConfig(host_kv_bytes=TIER_BYTES,
+                                         **COMMON),
+                             params=shared_params,
+                             rng=jax.random.PRNGKey(7))
+    return base, tiered
+
+
+def _reqs(seed, n, max_prompt=30, max_new=8):
+    import random
+    r = random.Random(seed)
+    return [Request(request_id=str(i),
+                    tokens=[r.randrange(1, 101)
+                            for _ in range(r.randrange(9, max_prompt))],
+                    max_new_tokens=r.randrange(1, max_new))
+            for i in range(n)]
+
+
+def _serve(eng, jobs, timeout=120):
+    results, q, stop = {}, queue.Queue(), threading.Event()
+    t = threading.Thread(
+        target=eng.generate_stream,
+        args=(q, lambda res: results.__setitem__(res.request_id, res),
+              stop), daemon=True)
+    t.start()
+    try:
+        for job in jobs:
+            q.put(copy.deepcopy(job))
+        deadline = time.time() + timeout
+        while len(results) < len(jobs) and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert len(results) == len(jobs)
+    return results
+
+
+def _assert_identical(out_a, out_b):
+    for a, b in zip(out_a, out_b):
+        assert a.output_tokens == b.output_tokens
+        assert a.finish_reason == b.finish_reason
+        np.testing.assert_allclose(a.logprobs, b.logprobs, atol=1e-5)
+
+
+def _churn(i):
+    """One distinct 25-token prompt: 3 radix inserts per completion,
+    so a few of these evict the hot prefix out of the 12-block pool."""
+    return Request(tokens=[(7 * j + 11 * i) % 97 + 1 for j in range(25)],
+                   max_new_tokens=4)
+
+
+# ------------------------------------------------------ tier identity
+
+
+def test_tier_offline_spill_restore_identity(tier_pair):
+    """The full tier round trip, offline: seed the hot prefix, churn
+    it out of the pool (spill), re-reference it (restore) — greedy
+    tokens AND logprobs byte-identical to the tierless engine, which
+    full-prefills what the tiered one restores."""
+    base, tiered = tier_pair
+    phases = ([Request(tokens=HOT + [50], max_new_tokens=4)],
+              [_churn(i) for i in range(4)],
+              [Request(tokens=HOT + [60], max_new_tokens=4)])
+    for reqs in phases:
+        out_b = base.generate([copy.deepcopy(r) for r in reqs])
+        out_t = tiered.generate([copy.deepcopy(r) for r in reqs])
+        _assert_identical(out_b, out_t)
+    ht = tiered.stats()['kv']['host_tier']
+    assert ht['enabled'] and ht['budget_bytes'] == TIER_BYTES
+    assert ht['spills'] > 0, 'churn never fed the tier'
+    assert ht['restores'] > 0, 'hot prefix never restored'
+    assert ht['restore_hit_rate'] > 0.0
+    assert ht['exported'] == 0 and ht['adopted'] == 0  # no handoff yet
+    # The tierless engine reports the same wire keys, all inert.
+    ht = base.stats()['kv']['host_tier']
+    assert not ht['enabled'] and ht['entries'] == 0
+    # Host-side allocator state identical: the tier substitutes
+    # restored blocks one-for-one inside admitted reservations.
+    assert (tiered.stats()['blocks_allocated'] ==
+            base.stats()['blocks_allocated'])
+
+
+def test_tier_serving_chunked_identity(tier_pair):
+    """Bursty serving with hot-prefix re-references interleaved into
+    random churn, prompts beyond the largest bucket (32) so the
+    chunked-prefill path runs: same scheduling, same bytes."""
+    base, tiered = tier_pair
+    jobs = _reqs(11, 6, max_prompt=45)
+    jobs.insert(0, Request(request_id='hot0', tokens=HOT + [70],
+                           max_new_tokens=4))
+    jobs.append(Request(request_id='hot1', tokens=HOT + [71],
+                        max_new_tokens=4))
+    res_b = _serve(base, jobs)
+    res_t = _serve(tiered, jobs)
+    for job in jobs:
+        a, b = res_b[job.request_id], res_t[job.request_id]
+        assert a.output_tokens == b.output_tokens, job.request_id
+        assert a.finish_reason == b.finish_reason
+    assert (tiered.stats()['blocks_allocated'] ==
+            base.stats()['blocks_allocated'])
+    # Conservation holds across the tier boundary (raises on any
+    # leak/double-free; the host tier's byte audit is folded in).
+    from skypilot_tpu.analysis.sanitizers import check_block_conservation
+    rep = check_block_conservation(tiered)
+    assert rep['host_tier_entries'] == tiered.kv_health(
+        )['host_tier']['entries']
+
+
+def test_tier_qos_park_resume_identity(tiny_config, shared_params):
+    """QoS preemption over the tiered pool: a part-prefilled batch
+    prompt parks for an interactive arrival and resumes suffix-only —
+    byte-identical to the tierless engine under the same faults.
+    Park/resume is pure host bookkeeping; the tier must not perturb
+    it (spills key on token content, not slot state)."""
+    from skypilot_tpu.infer.faults import FaultPlan, FaultSpec
+    qos_cfg = dict(num_slots=1, max_cache_len=128,
+                   prefill_buckets=(8, 16), max_new_tokens=8,
+                   cache_dtype=jnp.float32, kv_block_size=8,
+                   prefill_chunk=8, auto_prefix_cache=True, qos=True)
+    engines = [InferenceEngine(tiny_config,
+                               InferConfig(host_kv_bytes=hb, **qos_cfg),
+                               params=shared_params,
+                               rng=jax.random.PRNGKey(7))
+               for hb in (0, TIER_BYTES)]
+    batch = Request(request_id='batch',
+                    tokens=[(3 * j) % 97 + 1 for j in range(60)],
+                    max_new_tokens=8, priority='batch')
+    inter = Request(request_id='inter', tokens=[9, 4, 2, 8],
+                    max_new_tokens=8, priority='interactive')
+    outs = []
+    for eng in engines:
+        # Stall every loop pass so the interactive arrival
+        # deterministically lands while the batch prompt is mid-chunk.
+        eng.arm_faults(FaultPlan(seed=0, specs=[
+            FaultSpec(site='stall', prob=1.0, stall_s=0.03)]))
+        results, q, stop = {}, queue.Queue(), threading.Event()
+        t = threading.Thread(
+            target=eng.generate_stream,
+            args=(q, lambda r: results.__setitem__(r.request_id, r),
+                  stop), daemon=True)
+        t.start()
+        try:
+            q.put(copy.deepcopy(batch))
+            deadline = time.time() + 60
+            while not eng._chunking and time.time() < deadline:
+                time.sleep(0.002)
+            assert eng._chunking, 'batch prompt never started chunking'
+            q.put(copy.deepcopy(inter))
+            while len(results) < 2 and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+            eng.disarm_faults()
+        assert len(results) == 2, results.keys()
+        assert eng.qos_stats['preemptions'] >= 1
+        outs.append(results)
+    for rid in ('batch', 'inter'):
+        assert (outs[0][rid].output_tokens ==
+                outs[1][rid].output_tokens), rid
+        assert outs[0][rid].finish_reason == outs[1][rid].finish_reason
+
+
+# --------------------------------------------- cross-topology restore
+
+
+def test_tp2_spill_restores_onto_single_chip(tiny_config,
+                                             shared_params):
+    """The host form is topology-neutral: blocks spilled from a tp=2
+    engine (rows gathered global across chips) export through the
+    hot-prefix wire form and adopt onto a tp=1 engine, whose greedy
+    output over the restored prefix matches a cold engine exactly."""
+    tp = InferenceEngine(tiny_config,
+                         InferConfig(host_kv_bytes=TIER_BYTES, **COMMON),
+                         params=shared_params,
+                         rng=jax.random.PRNGKey(7), mesh=tp_mesh(2))
+    # Seed the hot prefix, churn it into the host tier.
+    tp.generate([Request(tokens=HOT + [50], max_new_tokens=4)])
+    tp.generate([_churn(i) for i in range(4)])
+    ht = tp.kv_health()['host_tier']
+    assert ht['spills'] > 0 and ht['entries'] > 0
+    payload = tp.export_hot_prefixes(max_prefixes=16)
+    assert payload['version'] == 1
+    ht = tp.kv_health()['host_tier']
+    assert ht['exported'] > 0
+    # Ship ONLY the evicted hot set: these blocks live in the host
+    # tier (spilled from tp=2), not the device tree, so the adoption
+    # below is host-form tp=2 rows landing on a tp=1 pool.
+    payload['prefixes'] = [p for p in payload['prefixes']
+                           if p['tokens'][:8] == HOT[:8]]
+    assert payload['prefixes'], 'hot prefix never exported'
+
+    single = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                             params=shared_params,
+                             rng=jax.random.PRNGKey(7))
+    res = single.adopt_prefixes(json.loads(json.dumps(payload)))
+    assert res['adopted_blocks'] >= 2
+    ht = single.kv_health()['host_tier']
+    assert ht['adopted'] == res['adopted_blocks']
+
+    probe = Request(tokens=HOT + [80], max_new_tokens=4)
+    cold = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                           params=shared_params,
+                           rng=jax.random.PRNGKey(7))
+    out_cold = cold.generate([copy.deepcopy(probe)])
+    hits0 = single.radix_stats['hits']
+    out_single = single.generate([copy.deepcopy(probe)])
+    assert single.radix_stats['hits'] > hits0, \
+        'adopted prefix never hit'
+    _assert_identical(out_cold, out_single)
+    # And the spilling engine itself restores onto tp=2: same host
+    # entries, re-sharded across two chips this time.
+    restores0 = tp.kv_health()['host_tier']['restores']
+    out_tp = tp.generate([copy.deepcopy(probe)])
+    assert tp.kv_health()['host_tier']['restores'] > restores0
+    _assert_identical(out_cold, out_tp)
+
+
+def test_adopt_rejects_mismatched_payload(tiny_config, shared_params):
+    eng = InferenceEngine(tiny_config, InferConfig(**COMMON),
+                          params=shared_params,
+                          rng=jax.random.PRNGKey(7))
+    good = {'version': 1, 'model': eng.cfg.model, 'block_size': 8,
+            'cache_dtype': 'float32', 'num_layers': 2, 'prefixes': []}
+    assert eng.adopt_prefixes(dict(good)) == {
+        'adopted_prefixes': 0, 'adopted_blocks': 0, 'skipped': 0}
+    for bad in ({'version': 2}, {'block_size': 16},
+                {'cache_dtype': 'bfloat16'}, {'num_layers': 3},
+                {'model': 'other-model'}):
+        with pytest.raises(ValueError):
+            eng.adopt_prefixes({**good, **bad})
+
+
+# ------------------------------------------------- tier LRU mechanics
+
+
+def _fake_block(fill, layers=2, hkv=2, bs=8, d=4):
+    ks = [jnp.full((hkv, bs, d), float(fill), jnp.float32)
+          for _ in range(layers)]
+    return ks, [x + 1 for x in ks]
+
+
+def test_host_tier_budget_lru_eviction():
+    """The tier is a bounded LRU: per-entry bytes are 2 layers x
+    [2, 8, 4] f32 k+v = 1 KiB, budget 2 KiB holds exactly two — the
+    third spill evicts the LRU entry, and the byte ledger audits
+    clean through spill, eviction, and take."""
+    tier = HostKVTier(2048, 8)
+    for i in range(3):
+        ks, vs = _fake_block(i)
+        tier.spill((None, (i,)), ks, vs)
+    assert tier.in_flight == 3          # async: nothing landed yet
+    assert not tier.contains((None, (0,)))   # finalizes: LRU evicted
+    assert tier.contains((None, (1,))) and tier.contains((None, (2,)))
+    assert tier.entries == 2 and tier.bytes_used == 2048
+    assert tier.stats['evictions'] == 1 and tier.stats['spills'] == 3
+    assert tier.audit() == []
+    # contains() LRU state: get() touches, take() pops and refunds.
+    k_rows, v_rows = tier.take((None, (1,)))
+    assert k_rows.shape == (2, 2, 8, 4)
+    np.testing.assert_array_equal(v_rows, k_rows + 1)
+    assert tier.entries == 1 and tier.bytes_used == 1024
+    assert tier.audit() == []
+
+
+def test_host_tier_drops_oversized_entry():
+    tier = HostKVTier(512, 8)           # smaller than one entry
+    ks, vs = _fake_block(3)
+    tier.spill((None, (3,)), ks, vs)
+    tier.finalize()
+    assert tier.entries == 0 and tier.stats['dropped'] == 1
+    assert tier.audit() == []
+
+
+# ------------------------------------------------ drain warm failover
+
+
+def _post_generate(port, payload, timeout=60):
+    conn = HTTPConnection('127.0.0.1', port, timeout=timeout)
+    try:
+        conn.request('POST', '/generate',
+                     body=json.dumps(payload).encode(),
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200, (resp.status, body)  # zero 5xx
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
+def test_drain_hot_handoff_warm_failover(tiny_config, shared_params,
+                                         monkeypatch):
+    """Drain a replica whose radix holds the hot prefix: the LB ships
+    the hot set to the survivor (GET /hot_prefixes -> POST
+    /adopt_blocks), and the next hot request 200s off the survivor's
+    ADOPTED blocks — a radix hit where a cold failover would full
+    re-prefill — byte-identical greedy, zero 5xx, all four sanitizers
+    armed throughout and swept explicitly at the end."""
+    from skypilot_tpu.analysis.sanitizers import (
+        check_block_conservation, check_compile_budget,
+        check_shard_layout)
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    monkeypatch.setenv('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    monkeypatch.setenv('SKYTPU_SANITIZERS', '1')   # umbrella: all four
+
+    def make_engine():
+        return InferenceEngine(tiny_config,
+                               InferConfig(host_kv_bytes=TIER_BYTES,
+                                           **COMMON),
+                               params=shared_params,
+                               rng=jax.random.PRNGKey(0))
+
+    fleet = ChaosFleet(make_engine, 2)
+    fleet.start()
+    try:
+        # Warm the hot prefix through the LB; remember who served it.
+        doc = _post_generate(fleet.lb.port,
+                             {'tokens': HOT + [50], 'max_new_tokens': 4})
+        ref_warm = doc['output_tokens']
+        src = next(r for r in fleet.replicas
+                   if r.server.engine.radix_stats['inserts'] > 0)
+        dst = next(r for r in fleet.replicas if r is not src)
+        # Byte-exact references from the same fleet, pre-drain (greedy
+        # is schedule- and replica-independent: shared params).
+        assert _post_generate(
+            fleet.lb.port,
+            {'tokens': HOT + [50], 'max_new_tokens': 4},
+        )['output_tokens'] == ref_warm
+        ref_probe = _post_generate(
+            dst.port, {'tokens': [33, 44, 55] * 4,
+                       'max_new_tokens': 4})['output_tokens']
+
+        conn = HTTPConnection('127.0.0.1', src.port, timeout=10)
+        conn.request('POST', '/drain', body=b'{"deadline_s": 30}')
+        resp = conn.getresponse()
+        assert resp.status == 200 and json.loads(resp.read())['draining']
+        conn.close()
+        # The LB's next probe sees the drain and ships the hot set.
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                dst.server.engine.handoff_stats['adopted'] == 0:
+            time.sleep(0.05)
+        assert dst.server.engine.handoff_stats['adopted'] > 0, \
+            fleet.lb.lb_stats()
+        ht = dst.server.engine.kv_health()['host_tier']
+        assert ht['adopted'] >= 3        # the 3-block hot prefix
+
+        # Hot traffic during the drain: 200 at the LB, served off the
+        # survivor's adopted blocks (radix hit, not a re-prefill).
+        hits0 = dst.server.engine.radix_stats['hits']
+        doc = _post_generate(fleet.lb.port,
+                             {'tokens': HOT + [50], 'max_new_tokens': 4})
+        assert doc['output_tokens'] == ref_warm      # zero failed greedy
+        assert dst.server.engine.radix_stats['hits'] > hits0
+        # Cold traffic stays correct too.
+        assert _post_generate(
+            fleet.lb.port, {'tokens': [33, 44, 55] * 4,
+                            'max_new_tokens': 4},
+        )['output_tokens'] == ref_probe
+
+        st = fleet.lb.lb_stats()
+        assert st['hot_handoffs'] >= 1
+        assert st['handoff_prefixes'] >= 1
+        assert st['handoff_failures'] == 0
+        assert st['drains_honored'] >= 1
+        # Fleet-aggregate tier rows flow through /lb/stats.
+        agg = st['kv_host_tier']
+        assert agg['replicas'] >= 1
+
+        # Explicit end-of-sweep sanitizer pass over both engines (the
+        # lock sanitizer ran inline on every instrumented acquire;
+        # conservation raises on any leak or double-free).
+        for r in fleet.replicas:
+            check_block_conservation(r.server.engine)
+            check_compile_budget(r.server.engine)
+            check_shard_layout(r.server.engine)
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------- wire-row readers
+
+
+def test_stats_host_tier_rows_complete(tier_pair):
+    """Every kv.host_tier wire row is present on BOTH sides of the
+    enabled branch — probes and dashboards must never key-miss on a
+    tierless replica."""
+    keys = {'enabled', 'budget_bytes', 'bytes', 'entries', 'spills',
+            'restores', 'restore_hit_rate', 'in_flight', 'evictions',
+            'exported', 'adopted'}
+    for eng in tier_pair:
+        for ht in (eng.kv_health()['host_tier'],
+                   eng.stats()['kv']['host_tier']):
+            assert set(ht) == keys
+            assert ht['bytes'] <= ht['budget_bytes'] or not ht['enabled']

@@ -204,6 +204,53 @@ def test_hit_rate_raises_the_load_bound(monkeypatch):
     p.request_done(owner)
 
 
+def test_tp_weighted_load_bound(monkeypatch):
+    """The bounded-load cap weights each replica's share by its probed
+    tensor-parallel degree: a tp=1 owner among tp=4 peers is capped
+    BELOW the uniform 1/N bound (it serves decode slowest, so the
+    classic bound would pin traffic on the slowest replica), while a
+    tp=4 owner may carry its larger share.  Equal degrees degenerate
+    to the old uniform bound exactly."""
+    monkeypatch.setenv('SKYTPU_SERVE_AFFINITY_LOAD_SLACK', '0')
+    monkeypatch.setenv('SKYTPU_SERVE_AFFINITY_LOAD_FACTOR', '3.0')
+    p = _policy()
+    ctx = _ctx(13, 64)
+    owner = p.owner_of(ctx)
+    # tp=1 owner, tp=4 peers: share 1/9.  At eff_load 1 (total 1) the
+    # uniform bound 3*(1+1)/3 = 2 would keep the owner; the weighted
+    # bound 3*(1+1)/9 = 0.67 spills it to a faster peer.
+    for u in URLS:
+        p.observe_replica(u, {'kv': {'tp': 1 if u == owner else 4}})
+    with p._lock:
+        p._outstanding[owner] = 1
+    pick = p.select_replica(context=ctx)
+    assert pick != owner
+    p.request_done(pick)
+    # Inverse fleet — tp=4 owner, share 4/6: eff_load 6 sits at the
+    # uniform bound's edge (3*(6+1)/3 = 7) but well inside the
+    # weighted one (3*(6+1)*4/6 = 14): affinity holds on the replica
+    # that can actually absorb the load.
+    for u in URLS:
+        p.observe_replica(u, {'kv': {'tp': 4 if u == owner else 1}})
+    with p._lock:
+        for u in URLS:
+            p._outstanding[u] = 0
+        p._outstanding[owner] = 6
+    assert p.select_replica(context=ctx) == owner
+    p.request_done(owner)
+    # Equal degrees (tp=2 everywhere): shares collapse to 1/3 and the
+    # bound is numerically the uniform one — 3*(8+1)/3 = 9 > 8 keeps
+    # the owner at the same load the pre-tp code would have kept it.
+    for u in URLS:
+        p.observe_replica(u, {'kv': {'tp': 2}})
+    with p._lock:
+        for u in URLS:
+            p._outstanding[u] = 0
+        p._outstanding[owner] = 8
+    assert p.select_replica(context=ctx) == owner
+    p.request_done(owner)
+
+
 # ------------------------------------------- failover prefers warm prefix
 
 

@@ -784,9 +784,58 @@ def test_block_radix_and_entry_sinks_clean():
     assert findings == []
 
 
+def test_block_spill_sink_clean():
+    """The host tier is a legal sink: handing allocated blocks across
+    the tier boundary via self._host_tier.spill(...) releases them."""
+    findings = _block('''
+        def f(self, key):
+            ids = self._alloc_blocks(2)  # owns-blocks: spill
+            self._host_tier.spill(key, ids, ids)
+    ''')
+    assert findings == []
+
+
+def test_block_spill_leak_on_early_return():
+    """Blocks annotated for the tier that skip the spill on some path
+    leak across the tier boundary: BLOCK001."""
+    findings = _block('''
+        def f(self, key, flag):
+            ids = self._alloc_blocks(2)  # owns-blocks: spill
+            if flag:
+                return None
+            self._host_tier.spill(key, ids, ids)
+    ''')
+    assert _ids(findings) == ['BLOCK001']
+
+
+def test_block_spill_then_deref_is_double_free():
+    """Once the tier owns the blocks, a deref on this side of the
+    boundary is a double release: BLOCK002."""
+    findings = _block('''
+        def f(self, key):
+            ids = self._alloc_blocks(2)  # owns-blocks: spill
+            self._host_tier.spill(key, ids, ids)
+            for b in ids:
+                self._deref_block(b)
+    ''')
+    assert _ids(findings) == ['BLOCK002']
+    assert 'already released' in findings[0].message
+
+
+def test_block_spill_restricted_by_annotation():
+    findings = _block('''
+        def f(self, key):
+            ids = self._alloc_blocks(2)  # owns-blocks: table
+            self._host_tier.spill(key, ids, ids)
+    ''')
+    assert _ids(findings) == ['BLOCK002']
+    assert 'not permitted' in findings[0].message
+
+
 def test_block_real_tree_clean():
-    """engine.py/radix.py prove every alloc reaches exactly one sink
-    on all paths (the two PR-9 leak fixes hold)."""
+    """engine.py/radix.py/block_pool.py prove every alloc reaches
+    exactly one sink on all paths (the two PR-9 leak fixes hold, and
+    the pool extraction kept the accounting provable)."""
     for rel in block_lifecycle.OWNED_FILES:
         with open(os.path.join(REPO, rel), encoding='utf-8') as f:
             text = f.read()
