@@ -551,6 +551,189 @@ def run_affinity_bench(out: str, n_replicas: int = 3, groups: int = 8,
     print(f'wrote {out}')
 
 
+# --------------------------------------------- gray-failure section
+
+
+def _gray_env(overrides: dict):
+    """Apply SKYTPU_LB_* knob overrides for one arm; returns a restore
+    callable.  The LB reads these at construction (hedge deadline) and
+    at breaker materialisation (probation knobs), so they must be in
+    place before the fleet is built."""
+    import os
+    saved = {k: os.environ.get(k) for k in overrides}
+    for k, v in overrides.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+
+    def restore():
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+    return restore
+
+
+def run_gray_bench(out: str, n_replicas: int = 3,
+                   requests_per_arm: int = 90, lanes: int = 3,
+                   delay_s: float = 0.35) -> None:
+    """Gray-failure TTFT bench: one replica of a 3-replica fleet rots
+    (every response chunk delayed ~`delay_s` by a seeded network proxy
+    — alive, never failing, just slow) while client lanes stream
+    through the LB.
+
+    Two arms over the same request set:
+      `no_ejection`     probation disabled (outlier threshold set
+                        unreachable) and hedging off — the LB keeps
+                        routing the degraded replica its full share,
+                        so fleet p99 TTFT inherits the degradation.
+      `ejection_hedge`  default probation knobs + TTFT hedging
+                        (SKYTPU_LB_HEDGE_MS): a stream with no first
+                        byte by the deadline is hedged to the
+                        next-best replica, and the latency-outlier
+                        track sheds the degraded replica to trickle
+                        weight.
+
+    The claim under measurement: hedging + probation cut fleet p99
+    TTFT vs the no-ejection baseline, and rescue may NEVER change
+    tokens — greedy outputs byte-identical per prompt across arms.
+    Writes BENCH_SERVE_r09.json.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import FaultPlan, FaultSpec, InferConfig
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    from skypilot_tpu.infer.engine import InferenceEngine
+    from skypilot_tpu.models.llama import LlamaConfig
+
+    os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    mc = LlamaConfig(name='graybench-t', vocab_size=101, hidden_size=32,
+                     intermediate_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, max_seq_len=128,
+                     tie_embeddings=True, dtype='float32')
+    cfg = InferConfig(num_slots=4, max_cache_len=64,
+                      prefill_buckets=(8, 16, 32), max_new_tokens=16,
+                      cache_dtype=jnp.float32, decode_steps=4,
+                      kv_block_size=8, auto_prefix_cache=True)
+
+    def make_engine():
+        eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+        eng.warmup()
+        return eng
+
+    prompts = [[(11 * i + 5 * j) % 97 + 1 for j in range(10)]
+               for i in range(6)]
+
+    def run_arm(name: str, env: dict):
+        restore = _gray_env(env)
+        fleet = None
+        try:
+            fleet = ChaosFleet(make_engine, n_replicas)
+            fleet.start()
+            plan = FaultPlan(seed=1, specs=[
+                FaultSpec(site='net_degrade', prob=1.0,
+                          delay_s=delay_s, jitter_s=0.05)])
+            proxy = fleet.degrade_one(0, plan, seed=1)
+            ttfts, outputs, errors = [], {}, []
+            lock = threading.Lock()
+            pending = list(range(requests_per_arm))
+
+            def lane():
+                while True:
+                    with lock:
+                        if not pending or errors:
+                            return
+                        i = pending.pop()
+                    key = i % len(prompts)
+                    try:
+                        ttft, toks = _affinity_ttft_stream(
+                            fleet.lb.port, prompts[key], max_new=8)
+                    except Exception as e:  # pylint: disable=broad-except
+                        with lock:
+                            errors.append(f'req {i}: {e}')
+                        return
+                    with lock:
+                        ttfts.append(ttft)
+                        if outputs.setdefault(key, toks) != toks:
+                            errors.append(f'divergence at prompt {key}')
+
+            threads = [threading.Thread(target=lane, daemon=True)
+                       for _ in range(lanes)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            if errors:
+                raise RuntimeError(f'gray arm {name}: {errors[:3]}')
+            stats = fleet.lb.lb_stats()
+            vals = sorted(ttfts)
+
+            def pct(p):
+                return vals[min(len(vals) - 1, int(len(vals) * p))]
+
+            row = {
+                'arm': name,
+                'requests': len(vals),
+                'degraded_replicas': 1,
+                'chunk_delay_s': delay_s,
+                'ttft_p50_s': statistics.median(vals),
+                'ttft_p95_s': pct(0.95),
+                'ttft_p99_s': pct(0.99),
+                'hedges': stats['hedges'],
+                'hedge_wins': stats['hedge_wins'],
+                'hedge_cancelled': stats['hedge_cancelled'],
+                'probation_replicas': stats['probation_replicas'],
+                'degraded_in_probation':
+                    proxy.url in stats['probation_replicas'],
+                'chunks_delayed': proxy.chunks_delayed,
+            }
+            print(json.dumps(row), flush=True)
+            return row, outputs
+        finally:
+            if fleet is not None:
+                fleet.stop()
+            restore()
+
+    arms = [
+        ('no_ejection', {'SKYTPU_LB_PROBATION_K': '1e9',
+                         'SKYTPU_LB_HEDGE_MS': None}),
+        ('ejection_hedge', {'SKYTPU_LB_PROBATION_K': None,
+                            'SKYTPU_LB_HEDGE_MS': '250'}),
+    ]
+    rows, outs = {}, {}
+    for name, env in arms:
+        print(f'-- gray arm={name}', flush=True)
+        rows[name], outs[name] = run_arm(name, env)
+    if outs['ejection_hedge'] != outs['no_ejection']:
+        raise RuntimeError('greedy outputs diverged between gray arms')
+    summary = {
+        'p99_no_ejection_s': rows['no_ejection']['ttft_p99_s'],
+        'p99_ejection_hedge_s': rows['ejection_hedge']['ttft_p99_s'],
+        'p99_speedup':
+            rows['no_ejection']['ttft_p99_s'] /
+            rows['ejection_hedge']['ttft_p99_s'],
+        'p99_improved':
+            rows['ejection_hedge']['ttft_p99_s'] <
+            rows['no_ejection']['ttft_p99_s'],
+        'outputs_byte_identical': True,
+    }
+    print(json.dumps(summary), flush=True)
+    try:
+        doc = json.load(open(out))
+    except (FileNotFoundError, ValueError):
+        doc = {}
+    doc['gray_failure'] = {'rows': list(rows.values()),
+                           'summary': summary, 'model': 'tiny-cpu',
+                           'measured_at': 'load_balancer_endpoint'}
+    json.dump(doc, open(out, 'w'), indent=2)
+    print(f'wrote {out}')
+
+
 # ------------------------------------------------------ qos section
 
 
@@ -800,6 +983,13 @@ def main() -> None:
     parser.add_argument('--affinity-replicas', type=int, default=3)
     parser.add_argument('--affinity-groups', type=int, default=8)
     parser.add_argument('--affinity-per-group', type=int, default=6)
+    parser.add_argument('--gray', action='store_true',
+                        help='run the gray-failure ejection/hedging '
+                             'TTFT section (in-process fleet, '
+                             'CPU-friendly)')
+    parser.add_argument('--gray-requests', type=int, default=90,
+                        help='requests per gray arm (p99 needs enough '
+                             'draws)')
     parser.add_argument('--qos', action='store_true',
                         help='run the 2x-overload QoS protection '
                              'section (in-process fleet, CPU-friendly)')
@@ -811,6 +1001,10 @@ def main() -> None:
     if args.failover:
         run_failover_bench(args.failover_iters,
                            args.out or 'BENCH_SERVE_r06.json')
+        return
+    if args.gray:
+        run_gray_bench(args.out or 'BENCH_SERVE_r09.json',
+                       requests_per_arm=args.gray_requests)
         return
     if args.qos:
         run_qos_bench(args.out or 'BENCH_SERVE_r08.json',

@@ -24,6 +24,17 @@ run** — zero failed requests under replica preemption — plus a drain
 exercise asserting a draining replica finishes its in-flight stream
 while the LB answers zero 5xx.
 
+The multi-replica sweep ends with two control-plane legs (PR 18):
+
+  3. **LB kill + warm restart** — the load balancer itself is killed
+     mid-traffic and restarted on the same port with its journal
+     re-adopted; clients retry connection errors, and every request
+     must still land byte-identical (zero lost through the outage).
+  4. **Gray-failure probation** — one replica is wrapped in a seeded
+     latency-injection proxy (``net_degrade`` site); the LB's TTFT
+     outlier track must put it in probation within the detection
+     window while traffic through it stays byte-identical.
+
 Exit code: 0 = all episodes passed, 1 = any property violated.
 """
 import argparse
@@ -300,31 +311,39 @@ def _drain_exercise(fleet, references) -> list:
         bad.append('DRAIN: in-flight stream diverged')
     if not busy.server.drained.wait(30):
         bad.append('DRAIN: replica never reported drained')
-    # Warm failover: a survivor must have adopted the drained
-    # replica's hot set, and replaying the hot prompt on the adopter
-    # must count a radix hit with byte-identical output.
-    survivors = [r for r in fleet.replicas if r is not busy]
-    adopter, wait_until = None, time.time() + 30
-    while time.time() < wait_until and adopter is None:
-        adopter = next(
-            (r for r in survivors
-             if r.server.engine.handoff_stats.get('adopted', 0) > 0),
-            None)
+    # Warm failover: the drained replica's hot set ships to the
+    # affinity-ring owner of EACH prefix, so with several survivors
+    # the prefixes can split across them (ring order follows the
+    # randomized ports).  Wait for the handoff to finish shipping
+    # every group (hot_handoffs bumps once, at the end), then replay
+    # the hot prompt on every adopter: byte-identity must hold on all
+    # of them, and the prefix's owner must answer it off the adopted
+    # blocks (radix hit) on at least one.
+    wait_until = time.time() + 30
+    while time.time() < wait_until and \
+            fleet.lb.lb_stats().get('hot_handoffs', 0) < 1:
         time.sleep(0.05)
-    if adopter is None:
+    survivors = [r for r in fleet.replicas if r is not busy]
+    adopters = [r for r in survivors
+                if r.server.engine.handoff_stats.get('adopted', 0) > 0]
+    if not adopters:
         bad.append('DRAIN: no survivor adopted the hot set')
     elif hot_ref is not None:
-        hits0 = adopter.server.engine.radix_stats['hits']
-        try:
-            done = _finish_of(_stream_generate(
-                adopter.port, {'tokens': hot + [90],
-                               'max_new_tokens': 3, 'stream': True}))
-            if done['output_tokens'] != hot_ref:
-                bad.append('DRAIN: hot replay diverged on the adopter')
-            if adopter.server.engine.radix_stats['hits'] <= hits0:
-                bad.append('DRAIN: hot replay missed the adopted radix')
-        except RuntimeError as e:
-            bad.append(f'DRAIN: hot replay failed: {e}')
+        radix_hits = 0
+        for adopter in adopters:
+            hits0 = adopter.server.engine.radix_stats['hits']
+            try:
+                done = _finish_of(_stream_generate(
+                    adopter.port, {'tokens': hot + [90],
+                                   'max_new_tokens': 3, 'stream': True}))
+                if done['output_tokens'] != hot_ref:
+                    bad.append('DRAIN: hot replay diverged on the adopter')
+                if adopter.server.engine.radix_stats['hits'] > hits0:
+                    radix_hits += 1
+            except RuntimeError as e:
+                bad.append(f'DRAIN: hot replay failed: {e}')
+        if radix_hits == 0:
+            bad.append('DRAIN: hot replay missed the adopted radix')
     conn = HTTPConnection('127.0.0.1', busy.port, timeout=10)
     conn.request('POST', '/drain', body=b'{"cancel": true}')
     conn.getresponse()
@@ -332,8 +351,145 @@ def _drain_exercise(fleet, references) -> list:
     return bad
 
 
+def _stream_with_retry(port: int, payload: dict, wall_s: float = 90.0):
+    """Stream through an LB that may be mid-restart: connection-level
+    errors and severed streams retry (greedy decode is deterministic,
+    so a from-scratch reissue yields identical tokens).  Returns
+    (terminal_event, attempts)."""
+    deadline = time.time() + wall_s
+    attempts, last = 0, None
+    while time.time() < deadline:
+        attempts += 1
+        try:
+            events = _stream_generate(port, payload, timeout=30)
+            done = [e for e in events if e.get('done')]
+            if len(done) == 1 and \
+                    done[0].get('finish_reason') in ('length', 'eos'):
+                return done[0], attempts
+            last = RuntimeError(
+                f'incomplete stream ({len(done)} terminal events, '
+                f'finish={done[0].get("finish_reason") if done else None})')
+        except (OSError, RuntimeError) as e:
+            last = e
+        time.sleep(0.2)
+    raise RuntimeError(f'never completed after {attempts} attempts: {last}')
+
+
+def _lb_restart_exercise(fleet, references, n_requests: int) -> list:
+    """Kill the LB mid-traffic, restart it on the same port with the
+    journal re-adopted: zero requests lost, every answer
+    byte-identical."""
+    bad, results = [], {}
+    lock = threading.Lock()
+
+    def worker(idx):
+        try:
+            done, attempts = _stream_with_retry(fleet.lb_port,
+                                                _request_spec(idx))
+            with lock:
+                results[idx] = (done['output_tokens'], attempts)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                bad.append(f'LB-restart request {idx}: {e}')
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_requests)]
+    for th in threads:
+        th.start()
+    time.sleep(0.4)   # let streams get genuinely in flight
+    fleet.kill_lb()
+    time.sleep(0.3)   # clients live through the dead window
+    fleet.restart_lb()
+    for th in threads:
+        th.join(120)
+        if th.is_alive():
+            bad.append('LB-restart: client hung')
+    retried = sum(1 for _, n in results.values() if n > 1)
+    for idx, (tokens, _) in sorted(results.items()):
+        if tokens != references[idx]:
+            bad.append(f'LB-restart: request {idx} diverged')
+    if len(results) + len(bad) < n_requests:
+        bad.append(f'LB-restart: only {len(results)}/{n_requests} '
+                   'requests accounted for')
+    stats = fleet.lb.lb_stats()
+    if stats.get('adopted_unverified'):
+        bad.append('LB-restart: journal-adopted replicas never '
+                   f're-verified: {stats["adopted_unverified"]}')
+    print(f'  lb-restart: kills={fleet.lb_kills} '
+          f'restarts={fleet.lb_restarts} retried_clients={retried} '
+          f'journal_age_s={stats.get("journal_age_s")} '
+          f'{"FAIL" if bad else "ok"}')
+    return bad
+
+
+def _probation_exercise(fleet, references, window_s: float = 45.0) -> list:
+    """Degrade one replica's network path (alive, answering probes,
+    crawling responses) and require the LB's gray-failure track to put
+    it in probation within the detection window — with every request
+    routed through the rot still byte-identical."""
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site='net_degrade', prob=1.0, delay_s=0.4,
+                  jitter_s=0.1),
+    ])
+    proxy = fleet.degrade_one(0, plan, seed=0)
+    bad, stop = [], threading.Event()
+    lock = threading.Lock()
+
+    def lane(lane_id):
+        i = lane_id
+        while not stop.is_set():
+            idx = i % 5
+            i += 3
+            try:
+                done, _ = _stream_with_retry(fleet.lb_port,
+                                             _request_spec(idx),
+                                             wall_s=30)
+                if done['output_tokens'] != references[idx]:
+                    with lock:
+                        bad.append(f'probation: request {idx} diverged '
+                                   'through the degraded path')
+            except RuntimeError as e:
+                with lock:
+                    bad.append(f'probation traffic: {e}')
+                return
+
+    # Three concurrent lanes so least-load routing spreads TTFT samples
+    # across the fleet (probation compares against the fleet median —
+    # it needs at least two replicas with an EWMA).
+    lanes = [threading.Thread(target=lane, args=(k,), daemon=True)
+             for k in range(3)]
+    for th in lanes:
+        th.start()
+    deadline = time.time() + window_s
+    probation = []
+    while time.time() < deadline and not bad:
+        probation = fleet.lb.lb_stats()['probation_replicas']
+        # Wait for the DEGRADED replica specifically: another replica
+        # entering probation (e.g. TTFT inflated by queuing behind the
+        # rot) is not detection.
+        if proxy.url in probation:
+            break
+        time.sleep(0.2)
+    detect_wall = window_s - max(0.0, deadline - time.time())
+    stop.set()
+    for th in lanes:
+        th.join(60)
+    if proxy.url not in probation:
+        bad.append(f'probation: degraded replica not ejected within '
+                   f'{window_s}s (probation={probation}, '
+                   f'delayed_chunks={proxy.chunks_delayed})')
+    if proxy.chunks_delayed == 0:
+        bad.append('probation: degrade proxy never fired')
+    print(f'  probation: detected_in={detect_wall:.1f}s '
+          f'delayed_chunks={proxy.chunks_delayed} '
+          f'{"FAIL" if bad else "ok"}')
+    return bad
+
+
 def multi_replica_sweep(n_replicas: int, seeds, n_requests: int,
                         policy_name: str = 'least_load') -> int:
+    import tempfile
+
     from skypilot_tpu.infer.chaos import ChaosFleet, SeededKiller
 
     os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
@@ -347,8 +503,10 @@ def multi_replica_sweep(n_replicas: int, seeds, n_requests: int,
     print(f'replica chaos: {n_replicas} replicas seeds={seeds} '
           f'requests/episode={n_requests} policy={policy_name} '
           f'tp_last={tp_last or 1}')
+    journal = os.path.join(tempfile.mkdtemp(prefix='chaos-lb-'),
+                           'lb_journal.jsonl')
     fleet = ChaosFleet(factories, n_replicas,
-                       policy_name=policy_name)
+                       policy_name=policy_name, journal_path=journal)
     fleet.start()
     failures = []
     try:
@@ -416,7 +574,21 @@ def multi_replica_sweep(n_replicas: int, seeds, n_requests: int,
                     break
                 time.sleep(0.05)
 
+        # Each leg tests ONE mechanism.  The kill episodes leave gray-
+        # failure evidence behind (TTFT EWMAs spiked by mid-stream
+        # failovers can hold a survivor in probation indefinitely once
+        # it stops drawing traffic), and a survivor stuck in probation
+        # diverts the drain leg's hot replay away from the replica that
+        # adopted the radix — so the evidence is explicitly reset at
+        # each leg boundary, exactly like an operator closing out a
+        # maintenance window.
+        fleet.lb.reset_gray_state()
         failures += _drain_exercise(fleet, references)
+        fleet.lb.reset_gray_state()
+        failures += _lb_restart_exercise(fleet, references,
+                                         n_requests=min(6, n_requests))
+        fleet.lb.reset_gray_state()
+        failures += _probation_exercise(fleet, references)
         print(f'  lb stats: {fleet.lb.lb_stats()}')
     finally:
         fleet.stop()

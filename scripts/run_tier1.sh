@@ -20,7 +20,7 @@ timeout -k 5 60 python scripts/skycheck.py \
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
-    -p no:randomly --durations=15 2>&1 | tee "$LOG"
+    -p no:randomly --durations=0 --durations-min=0.05 2>&1 | tee "$LOG"
 [ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
 # Decode-bench dryrun under the compile sanitizer: drives the REAL
@@ -42,6 +42,7 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_qos.py \
     --require tests/test_tp_paged.py \
     --require tests/test_kv_tier.py \
+    --require tests/test_control_plane.py \
     --skycheck-json "$SKYJSON" \
     --extra-seconds "bench_dryrun:$BENCH_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request

@@ -171,6 +171,8 @@ SURFACES: Tuple[SurfaceSpec, ...] = (
                      vars=('stats', 'st')),
             Consumer('tests/test_kv_tier.py', None,
                      vars=('stats', 'st')),
+            Consumer('tests/test_control_plane.py', None,
+                     vars=('stats', 'st')),
             Consumer('scripts/bench_serve_lb.py', None,
                      vars=('stats',)),
         ),
@@ -182,6 +184,8 @@ SURFACES: Tuple[SurfaceSpec, ...] = (
         consumers=(
             Consumer('tests/test_qos.py', None, vars=('snap',)),
             Consumer('tests/test_serve.py', None, vars=('snap',)),
+            Consumer('tests/test_control_plane.py', None,
+                     vars=('snap',)),
         ),
     ),
     # LB -> controller sync body (one producer, one consumer, different

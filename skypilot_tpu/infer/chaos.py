@@ -21,10 +21,14 @@ process spawn/jax re-import per respawn).
 Used by `scripts/chaos_smoke.py --multi-replica N` and
 `tests/test_serve_failover.py`.
 """
+import json
 import socket
 import threading
 import time
-from typing import Callable, List, Optional, Sequence, Union
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
 
 from skypilot_tpu.analysis import sanitizers
 from skypilot_tpu import logsys
@@ -32,6 +36,7 @@ from skypilot_tpu.infer.engine import InferenceEngine
 from skypilot_tpu.infer.server import (InferenceServer,
                                        _BurstTolerantHTTPServer,
                                        _make_handler)
+from skypilot_tpu.serve.lb_journal import LBJournal
 from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
 from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
 
@@ -190,7 +195,8 @@ class ChaosFleet:
                                     Sequence[Callable[[],
                                                       InferenceEngine]]],
                  n_replicas: int, policy_name: str = 'least_load',
-                 host: str = '127.0.0.1'):
+                 host: str = '127.0.0.1',
+                 journal_path: Optional[str] = None):
         # One factory for a homogeneous fleet, or one PER replica for a
         # mixed one (e.g. a tp=2 replica next to single-chip ones — the
         # serve plane must treat both identically behind the LB).
@@ -206,10 +212,39 @@ class ChaosFleet:
             KillableReplica(factory, free_port(host), host=host)
             for factory in factories
         ]
+        self.host = host
+        self.policy_name = policy_name
+        self.journal_path = journal_path
+        # Degraded (gray-failure) proxies by replica index: routing
+        # goes through the proxy URL while the replica itself stays
+        # reachable at its own port (the two URLs are distinct replica
+        # identities from the LB's point of view — deliberate, so the
+        # probation verdict lands on the degraded path).
+        self.degraded: Dict[int, 'DegradedReplica'] = {}
+        # LB port pinned ONCE: kill_lb/restart_lb keep the URL clients
+        # hold stable across LB generations (same contract the
+        # supervisor gives the real serve plane).
+        self.lb_port = free_port(host)
+        self.lb_kills = 0
+        self.lb_restarts = 0
         self.policy = LoadBalancingPolicy.make(policy_name)
-        self.policy.set_ready_replicas([r.url for r in self.replicas])
-        self.lb = SkyTpuLoadBalancer(None, free_port(host), self.policy)
+        self.policy.set_ready_replicas(self._replica_urls())
+        self.lb = SkyTpuLoadBalancer(
+            None, self.lb_port, self.policy,
+            journal=self._make_journal(),
+            server_cls=_TrackingHTTPServer)
         self._lb_thread: Optional[threading.Thread] = None
+
+    def _make_journal(self) -> Optional[LBJournal]:
+        if not self.journal_path:
+            return None
+        return LBJournal(self.journal_path, clock=time.monotonic)
+
+    def _replica_urls(self) -> List[str]:
+        return [
+            self.degraded[i].url if i in self.degraded else r.url
+            for i, r in enumerate(self.replicas)
+        ]
 
     @property
     def lb_url(self) -> str:
@@ -221,7 +256,10 @@ class ChaosFleet:
         self._lb_thread = threading.Thread(target=self.lb.run,
                                            daemon=True, name='chaos-lb')
         self._lb_thread.start()
-        deadline = time.monotonic() + 10  # det-ok: startup wait (harness)
+        self._wait_lb_up()
+
+    def _wait_lb_up(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout  # det-ok: startup wait (harness)
         while time.monotonic() < deadline:  # det-ok: startup wait
             try:
                 with socket.create_connection(
@@ -230,6 +268,76 @@ class ChaosFleet:
             except OSError:
                 time.sleep(0.05)
         raise TimeoutError('load balancer never came up')
+
+    # ----------------------------------------------- control-plane chaos
+
+    def kill_lb(self) -> None:
+        """Crash the load balancer: listener closed, every in-flight
+        proxied connection RST — from a client's view the service's one
+        front door slams shut mid-stream."""
+        lb, thread = self.lb, self._lb_thread
+        httpd = lb._httpd  # pylint: disable=protected-access
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.sever_all()
+        lb.stop()
+        if thread is not None:
+            thread.join(timeout=5)
+        self.lb_kills += 1
+        logger.info('chaos: killed LB :%d', self.lb_port)
+
+    def restart_lb(self, wait_adopted: bool = True,
+                   timeout: float = 10.0) -> None:
+        """Bring a FRESH LB up on the same port (what the supervisor
+        does in the real serve plane): new policy instance, journal
+        re-adopted in the constructor.  With `wait_adopted`, block until
+        the restarted LB has re-verified every journal-adopted replica
+        with a live probe (adopted_unverified drains to []) — traffic
+        sent before that may be quarantined away from healthy replicas.
+        """
+        policy = LoadBalancingPolicy.make(self.policy_name)
+        policy.set_ready_replicas(self._replica_urls())
+        self.policy = policy
+        self.lb = SkyTpuLoadBalancer(
+            None, self.lb_port, policy,
+            journal=self._make_journal(),
+            server_cls=_TrackingHTTPServer)
+        self._lb_thread = threading.Thread(target=self.lb.run,
+                                           daemon=True, name='chaos-lb')
+        self._lb_thread.start()
+        self._wait_lb_up(timeout)
+        self.lb_restarts += 1
+        if wait_adopted:
+            deadline = time.monotonic() + timeout  # det-ok: harness wait
+            while time.monotonic() < deadline:  # det-ok: harness wait
+                try:
+                    with urllib.request.urlopen(
+                            f'{self.lb_url}/lb/stats', timeout=2) as resp:
+                        stats = json.loads(resp.read())
+                    if not stats.get('adopted_unverified'):
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.05)
+        logger.info('chaos: restarted LB :%d (journal=%s)', self.lb_port,
+                    bool(self.journal_path))
+
+    def degrade_one(self, index: int, plan,
+                    seed: int = 0) -> 'DegradedReplica':
+        """Put a gray-failure proxy in front of replica `index` and
+        re-seed routing through it.  The replica stays perfectly
+        healthy; only its network path rots — the case the probation
+        track exists for."""
+        if index in self.degraded:
+            return self.degraded[index]
+        proxy = DegradedReplica(self.replicas[index], plan, seed=seed,
+                                host=self.host)
+        proxy.start()
+        self.degraded[index] = proxy
+        self.policy.set_ready_replicas(self._replica_urls())
+        logger.info('chaos: degraded replica :%d behind proxy :%d',
+                    self.replicas[index].port, proxy.port)
+        return proxy
 
     def live_replicas(self) -> List[KillableReplica]:
         return [r for r in self.replicas if r.alive]
@@ -255,6 +363,8 @@ class ChaosFleet:
 
     def stop(self) -> None:
         self.lb.stop()
+        for proxy in self.degraded.values():
+            proxy.stop()
         for r in self.replicas:
             r.kill()
 
@@ -274,6 +384,7 @@ class SeededKiller(threading.Thread):
         self.plan = plan
         self.tick_s = tick_s
         self.kills = 0
+        self.lb_kills = 0
         # NOT named _stop: that would shadow threading.Thread._stop,
         # which join() calls internally.
         self._halt = threading.Event()
@@ -283,8 +394,138 @@ class SeededKiller(threading.Thread):
             if self.plan.check('replica_kill') is not None:
                 if self.fleet.kill_one() is not None:
                     self.kills += 1
+            if self.plan.check('lb_kill') is not None:
+                # Kill + supervisor-style restart on the same port: the
+                # window where clients see connection errors is the
+                # restart latency, exactly as in the real serve plane.
+                self.fleet.kill_lb()
+                self.fleet.restart_lb()
+                self.lb_kills += 1
             self._halt.wait(self.tick_s)
 
     def stop(self) -> None:
         self._halt.set()
         self.join(timeout=5)
+
+
+class DegradedReplica:
+    """Gray-failure wrapper: a TCP splice proxy in front of a healthy
+    replica.
+
+    Crashes are the EASY failure — connection refused trips the
+    breaker in seconds.  The failure that silently ruins a fleet's
+    tail is the replica that stays alive and keeps answering probes
+    while its responses crawl.  This proxy manufactures exactly that:
+    the client→server direction passes through untouched, and each
+    server→client chunk consults the plan's ``net_degrade`` site — a
+    firing spec either sleeps ``delay_s ± jitter_s`` (seeded uniform)
+    before relaying, or, with ``blackhole``, stops relaying the
+    connection's downstream bytes entirely (a hung-but-open socket).
+
+    The proxy has its own pinned port: the LB routes to the PROXY url,
+    so from the control plane's view the degraded path IS the replica —
+    TTFT samples, probation verdicts, and weight shed all land on it
+    while the wrapped engine stays pristine.
+    """
+
+    def __init__(self, inner: KillableReplica, plan, seed: int = 0,
+                 host: str = '127.0.0.1'):
+        self.inner = inner
+        self.plan = plan
+        self.host = host
+        self.port = free_port(host)
+        # Jitter draws come from the proxy's own seeded stream (NOT the
+        # plan's per-spec streams, which must stay consult-aligned).
+        self._rng = np.random.default_rng(seed)
+        self._rng_lock = sanitizers.instrument_lock(
+            threading.Lock(), 'infer.chaos.degraded._rng_lock')
+        self._halt = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self.chunks_delayed = 0
+        self.chunks_blackholed = 0
+
+    @property
+    def url(self) -> str:
+        return f'http://{self.host}:{self.port}'
+
+    def start(self) -> None:
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self.host, self.port))
+        listener.listen(64)
+        self._listener = listener
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f'degrade-{self.port}').start()
+
+    def stop(self) -> None:
+        self._halt.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._halt.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(
+                    (self.inner.host, self.inner.port), timeout=5)
+            except OSError:
+                client.close()
+                continue
+            threading.Thread(
+                target=self._splice, args=(client, upstream, False),
+                daemon=True, name=f'degrade-up-{self.port}').start()
+            threading.Thread(
+                target=self._splice, args=(upstream, client, True),
+                daemon=True, name=f'degrade-down-{self.port}').start()
+
+    def _splice(self, src: socket.socket, dst: socket.socket,
+                degrade: bool) -> None:
+        """Relay src→dst until either side dies.  Only the downstream
+        (server→client) direction is degraded: requests arrive intact,
+        responses rot — the asymmetry real congested paths show."""
+        blackholed = False
+        try:
+            while not self._halt.is_set():
+                try:
+                    chunk = src.recv(65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                if degrade and not blackholed:
+                    spec = self.plan.check('net_degrade')
+                    if spec is not None:
+                        if spec.blackhole:
+                            # Hung-but-open: swallow this and every
+                            # later downstream byte; the client waits
+                            # on a socket that never speaks again.
+                            blackholed = True
+                            self.chunks_blackholed += 1
+                        elif spec.delay_s > 0.0:
+                            with self._rng_lock:
+                                jitter = float(self._rng.uniform(
+                                    -spec.jitter_s, spec.jitter_s))
+                            time.sleep(max(0.0, spec.delay_s + jitter))
+                            self.chunks_delayed += 1
+                if blackholed and degrade:
+                    continue
+                try:
+                    dst.sendall(chunk)
+                except OSError:
+                    break
+        finally:
+            for sock in (src, dst):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass

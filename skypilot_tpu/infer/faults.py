@@ -57,6 +57,18 @@ Sites (where the engine consults the plan):
                     balancer's circuit breaker and mid-stream failover.
                     The harness never kills the last live replica, and
                     caps kills with ``max_fires``
+``net_degrade``     NETWORK-level site, consulted by the chaos
+                    harness's ``DegradedReplica`` proxy once per
+                    server→client chunk: a firing spec injects
+                    ``delay_s`` (± ``jitter_s``, seeded) before the
+                    chunk is relayed, or swallows it entirely when
+                    ``blackhole`` — a gray failure (alive but slow/
+                    lossy) to exercise the LB's probation track
+``lb_kill``         CONTROL-PLANE site, consulted by the chaos
+                    harness's killer thread: a firing spec kills the
+                    load balancer itself (listener closed, in-flight
+                    proxies severed) to exercise supervisor restart +
+                    warm-journal re-adoption
 ==================  =====================================================
 
 Injected dispatch faults are raised HOST-SIDE, before the jitted call:
@@ -83,6 +95,8 @@ SITES = (
     'stall',
     'serve_loop',
     'replica_kill',
+    'net_degrade',
+    'lb_kill',
 )
 
 
@@ -116,6 +130,13 @@ class FaultSpec:
     slot       attribution: the engine slot this fault claims to have
                injured (None = unattributed → batch quarantine).
     stall_s    for the ``stall`` site: how long the loop sleeps.
+    delay_s    for the ``net_degrade`` site: base added latency per
+               relayed chunk (gray failure, not a crash).
+    jitter_s   for the ``net_degrade`` site: uniform ±jitter around
+               ``delay_s``, drawn from the consulting harness's own
+               seeded stream (spec streams stay consult-aligned).
+    blackhole  for the ``net_degrade`` site: a firing consult swallows
+               the chunk instead of delaying it (lossy path).
     message    human-readable tag carried into the raised error.
     """
 
@@ -125,6 +146,9 @@ class FaultSpec:
     max_fires: Optional[int] = None
     slot: Optional[int] = None
     stall_s: float = 0.0
+    delay_s: float = 0.0
+    jitter_s: float = 0.0
+    blackhole: bool = False
     message: str = 'injected fault'
 
     def __post_init__(self):
@@ -139,6 +163,15 @@ class FaultSpec:
             raise ValueError(f'prob must be in [0, 1] (got {self.prob})')
         if self.hits is None and self.prob == 0.0:
             raise ValueError('spec can never fire: give hits or prob > 0')
+        if self.delay_s < 0.0 or self.jitter_s < 0.0:
+            raise ValueError('delay_s/jitter_s must be >= 0')
+        if self.jitter_s > self.delay_s and self.jitter_s > 0.0:
+            raise ValueError('jitter_s must not exceed delay_s '
+                             '(delay - jitter would go negative)')
+        if ((self.delay_s > 0.0 or self.blackhole)
+                and self.site != 'net_degrade'):
+            raise ValueError(
+                'delay_s/blackhole only apply to the net_degrade site')
 
 
 class FaultPlan:

@@ -17,12 +17,26 @@ HTTP response — including a 404 from a replica that doesn't implement
 opens the breaker.  That keeps the LB safe in front of plain HTTP
 replicas (the e2e tests serve `python3 -m http.server`).
 
+A fourth state rides alongside the classic three: **probation**, the
+gray-failure track.  Connection failures prove a replica *dead*;
+probation catches one that is *alive but lying* — answering probes
+while its TTFT drifts to many multiples of the fleet median (fail-slow).
+The LB feeds per-replica TTFT samples into an EWMA and periodically
+calls ``evaluate_probation(fleet_median)``; a replica sustained above
+``probation_k`` x median for ``probation_enter`` consecutive
+evaluations enters probation (the LB sheds its routing weight to
+~10%), and needs ``probation_exit`` consecutive clean evaluations to
+leave — hysteresis on both edges so one GC pause doesn't eject and one
+lucky request doesn't readmit.  Probation never blocks traffic
+outright (the replica keeps a trickle + probes): it is a weight, not a
+wall, so a fleet-wide slowdown cannot eject everyone.
+
 Deterministic by construction: the clock and the jitter RNG are
 injected, so tests drive every transition without a single sleep.
 """
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
@@ -34,6 +48,7 @@ class CircuitBreaker:
     CLOSED = 'closed'
     OPEN = 'open'
     HALF_OPEN = 'half_open'
+    PROBATION = 'probation'
 
     def __init__(self,
                  failure_threshold: int = 2,
@@ -41,13 +56,21 @@ class CircuitBreaker:
                  max_backoff_s: float = 30.0,
                  jitter_frac: float = 0.2,
                  now: Callable[[], float] = time.monotonic,
-                 rng: Optional[np.random.Generator] = None):
+                 rng: Optional[np.random.Generator] = None,
+                 probation_k: float = 3.0,
+                 probation_enter: int = 3,
+                 probation_exit: int = 3,
+                 ewma_alpha: float = 0.3):
         if failure_threshold < 1:
             raise ValueError('failure_threshold must be >= 1')
         self.failure_threshold = failure_threshold
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
         self.jitter_frac = jitter_frac
+        self.probation_k = probation_k
+        self.probation_enter = max(1, int(probation_enter))
+        self.probation_exit = max(1, int(probation_exit))
+        self.ewma_alpha = ewma_alpha
         self._now = now
         self._rng = rng if rng is not None else np.random.default_rng(0)
         self._lock = sanitizers.instrument_lock(
@@ -56,17 +79,26 @@ class CircuitBreaker:
         self._opens = 0  # guarded-by: _lock (consecutive opens = backoff exp)
         self._open_until: Optional[float] = None  # guarded-by: _lock
         self.open_count = 0  # guarded-by: _lock (lifetime opens)
+        self._lat_ewma: Optional[float] = None  # guarded-by: _lock
+        self._outlier_streak = 0  # guarded-by: _lock (consecutive outlier evals)
+        self._clear_streak = 0  # guarded-by: _lock (consecutive clean evals)
+        self._probation = False  # guarded-by: _lock
+        # Fired OUTSIDE the lock with the new state name on every
+        # open/close/probation edge; the LB hangs its journal fsync here.
+        self.on_transition: Optional[Callable[[str], None]] = None
 
     # ------------------------------------------------------------- state
 
     @property
     def state(self) -> str:
         with self._lock:
-            if self._open_until is None:
-                return self.CLOSED
-            if self._now() >= self._open_until:
-                return self.HALF_OPEN
-            return self.OPEN
+            if self._open_until is not None:
+                if self._now() >= self._open_until:
+                    return self.HALF_OPEN
+                return self.OPEN
+            if self._probation:
+                return self.PROBATION
+            return self.CLOSED
 
     def available(self) -> bool:
         """True when the replica may receive traffic: closed, or open
@@ -80,28 +112,37 @@ class CircuitBreaker:
     def record_success(self) -> None:
         """Any HTTP response (probe or proxied request reached the
         replica): close the breaker, reset failures and backoff."""
+        fire = None
         with self._lock:
+            was_open = self._open_until is not None
             self._failures = 0
             self._opens = 0
             self._open_until = None
+            if was_open:
+                fire = (self.PROBATION if self._probation else self.CLOSED)
+        self._fire(fire)
 
     def record_failure(self) -> None:
         """A connection-level failure (refused/reset/timeout).  While
         closed, counts toward the threshold; in half-open, re-opens
         immediately with a doubled window."""
+        fire = None
         with self._lock:
             if self._open_until is not None:
                 if self._now() >= self._open_until:
                     # Half-open trial failed: re-open, doubled window.
                     self._trip()
+                    fire = self.OPEN
                 # Still open: probes/stragglers hitting a known-dead
                 # replica add no information — re-arming here would
                 # double the backoff per PROBE instead of per trial
                 # and inflate open_count.
-                return
-            self._failures += 1
-            if self._failures >= self.failure_threshold:
-                self._trip()
+            else:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    self._trip()
+                    fire = self.OPEN
+        self._fire(fire)
 
     def _trip(self) -> None:  # locked: _lock
         """(Caller holds the lock.)  Open with exponential backoff +
@@ -114,3 +155,122 @@ class CircuitBreaker:
         self._opens += 1
         self._failures = 0
         self.open_count += 1
+
+    def _fire(self, state: Optional[str]) -> None:
+        """Invoke on_transition outside the lock (the callback may
+        fsync a journal or take other locks; holding _lock across it
+        would invert lock order with the LB's stats lock)."""
+        if state is not None and self.on_transition is not None:
+            self.on_transition(state)
+
+    # ------------------------------------------- gray-failure (probation)
+
+    def record_latency(self, seconds: float) -> None:
+        """Feed one TTFT sample into the latency EWMA.  Cheap enough to
+        call per request; the EWMA (not the raw sample) is what
+        evaluate_probation() compares against the fleet median."""
+        with self._lock:
+            if self._lat_ewma is None:
+                self._lat_ewma = float(seconds)
+            else:
+                a = self.ewma_alpha
+                self._lat_ewma = a * float(seconds) + (1.0 - a) * self._lat_ewma
+
+    @property
+    def latency_ewma(self) -> Optional[float]:
+        with self._lock:
+            return self._lat_ewma
+
+    def in_probation(self) -> bool:
+        with self._lock:
+            return self._probation
+
+    def evaluate_probation(self, fleet_median: float) -> bool:
+        """One probation evaluation against the fleet TTFT median.
+        Returns True iff the probation flag CHANGED this call.  A
+        replica with no EWMA yet (no traffic) counts as clean — absence
+        of samples is not evidence of slowness."""
+        fire = None
+        changed = False
+        with self._lock:
+            outlier = (self._lat_ewma is not None and fleet_median > 0.0
+                       and self._lat_ewma > self.probation_k * fleet_median)
+            if outlier:
+                self._outlier_streak += 1
+                self._clear_streak = 0
+                if (not self._probation
+                        and self._outlier_streak >= self.probation_enter):
+                    self._probation = True
+                    changed = True
+                    fire = self.PROBATION
+            else:
+                self._clear_streak += 1
+                self._outlier_streak = 0
+                if (self._probation
+                        and self._clear_streak >= self.probation_exit):
+                    self._probation = False
+                    # Exiting probation sheds the stale EWMA: the next
+                    # verdict should rest on post-recovery samples, not
+                    # on the slow era's memory.
+                    self._lat_ewma = None
+                    changed = True
+                    fire = self.CLOSED
+        self._fire(fire)
+        return changed
+
+    def reset_latency_state(self) -> bool:
+        """Forget all gray-failure evidence: latency EWMA, hysteresis
+        streaks, and the probation flag.  Probation normally exits by
+        accumulating fresh healthy samples, but a replica that stopped
+        receiving traffic keeps its stale EWMA forever — an operator
+        (or a test harness isolating fault episodes) may know the
+        evidence no longer describes the replica.  Returns True iff the
+        replica actually left probation (the edge is journalled via
+        on_transition, like a natural exit)."""
+        with self._lock:
+            was = self._probation
+            self._probation = False
+            self._lat_ewma = None
+            self._outlier_streak = 0
+            self._clear_streak = 0
+        if was:
+            self._fire(self.CLOSED)
+        return was
+
+    # ------------------------------------------------- journal snapshot
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serialisable state for the LB journal.  The backoff
+        deadline is stored RELATIVE (seconds remaining) because the
+        injected clock is monotonic — absolute readings don't survive a
+        process restart."""
+        with self._lock:
+            remaining = None
+            if self._open_until is not None:
+                remaining = max(0.0, self._open_until - self._now())
+            return {
+                'failures': self._failures,
+                'opens': self._opens,
+                'open_remaining_s': remaining,
+                'open_count': self.open_count,
+                'probation': self._probation,
+                'outlier_streak': self._outlier_streak,
+                'clear_streak': self._clear_streak,
+                'latency_ewma': self._lat_ewma,
+            }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Re-adopt a snapshot() doc after a restart.  Tolerant of
+        missing keys (journal written by an older LB)."""
+        with self._lock:
+            self._failures = int(snap.get('failures', 0))
+            self._opens = int(snap.get('opens', 0))
+            remaining = snap.get('open_remaining_s')
+            self._open_until = (None if remaining is None
+                                else self._now() + float(remaining))
+            self.open_count = int(snap.get('open_count', 0))
+            self._probation = bool(snap.get('probation', False))
+            self._outlier_streak = int(snap.get('outlier_streak', 0))
+            self._clear_streak = int(snap.get('clear_streak', 0))
+            ewma = snap.get('latency_ewma')
+            self._lat_ewma = None if ewma is None else float(ewma)

@@ -184,3 +184,85 @@ def slo_downscale_factor() -> float:
     this fraction of the target (hysteresis band: between factor*SLO
     and SLO the fleet holds)."""
     return _f('SKYTPU_SERVE_SLO_DOWNSCALE_FACTOR', 0.5)
+
+
+# ------------------------------------------- control-plane resilience
+# (PR 18): LB warm-restart journal, gray-failure probation, retry
+# budgets, TTFT hedging.  The SKYTPU_LB_* prefix is the env contract
+# documented in docs/serving.md "Control-plane fault tolerance".
+
+
+def lb_hedge_ms() -> float:
+    """TTFT hedge deadline in milliseconds for resumable greedy
+    streams: if the first byte hasn't arrived by this deadline the LB
+    issues the request to the affinity ring's next-best replica and
+    keeps whichever answers first.  <= 0 (the default) disables
+    hedging — it spends extra replica work for tail latency and must
+    be an explicit choice."""
+    return _f('SKYTPU_LB_HEDGE_MS', 0.0)
+
+
+def lb_retry_budget_ratio() -> float:
+    """Retry-budget deposit per successful request (Finagle-style
+    refill proportional to successes): the fleet can spend at most
+    ~ratio extra attempts per success under sustained failure."""
+    return _f('SKYTPU_LB_RETRY_RATIO', 0.2)
+
+
+def lb_retry_budget_reserve() -> float:
+    """Constant retry-token trickle (tokens/second) so a cold or
+    zero-throughput fleet can still retry occasionally."""
+    return _f('SKYTPU_LB_RETRY_RESERVE', 0.1)
+
+
+def lb_retry_budget_cap() -> float:
+    """Retry-budget bucket capacity (tokens); the budget starts full."""
+    return _f('SKYTPU_LB_RETRY_CAP', 100.0)
+
+
+def lb_probation_k() -> float:
+    """Gray-failure threshold: a replica whose TTFT EWMA sustains above
+    k x the fleet median enters probation."""
+    return _f('SKYTPU_LB_PROBATION_K', 3.0)
+
+
+def lb_probation_enter() -> int:
+    """Consecutive outlier evaluations (one per probe round) required
+    to ENTER probation — hysteresis so one GC pause doesn't eject."""
+    return int(_f('SKYTPU_LB_PROBATION_ENTER', 3))
+
+
+def lb_probation_exit() -> int:
+    """Consecutive clean evaluations required to LEAVE probation."""
+    return int(_f('SKYTPU_LB_PROBATION_EXIT', 3))
+
+
+def lb_probation_weight() -> float:
+    """Fraction of its normal traffic a probation replica keeps (it is
+    shed, not ejected: still probed, still convalescing on a trickle)."""
+    return _f('SKYTPU_LB_PROBATION_WEIGHT', 0.1)
+
+
+def lb_ewma_alpha() -> float:
+    """EWMA smoothing factor for the per-replica TTFT track feeding
+    probation evaluation."""
+    return _f('SKYTPU_LB_EWMA_ALPHA', 0.3)
+
+
+def lb_journal_path() -> str:
+    """Warm-restart journal path; empty (the default) disables
+    journalling entirely — the LB then restarts cold, exactly the
+    pre-PR-18 behaviour."""
+    return os.environ.get('SKYTPU_LB_JOURNAL', '')
+
+
+def lb_journal_compact_every() -> int:
+    """Appends between journal compactions (rewrite to one line per
+    live key)."""
+    return int(_f('SKYTPU_LB_JOURNAL_COMPACT_EVERY', 256))
+
+
+def lb_restart_threshold() -> int:
+    """Consecutive failed LB health probes before the supervisor
+    restarts the LB process/thread on the same port."""
+    return int(_f('SKYTPU_LB_RESTART_THRESHOLD', 3))

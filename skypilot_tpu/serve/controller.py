@@ -38,7 +38,29 @@ class ServeController:
         # replacement sizing and drain pacing both work against the LIVE
         # (possibly autoscaled-above-min) fleet, not min_replicas.
         self._update_old_fleet = 0
+        # Crash recovery: the service record + replica rows in
+        # serve_state survive a controller restart, and a restart
+        # mid-update must neither forget the update (version) nor the
+        # pre-update fleet size (drain pacing).  Re-adopt both here: the
+        # recovered old-fleet size is old READY + latest READY — the
+        # ready capacity the update is defending.  (Plugging that into
+        # _update_replicas: old_drained = latest_ready so permits = 0 —
+        # conservative: drains resume only as NEW replicas come ready
+        # post-restart, never dropping capacity below where we rejoined.)
+        svc = serve_state.get_service(service_name)
+        if svc is not None:
+            self.version = int(svc.get('version', 1))
+            live = serve_state.get_replicas(service_name)
+            old_ready = sum(
+                1 for r in live if r['version'] < self.version and
+                ReplicaStatus(r['status']) == ReplicaStatus.READY)
+            latest_ready = sum(
+                1 for r in live if r['version'] >= self.version and
+                ReplicaStatus(r['status']) == ReplicaStatus.READY)
+            if old_ready > 0:
+                self._update_old_fleet = old_ready + latest_ready
         self.autoscaler = autoscalers.Autoscaler.make(spec)
+        self.autoscaler.latest_version = self.version
         self.replica_manager = ReplicaManager(service_name, spec, task_yaml)
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -63,6 +85,16 @@ class ServeController:
         # Surfaced per replica in state_snapshot() so operators can see
         # mixed TP/DP fleet composition at a glance.
         self._lb_tp: dict = {}  # guarded-by: _lb_lock
+        # Control-plane resilience views from the LB sync (PR 18):
+        # replicas in gray-failure probation, retry-budget level, and
+        # journal staleness — mirrored into state_snapshot() so one
+        # GET /controller/state shows the whole resilience posture.
+        self._lb_probation: list = []  # guarded-by: _lb_lock
+        self._lb_retry_budget: Optional[float] = None  # guarded-by: _lb_lock
+        self._lb_journal_age: Optional[float] = None  # guarded-by: _lb_lock
+        # Set by service.py when the LB runs under a supervisor; its
+        # stats() feed the state_snapshot 'load_balancer' block.
+        self.lb_supervisor = None
 
     # ----------------------------------------------------------- HTTP API
 
@@ -76,8 +108,19 @@ class ServeController:
             tenant_qos = payload.get('tenant_qos')
             latency = payload.get('replica_latency')
             replica_tp = payload.get('replica_tp')
+            probation = payload.get('replica_probation')
+            retry_budget = payload.get('retry_budget')
+            journal_age = payload.get('journal_age_s')
             if isinstance(latency, dict):
                 self.autoscaler.collect_latency_information(latency)
+            with self._lb_lock:
+                if isinstance(probation, list):
+                    self._lb_probation = [str(u) for u in probation]
+                if isinstance(retry_budget, (int, float)):
+                    self._lb_retry_budget = float(retry_budget)
+                self._lb_journal_age = (
+                    float(journal_age)
+                    if isinstance(journal_age, (int, float)) else None)
             if isinstance(inflight, dict) or isinstance(draining, list) \
                     or isinstance(affinity, dict) \
                     or isinstance(tenant_qos, dict) \
@@ -170,6 +213,17 @@ class ServeController:
             lb_tenant_qos = dict(self._lb_tenant_qos)
             lb_latency = dict(self._lb_latency)
             lb_tp = dict(self._lb_tp)
+            lb_probation = list(self._lb_probation)
+            lb_retry_budget = self._lb_retry_budget
+            lb_journal_age = self._lb_journal_age
+        supervisor = self.lb_supervisor
+        lb_block = {
+            'probation_replicas': lb_probation,
+            'retry_budget_remaining': lb_retry_budget,
+            'journal_age_s': lb_journal_age,
+            'supervisor': (None if supervisor is None
+                           else supervisor.stats()),
+        }
         replicas = []
         for r in serve_state.get_replicas(self.service_name):
             endpoint = r.get('endpoint')
@@ -191,7 +245,8 @@ class ServeController:
             })
         return {'service': self.service_name, 'version': self.version,  # wire-ok: CLI/debug surface
                 'replicas': replicas,
-                'qos': lb_tenant_qos}
+                'qos': lb_tenant_qos,
+                'load_balancer': lb_block}
 
     def _serve_http(self) -> None:
         controller = self

@@ -144,9 +144,13 @@ def up(task: Task,
 
 
 def _controller_envs() -> Dict[str, str]:
+    # SKYTPU_SERVE_*: serve-plane loop intervals and QoS knobs.
+    # SKYTPU_LB_*: control-plane resilience knobs (journal path, hedge
+    # deadline, retry budget, probation) — the LB runs on the controller
+    # host, so they must ride along too.
     envs = {}
     for key in os.environ:
-        if key.startswith('SKYTPU_SERVE_'):
+        if key.startswith(('SKYTPU_SERVE_', 'SKYTPU_LB_')):
             envs[key] = os.environ[key]
     return envs
 

@@ -1,0 +1,153 @@
+"""Append-compacted JSON journal for load-balancer warm restart.
+
+The LB's slow-moving state — circuit-breaker machines + backoff clocks,
+the prefix-affinity ``_seen`` map, per-replica tp/latency snapshots,
+tenant token-bucket levels, the retry-budget level — lives in memory
+and dies with the process.  This journal makes an LB restart *warm*:
+the revived process re-adopts breaker backoffs (a known-bad replica
+stays ejected across the restart) and affinity residency (cache-aware
+routing resumes without re-learning the fleet) instead of starting
+blind.
+
+Design: a key->doc map persisted as an append-only log of one-line
+JSON records ``{"k": <key>, "v": <doc>}``.  Appends are cheap (one
+line + flush); every ``compact_every`` appends the file is rewritten
+to one line per live key via a temp file + ``os.replace`` (atomic on
+POSIX), so the journal stays small and a crash mid-compaction leaves
+the previous complete file.  ``fsync=True`` (used only on breaker
+transitions — the rare, high-value edges) forces the line to disk;
+routine soft-state writes ride the OS page cache, which is the right
+trade: losing two seconds of latency EWMA is free, losing an OPEN
+breaker means one bad request after restart.
+
+Loading tolerates a truncated tail (torn final line from a crash
+mid-append): complete lines win, the torn line is dropped.
+
+Determinism: the clock is injected (DET scope covers serve/); nothing
+here reads the wall clock.  Age is *this-process* age — seconds since
+the last put() by this process via the injected monotonic clock — and
+is None before the first write, because monotonic readings are not
+comparable across processes.
+"""
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu.analysis import sanitizers
+
+
+class LBJournal:
+
+    def __init__(self, path: str, clock: Callable[[], float],
+                 compact_every: int = 256) -> None:
+        assert clock is not None, 'inject the LB clock seam'
+        self.path = os.path.expanduser(path)
+        self._clock = clock
+        self._compact_every = max(1, int(compact_every))
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.lb_journal._lock')
+        self._state: Dict[str, Any] = {}  # guarded-by: _lock
+        self._appends = 0  # guarded-by: _lock (since last compaction)
+        self._last_put: Optional[float] = None  # guarded-by: _lock
+        self._fh = None  # guarded-by: _lock (append handle, lazy)
+        # True when the existing file ends mid-line (crash mid-append):
+        # the first append must start on a fresh line or it would fuse
+        # with the torn tail and corrupt BOTH records.
+        self._needs_newline = False  # guarded-by: _lock
+        os.makedirs(os.path.dirname(self.path) or '.', exist_ok=True)
+        self._load()
+
+    # --------------------------------------------------------------- load
+
+    def _load(self) -> None:
+        """Replay the log; later lines win.  A torn final line (crash
+        mid-append) is dropped silently — everything before it is a
+        complete record."""
+        if not os.path.exists(self.path):
+            return
+        with self._lock:   # constructor-only caller; lock for the record
+            with open(self.path, 'rb') as fb:
+                fb.seek(0, os.SEEK_END)
+                if fb.tell() > 0:
+                    fb.seek(-1, os.SEEK_END)
+                    self._needs_newline = fb.read(1) != b'\n'
+            with open(self.path, encoding='utf-8') as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail / corrupt line: skip
+                    if isinstance(rec, dict) and 'k' in rec:
+                        self._state[str(rec['k'])] = rec.get('v')
+
+    # -------------------------------------------------------------- write
+
+    def put(self, key: str, doc: Any, fsync: bool = False) -> None:
+        """Record `key` -> `doc` (any JSON-serialisable value).  With
+        fsync=True the line is forced to disk before returning — reserve
+        that for breaker transitions; soft state should not eat an
+        fsync per probe round."""
+        line = json.dumps({'k': key, 'v': doc}, sort_keys=True)
+        with self._lock:
+            self._state[key] = doc
+            if self._fh is None:
+                self._fh = open(self.path, 'a', encoding='utf-8')
+                if self._needs_newline:
+                    self._fh.write('\n')
+                    self._needs_newline = False
+            self._fh.write(line + '\n')
+            self._fh.flush()
+            if fsync:
+                os.fsync(self._fh.fileno())
+            self._last_put = self._clock()
+            self._appends += 1
+            if self._appends >= self._compact_every:
+                self._compact()
+
+    def _compact(self) -> None:  # locked: _lock
+        """Rewrite to one line per live key, atomically (temp file +
+        os.replace): a crash mid-compaction leaves the old file."""
+        tmp = self.path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            for key in sorted(self._state):
+                f.write(json.dumps({'k': key, 'v': self._state[key]},
+                                   sort_keys=True) + '\n')
+            f.flush()
+            os.fsync(f.fileno())
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        os.replace(tmp, self.path)
+        self._appends = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # --------------------------------------------------------------- read
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._state.get(key, default)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Deep-ish copy of the full key->doc map (one json round-trip:
+        callers may mutate freely)."""
+        with self._lock:
+            return json.loads(json.dumps(self._state))
+
+    def age_s(self) -> Optional[float]:
+        """Seconds since the last put() BY THIS PROCESS (injected
+        monotonic clock); None before the first write.  Not comparable
+        across restarts — a freshly revived LB reports None until its
+        first journal write."""
+        with self._lock:
+            if self._last_put is None:
+                return None
+            return max(0.0, self._clock() - self._last_put)

@@ -34,8 +34,34 @@ Replica fault tolerance (the supervisor half of the proxy):
   across failover attempts, so replay can never exceed the client's
   original deadline.
 
+Control-plane resilience (PR 18):
+
+- **Warm restart.**  With a journal (`serve/lb_journal.py`) attached,
+  the LB persists its slow-moving state — breaker machines + backoff
+  clocks (fsync'd on transitions), the affinity ``_seen`` residency
+  map, per-replica latency/tp snapshots, tenant bucket levels, the
+  retry-budget level — and a restarted LB re-adopts it instead of
+  starting blind.  Adopted replicas are *unverified* until one probe
+  round confirms them: the journal is trusted for backoff clocks
+  (pessimistic state ages out safely) but never for liveness.
+- **Gray-failure probation.**  Per-replica TTFT EWMAs are compared to
+  the fleet median after every probe round; a sustained outlier
+  (`circuit_breaker.evaluate_probation`) is shed to
+  ``lb_probation_weight`` of its traffic while probes keep watching —
+  a fail-slow replica stops dragging fleet p99 without a full eject.
+- **Retry budgets.**  Failure-driven retries/failovers withdraw from a
+  Finagle-style token budget refilled by successes; a dry budget turns
+  the next retry into a typed 503 ``error_class='retry_budget'``
+  instead of amplifying a brownout into a retry storm.
+- **TTFT hedging** (``SKYTPU_LB_HEDGE_MS``).  A resumable greedy
+  stream whose first byte misses the hedge deadline is issued to a
+  second replica; whichever arm produces the first event is promoted
+  to the client stream and the loser is cancelled (single-promotion
+  guard = dedup; ``hedges``/``hedge_wins``/``hedge_cancelled`` count
+  the wasted work).
+
 ``GET /lb/stats`` exports the counters (attempts, failovers, breaker
-opens, drains honored, streams resumed).
+opens, drains honored, streams resumed, hedges, retry-budget level).
 """
 import collections
 import json
@@ -58,6 +84,7 @@ from skypilot_tpu import logsys
 from skypilot_tpu.serve import constants
 from skypilot_tpu.serve import qos as serve_qos
 from skypilot_tpu.serve.circuit_breaker import CircuitBreaker
+from skypilot_tpu.serve.lb_journal import LBJournal
 from skypilot_tpu.serve.load_balancing_policies import (LoadBalancingPolicy,
                                                         RequestContext)
 
@@ -74,6 +101,10 @@ _PROBE_TIMEOUT = 2.0
 
 class _ClientGone(Exception):
     """The downstream client hung up; abandon the whole request."""
+
+
+class _HedgeCancelled(Exception):
+    """This hedge arm lost the race; stop relaying and unwind."""
 
 
 class _ReplicaHealth:
@@ -106,11 +137,14 @@ class _SSERelay:
         self.resumed = False            # a continuation attempt ran
 
     def send_headers(self, resp) -> None:
+        self.send_headers_raw(resp.status, resp.reason, resp.getheaders())
+
+    def send_headers_raw(self, status: int, reason: str, headers) -> None:
         if self.headers_sent:
             return
         h = self.handler
-        h.send_response(resp.status, resp.reason)
-        for k, v in resp.getheaders():
+        h.send_response(status, reason)
+        for k, v in headers:
             if k.lower() not in _HOP_BY_HOP and \
                     k.lower() != 'content-length':
                 h.send_header(k, v)
@@ -120,12 +154,35 @@ class _SSERelay:
         h.end_headers()
         self.headers_sent = True
 
+    def send_buffered_response(self, status: int, reason: str,
+                               headers, data: bytes) -> None:
+        """One fully-buffered non-SSE response (a replica's non-200
+        answer before any stream started)."""
+        try:
+            h = self.handler
+            h.send_response(status, reason)
+            for k, v in headers:
+                if k.lower() not in _HOP_BY_HOP and \
+                        k.lower() != 'content-length':
+                    h.send_header(k, v)
+            h.send_header('Content-Length', str(len(data)))
+            h.end_headers()
+            h.wfile.write(data)
+        except (OSError, socket.timeout) as e:
+            raise _ClientGone() from e
+        self.headers_sent = True
+
     def forward(self, raw: bytes) -> None:
         try:
             self.handler.wfile.write(raw)
             self.handler.wfile.flush()
         except (OSError, socket.timeout) as e:
             raise _ClientGone() from e
+
+    def note_tokens(self, tokens) -> None:
+        """Record token ids relayed to the client (continuation
+        reconstruction input)."""
+        self.streamed.extend(int(t) for t in tokens)
 
     def emit_event(self, payload: dict) -> None:
         self.forward(b'data: ' + json.dumps(payload).encode() + b'\n\n')
@@ -145,16 +202,132 @@ class _SSERelay:
             pass
 
 
+class _BufferRelay:
+    """One hedge arm's view of the client stream: buffers everything
+    until the arm is PROMOTED (buffer replays into the real relay and
+    later writes stream straight through) or CANCELLED (writes raise
+    `_HedgeCancelled` and the arm unwinds).  The promote/cancel edge is
+    taken exactly once under `_lock` — that single-promotion guard is
+    what dedups the hedged request: the client can never observe bytes
+    from both arms.
+    """
+
+    def __init__(self, inner: _SSERelay,
+                 on_first: Callable[[], None]) -> None:
+        self.inner = inner
+        self._on_first = on_first
+        self.headers_sent = False
+        self.streamed: List[int] = list(inner.streamed)
+        self._base = len(inner.streamed)
+        self.chunks_forwarded = inner.chunks_forwarded
+        self.resumed = inner.resumed
+        self.first_event = threading.Event()
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.load_balancer._hedge_relay_lock')
+        self._buf: list = []       # guarded-by: _lock
+        self._state = 'buffering'  # guarded-by: _lock
+
+    def send_headers(self, resp) -> None:
+        self.send_headers_raw(resp.status, resp.reason, resp.getheaders())
+
+    def send_headers_raw(self, status: int, reason: str, headers) -> None:
+        with self._lock:
+            if self._state == 'cancelled':
+                raise _HedgeCancelled()
+            if self._state == 'promoted':
+                self.inner.send_headers_raw(status, reason, list(headers))
+            elif not self.headers_sent:
+                self._buf.append(
+                    ('headers', (status, reason,
+                                 [list(kv) for kv in headers])))
+            self.headers_sent = True
+
+    def send_buffered_response(self, status: int, reason: str,
+                               headers, data: bytes) -> None:
+        with self._lock:
+            if self._state == 'cancelled':
+                raise _HedgeCancelled()
+            if self._state == 'promoted':
+                self.inner.send_buffered_response(
+                    status, reason, list(headers), data)
+            else:
+                self._buf.append(
+                    ('response', (status, reason,
+                                  [list(kv) for kv in headers],
+                                  bytes(data))))
+            self.headers_sent = True
+        self.first_event.set()
+        self._on_first()
+
+    def forward(self, raw: bytes) -> None:
+        with self._lock:
+            if self._state == 'cancelled':
+                raise _HedgeCancelled()
+            if self._state == 'promoted':
+                self.inner.forward(raw)
+            else:
+                self._buf.append(('raw', bytes(raw)))
+        self.first_event.set()
+        self._on_first()
+
+    def emit_event(self, payload: dict) -> None:
+        self.forward(b'data: ' + json.dumps(payload).encode() + b'\n\n')
+
+    def note_tokens(self, tokens) -> None:
+        # Under the hedge lock: promote() merges + aliases `streamed`
+        # while holding it, so an append lands either in the buffer's
+        # list (pre-merge, carried over) or the inner relay's (post).
+        with self._lock:
+            self.streamed.extend(int(t) for t in tokens)
+
+    def promote(self) -> None:
+        """This arm won: replay the buffer into the client stream; all
+        later writes go straight through.  Idempotent; a cancelled arm
+        stays cancelled."""
+        with self._lock:
+            if self._state != 'buffering':
+                return
+            self._state = 'promoted'
+            # Merge token bookkeeping FIRST, then alias: the streaming
+            # thread appends to whatever `self.streamed` points at, so
+            # after the alias its appends land in the inner relay.
+            self.inner.streamed.extend(self.streamed[self._base:])
+            self.streamed = self.inner.streamed
+            buffered, self._buf = self._buf, []
+            for kind, args in buffered:
+                if kind == 'headers':
+                    self.inner.send_headers_raw(*args)
+                elif kind == 'response':
+                    self.inner.send_buffered_response(*args)
+                else:
+                    self.inner.forward(args)
+
+    def cancel(self) -> None:
+        """This arm lost: drop the buffer; the arm's next write raises
+        and its attempt unwinds as outcome 'cancelled'."""
+        with self._lock:
+            if self._state == 'buffering':
+                self._state = 'cancelled'
+                self._buf = []
+
+
 class SkyTpuLoadBalancer:
 
     def __init__(self, controller_url: Optional[str], port: int,
                  policy: LoadBalancingPolicy,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 journal: Optional[LBJournal] = None,
+                 server_cls: type = ThreadingHTTPServer):
         """controller_url=None: standalone mode (tests, the chaos
         harness) — no controller sync; the caller seeds the policy's
         replica set directly.  ``clock``: monotonic-seconds source for
         the per-request deadline budget (injectable so failover-budget
-        tests replay deterministically)."""
+        tests replay deterministically).  ``journal``: warm-restart
+        journal to adopt + keep current (None = journalling off, the
+        pre-existing cold-restart behaviour).  ``server_cls``: the
+        HTTP server base class run() builds on — the chaos harness
+        injects a socket-tracking subclass so `lb_kill` can sever live
+        client connections like a real process death."""
         self.controller_url = controller_url
         self._clock = clock
         self.port = port
@@ -193,6 +366,13 @@ class SkyTpuLoadBalancer:
             'hot_handoffs': 0,
             'handoff_prefixes': 0,
             'handoff_failures': 0,
+            # TTFT hedging: hedges launched, races the hedge arm won,
+            # loser arms cancelled (wasted replica work), and retries
+            # refused because the retry budget ran dry.
+            'hedges': 0,
+            'hedge_wins': 0,
+            'hedge_cancelled': 0,
+            'retry_budget_exhausted': 0,
         }
         # LB-side QoS plane: per-tenant token buckets (serve/qos.py)
         # share the LB's injected clock so rate-limit tests replay
@@ -204,6 +384,34 @@ class SkyTpuLoadBalancer:
         # TTFT — still SLO-relevant signal).  Summaries feed /lb/stats
         # and the controller sync for the SLO autoscaler.
         self._latency: Dict[str, collections.deque] = {}  # guarded-by: _stats_lock
+        # Fleet-wide retry budget: failure-driven retries/hedges spend,
+        # completed requests earn (serve/qos.RetryBudget).
+        self.retry_budget = serve_qos.RetryBudget(
+            ratio=constants.lb_retry_budget_ratio(),
+            reserve_per_s=constants.lb_retry_budget_reserve(),
+            cap=constants.lb_retry_budget_cap(),
+            clock=self._clock)
+        # Gray-failure probation knobs (read once; circuit_breaker.py
+        # holds the per-replica state machines).
+        self._probation_weight = constants.lb_probation_weight()
+        self._probation_k = constants.lb_probation_k()
+        self._probation_enter = constants.lb_probation_enter()
+        self._probation_exit = constants.lb_probation_exit()
+        self._ewma_alpha = constants.lb_ewma_alpha()
+        self._hedge_s = max(0.0, constants.lb_hedge_ms() / 1000.0)
+        # Probation traffic shed draws: seeded from the port so a fleet
+        # replays its shed pattern run-over-run.
+        self._shed_rng = np.random.default_rng(port)  # guarded-by: _health_lock
+        self._server_cls = server_cls
+        # Warm-restart journal.  Replicas adopted FROM the journal are
+        # quarantined in _adopted_unverified until one probe round
+        # confirms them (journalled backoffs are trusted; journalled
+        # liveness never is).
+        self.journal = journal
+        self._adopted_unverified: set = set()  # guarded-by: _health_lock
+        self._breaker_snapshots: Dict[str, dict] = {}  # guarded-by: _health_lock
+        if journal is not None:
+            self._adopt_journal()
 
     # ----------------------------------------------------- health/breakers
 
@@ -219,6 +427,9 @@ class SkyTpuLoadBalancer:
                     maxlen=constants.slo_latency_window())
                 self._latency[replica] = window
             window.append(seconds)
+        # Gray-failure track: the breaker's TTFT EWMA is what
+        # _evaluate_probation compares against the fleet median.
+        self._rep(replica).breaker.record_latency(seconds)
 
     def _latency_summary(self) -> Dict[str, Dict[str, float]]:
         """Per-replica TTFT percentiles (ms) over the rolling window —
@@ -242,11 +453,150 @@ class SkyTpuLoadBalancer:
             if h is None:
                 # Seed the jitter stream from the URL so a given fleet
                 # lays out backoff deterministically run-over-run.
-                h = _ReplicaHealth(CircuitBreaker(
+                breaker = CircuitBreaker(
                     rng=np.random.default_rng(
-                        zlib.crc32(url.encode()) & 0xffffffff)))
+                        zlib.crc32(url.encode()) & 0xffffffff),
+                    probation_k=self._probation_k,
+                    probation_enter=self._probation_enter,
+                    probation_exit=self._probation_exit,
+                    ewma_alpha=self._ewma_alpha)
+                snap = self._breaker_snapshots.pop(url, None)
+                if snap is not None:
+                    breaker.restore(snap)
+                if self.journal is not None:
+                    # fsync'd journal write on every breaker edge: an
+                    # OPEN state that doesn't survive a crash means one
+                    # guaranteed-bad request after restart.
+                    breaker.on_transition = (
+                        lambda _state, u=url: self._journal_breaker(u))
+                h = _ReplicaHealth(breaker)
                 self._health[url] = h
             return h
+
+    # -------------------------------------------- warm-restart journal
+
+    _JOURNAL_BREAKER_PREFIX = 'breaker:'
+
+    def _adopt_journal(self) -> None:
+        """Re-adopt the journal's state at construction.  Breaker
+        snapshots are staged for lazy _rep() materialisation; every
+        journalled replica starts UNVERIFIED (excluded from routing)
+        until one probe round answers for it."""
+        snap = self.journal.snapshot()
+        urls = set()
+        with self._health_lock:
+            for key, doc in snap.items():
+                if key.startswith(self._JOURNAL_BREAKER_PREFIX) and \
+                        isinstance(doc, dict):
+                    urls.add(key[len(self._JOURNAL_BREAKER_PREFIX):])
+                    self._breaker_snapshots[
+                        key[len(self._JOURNAL_BREAKER_PREFIX):]] = doc
+        seen = snap.get('affinity_seen')
+        if isinstance(seen, dict):
+            self.policy.import_seen(seen)
+        qos_doc = snap.get('qos')
+        if isinstance(qos_doc, dict):
+            self.limiter.restore(qos_doc)
+        budget = snap.get('retry_budget')
+        if isinstance(budget, dict):
+            self.retry_budget.restore(budget)
+        latency = snap.get('latency')
+        if isinstance(latency, dict):
+            with self._stats_lock:
+                for url, vals in latency.items():
+                    if isinstance(vals, list):
+                        window = collections.deque(
+                            maxlen=constants.slo_latency_window())
+                        window.extend(float(v) for v in vals)
+                        self._latency[url] = window
+                        urls.add(url)
+        tp = snap.get('replica_tp')
+        if isinstance(tp, dict):
+            with self._health_lock:
+                for url, v in tp.items():
+                    self._replica_tp[url] = int(v)
+                    urls.add(url)
+        with self._health_lock:
+            self._adopted_unverified |= urls
+        for url in sorted(urls):
+            self._rep(url)   # materialise now: restores the snapshot
+        if urls:
+            logger.info(
+                'LB: adopted journal state for %d replica(s); '
+                'awaiting one probe round before routing to them',
+                len(urls))
+
+    def _journal_breaker(self, url: str) -> None:
+        """Persist one breaker's snapshot, fsync'd (breaker edges are
+        the rare, high-value journal writes)."""
+        if self.journal is None:
+            return
+        with self._health_lock:
+            h = self._health.get(url)
+        if h is not None:
+            self.journal.put(self._JOURNAL_BREAKER_PREFIX + url,
+                             h.breaker.snapshot(), fsync=True)
+
+    def _journal_soft_state(self) -> None:
+        """Persist the slow-moving soft state once per probe round —
+        flushed, not fsync'd: losing a probe-interval of it is free."""
+        if self.journal is None:
+            return
+        seen = self.policy.export_seen()
+        if seen is not None:
+            self.journal.put('affinity_seen', seen)
+        self.journal.put('qos', self.limiter.snapshot())
+        self.journal.put('retry_budget', self.retry_budget.snapshot())
+        with self._stats_lock:
+            latency = {u: list(w) for u, w in self._latency.items() if w}
+        with self._health_lock:
+            tp = dict(self._replica_tp)
+        self.journal.put('latency', latency)
+        self.journal.put('replica_tp', tp)
+
+    def _mark_verified(self, url: str) -> None:
+        with self._health_lock:
+            self._adopted_unverified.discard(url)
+
+    # --------------------------------------------- gray-failure probation
+
+    def _evaluate_probation(self) -> None:
+        """Once per probe round: compare every replica's TTFT EWMA to
+        the fleet median and step the probation state machines.  Needs
+        two replicas with samples — with one signal there is no
+        'fleet' to be an outlier of."""
+        with self._health_lock:
+            breakers = {u: h.breaker for u, h in self._health.items()}
+        ewmas = [b.latency_ewma for b in breakers.values()]
+        ewmas = [e for e in ewmas if e is not None]
+        if len(ewmas) < 2:
+            return
+        median = float(np.median(np.asarray(ewmas)))
+        for url, breaker in sorted(breakers.items()):
+            if breaker.evaluate_probation(median):
+                # (on_transition already journalled the edge, fsync'd.)
+                verb = ('entered' if breaker.in_probation() else 'left')
+                logger.warning(
+                    'LB: replica %s %s probation (TTFT EWMA %s s vs '
+                    'fleet median %.4f s)', url, verb,
+                    breaker.latency_ewma, median)
+
+    def reset_gray_state(self) -> int:
+        """Forget every replica's gray-failure evidence (TTFT EWMAs,
+        hysteresis streaks, probation flags) and the per-replica
+        latency windows behind ``lb_stats()['replica_latency']``.
+        Probation normally clears through fresh healthy samples, but a
+        replica shed to the probation weight may see too little
+        traffic to ever refresh its stale EWMA — after a maintenance
+        window (or between fault-injection episodes that must not
+        contaminate each other) the operator knows the old evidence is
+        dead.  Returns how many replicas left probation."""
+        with self._health_lock:
+            breakers = [h.breaker for h in self._health.values()]
+        exited = sum(1 for b in breakers if b.reset_latency_state())
+        with self._stats_lock:
+            self._latency.clear()
+        return exited
 
     @staticmethod
     def _hot_handoff_enabled() -> bool:
@@ -277,11 +627,29 @@ class SkyTpuLoadBalancer:
 
     def _routing_exclude(self, tried) -> set:
         """Replicas a select must skip: already tried this request,
-        breaker open, or draining."""
+        breaker open, draining, journal-adopted-but-unverified, or (in
+        ~1-probation_weight of draws) in probation.  The quarantine and
+        the shed are both availability-bounded: they never empty the
+        candidate set — a fleet that is entirely unverified or entirely
+        in probation still serves."""
         ex = set(tried)
+        ready = set(self.policy.ready_replicas)
         with self._health_lock:
+            probation = []
             for url, h in self._health.items():
                 if h.draining or not h.breaker.available():
+                    ex.add(url)
+                elif h.breaker.in_probation():
+                    probation.append(url)
+            unverified = {u for u in self._adopted_unverified
+                          if u in ready}
+            if unverified and (ready - ex - unverified):
+                ex |= unverified
+            for url in sorted(probation):
+                if float(self._shed_rng.random()) < \
+                        self._probation_weight:
+                    continue   # the trickle that keeps it convalescing
+                if ready - ex - {url}:
                     ex.add(url)
         return ex
 
@@ -312,6 +680,7 @@ class SkyTpuLoadBalancer:
             # Not a /healthz speaker (404 from a plain HTTP replica):
             # any response proves the process is alive.
             h.breaker.record_success()
+            self._mark_verified(url)
             self._mark_draining(url, False)
             return
         # Affinity-aware policies read kv/radix counters out of the
@@ -337,6 +706,7 @@ class SkyTpuLoadBalancer:
             # 'draining' is alive (it is finishing real work) — the
             # drain flag, not the breaker, keeps traffic away.
             h.breaker.record_success()
+            self._mark_verified(url)
         else:
             # Explicit 'dead' (serving loop gave up) or 'starting':
             # a live process that cannot serve is ejected like a dead
@@ -350,6 +720,8 @@ class SkyTpuLoadBalancer:
                 if self._stop.is_set():
                     return
                 self._probe_replica_once(url)
+            self._evaluate_probation()
+            self._journal_soft_state()
             self._stop.wait(constants.lb_health_probe_interval())
 
     # ------------------------------------------------- hot-set handoff
@@ -464,6 +836,8 @@ class SkyTpuLoadBalancer:
             inflight = {u: h.outstanding for u, h in self._health.items()}
             draining = sorted(u for u, h in self._health.items()
                               if h.draining)
+            probation = sorted(u for u, h in self._health.items()
+                               if h.breaker.in_probation())
             replica_tp = dict(self._replica_tp)
         body = json.dumps({'request_timestamps': timestamps,
                            'replica_inflight': inflight,
@@ -473,6 +847,12 @@ class SkyTpuLoadBalancer:
                            'tenant_qos': self.limiter.stats(),
                            'replica_latency': self._latency_summary(),
                            'replica_tp': replica_tp,
+                           'replica_probation': probation,
+                           'retry_budget':
+                               self.retry_budget.remaining(),
+                           'journal_age_s':
+                               (None if self.journal is None
+                                else self.journal.age_s()),
                            }).encode()
         req = urllib.request.Request(
             self.controller_url + '/controller/load_balancer_sync',
@@ -693,10 +1073,12 @@ class SkyTpuLoadBalancer:
     def _proxy_stream_once(self, replica: str, path: str, payload: dict,
                            relay: _SSERelay, timeout: float) -> str:
         """One SSE generate attempt against one replica, relaying
-        complete events through `relay`.  Returns 'done' (final event
+        complete events through `relay` (the real client stream, or a
+        `_BufferRelay` hedge arm).  Returns 'done' (final event
         forwarded), 'broken' (stream ended without one — failover
         material), 'unreachable', 'shed', 'draining', 'failed' (replica
-        rejected a continuation — not retryable), or 'client_gone'."""
+        rejected a continuation — not retryable), 'client_gone', or
+        'cancelled' (this hedge arm lost the race)."""
         parsed = urllib.parse.urlsplit(replica)
         conn = HTTPConnection(parsed.hostname, parsed.port,
                               timeout=timeout)
@@ -722,18 +1104,8 @@ class SkyTpuLoadBalancer:
                     # cannot be resumed here or anywhere.
                     return 'failed'
                 data = resp.read()
-                try:
-                    relay.handler.send_response(resp.status, resp.reason)
-                    for k, v in resp.getheaders():
-                        if k.lower() not in _HOP_BY_HOP and \
-                                k.lower() != 'content-length':
-                            relay.handler.send_header(k, v)
-                    relay.handler.send_header('Content-Length',
-                                              str(len(data)))
-                    relay.handler.end_headers()
-                    relay.handler.wfile.write(data)
-                except (OSError, socket.timeout):
-                    return 'client_gone'
+                relay.send_buffered_response(
+                    resp.status, resp.reason, resp.getheaders(), data)
                 return 'done'
             relay.send_headers(resp)
             buf = b''
@@ -770,12 +1142,13 @@ class SkyTpuLoadBalancer:
                         return 'done'
                     if obj is not None and \
                             isinstance(obj.get('tokens'), list):
-                        relay.streamed.extend(
-                            int(t) for t in obj['tokens'])
+                        relay.note_tokens(obj['tokens'])
                     relay.forward(raw)
                     relay.chunks_forwarded += 1
         except _ClientGone:
             return 'client_gone'
+        except _HedgeCancelled:
+            return 'cancelled'
         finally:
             conn.close()
 
@@ -912,6 +1285,7 @@ class SkyTpuLoadBalancer:
                                            forward_shed=False)
                 if outcome == 'ok':
                     self._rep(replica).breaker.record_success()
+                    self.retry_budget.deposit()
                     return
                 if outcome == 'shed':
                     # Admission-shed: the replica did no work — another
@@ -922,6 +1296,11 @@ class SkyTpuLoadBalancer:
                 if outcome == 'draining':
                     continue
                 self._rep(replica).breaker.record_failure()
+                if not self._retry_budget_spend():
+                    self._send_json(handler, 503, {
+                        'error': self._RETRY_BUDGET_MSG,
+                        'error_class': 'retry_budget'})
+                    return
                 logger.warning('LB: replica %s unreachable, retrying',
                                replica)
             finally:
@@ -971,6 +1350,7 @@ class SkyTpuLoadBalancer:
                 self.policy.request_done(replica)
             if outcome == 'done':
                 self._rep(replica).breaker.record_success()
+                self.retry_budget.deposit()
                 return
             if outcome == 'shed':
                 self._rep(replica).breaker.record_success()
@@ -981,6 +1361,11 @@ class SkyTpuLoadBalancer:
             # unreachable / broken: connection-level failure.
             self._rep(replica).breaker.record_failure()
             had_break |= outcome == 'broken'
+            if not self._retry_budget_spend():
+                self._send_json(handler, 503, {
+                    'error': self._RETRY_BUDGET_MSG,
+                    'error_class': 'retry_budget'})
+                return
             logger.warning('LB: replica %s %s, retrying elsewhere',
                            replica, outcome)
         if shed_replica is not None:
@@ -992,15 +1377,129 @@ class SkyTpuLoadBalancer:
         self._no_replica_response(
             handler, deadline_spent=left is not None and left <= 0)
 
+    def _retry_budget_spend(self) -> bool:
+        """Withdraw one failure-driven retry/hedge token; False means
+        the budget is dry and the caller must answer the typed 503
+        instead of piling on.  Shed/drain redirects are NOT charged —
+        they cost the fleet nothing."""
+        if self.retry_budget.try_withdraw():
+            return True
+        self._bump('retry_budget_exhausted')
+        return False
+
+    _RETRY_BUDGET_MSG = ('retry budget exhausted: the fleet is failing '
+                         'faster than it is succeeding; not retrying')
+
+    def _stream_budget_exhausted(self, handler, relay: _SSERelay) -> None:
+        if relay.headers_sent:
+            relay.emit_error_event(self._RETRY_BUDGET_MSG, 'retry_budget')
+        else:
+            self._send_json(handler, 503, {
+                'error': self._RETRY_BUDGET_MSG,
+                'error_class': 'retry_budget'})
+
+    def _attempt_stream(self, replica: str, route: dict, payload: dict,
+                        relay, timeout: float) -> str:
+        """One tracked stream attempt: counters + outstanding + policy
+        accounting around _proxy_stream_once (shared by the direct path
+        and each hedge arm's thread)."""
+        self._bump('attempts')
+        self._adjust_outstanding(replica, 1)
+        try:
+            return self._proxy_stream_once(
+                replica, route['path'], payload, relay, timeout)
+        finally:
+            self._adjust_outstanding(replica, -1)
+            self.policy.request_done(replica)
+
+    def _hedged_attempt(self, primary: str, route: dict, relay: _SSERelay,
+                        tried: set, left: Optional[float]):
+        """TTFT hedge around the FIRST attempt of a resumable greedy
+        stream.  The primary streams into a buffer; if its first event
+        misses the hedge deadline (and the retry budget allows), the
+        request is issued to the next-best replica and whichever arm
+        produces a first event is promoted to the client stream — the
+        loser is cancelled.  Returns (outcome, winning_replica);
+        `tried` gains every replica an arm touched."""
+        any_first = threading.Event()
+        results: Dict[str, str] = {}
+
+        def run_arm(url: str, buf: '_BufferRelay') -> None:
+            results[url] = self._attempt_stream(
+                url, route, route['payload'], buf,
+                self._attempt_timeout(left))
+            any_first.set()   # completion (even a failure) wakes the race
+
+        p_buf = _BufferRelay(relay, any_first.set)
+        p_thread = threading.Thread(
+            target=run_arm, args=(primary, p_buf), daemon=True,
+            name='lb-hedge-primary')
+        p_thread.start()
+        secondary = None
+        s_buf = None
+        s_thread = None
+        if not p_buf.first_event.wait(self._hedge_s) and \
+                p_thread.is_alive():
+            # Hedge deadline passed with no first byte.  A hedge is a
+            # speculative retry: it spends a retry-budget token, and a
+            # dry budget silently skips the hedge (the primary is still
+            # running — nothing to fail).
+            if self._retry_budget_spend():
+                secondary = self.policy.select_replica(
+                    exclude=self._routing_exclude(tried),
+                    context=route.get('context'))
+            if secondary is not None:
+                tried.add(secondary)
+                self._bump('hedges')
+                s_buf = _BufferRelay(relay, any_first.set)
+                s_thread = threading.Thread(
+                    target=run_arm, args=(secondary, s_buf),
+                    daemon=True, name='lb-hedge-secondary')
+                s_thread.start()
+                while not (p_buf.first_event.is_set() or
+                           s_buf.first_event.is_set() or
+                           (not p_thread.is_alive() and
+                            not s_thread.is_alive())):
+                    any_first.wait(0.02)
+                    any_first.clear()
+        # Pick the winner: first byte beats no byte; the primary wins
+        # ties (deterministic, and its buffer is never behind).
+        if s_buf is not None and s_buf.first_event.is_set() and \
+                not p_buf.first_event.is_set():
+            winner, w_buf, w_thread = secondary, s_buf, s_thread
+            loser_buf, loser_thread = p_buf, p_thread
+            self._bump('hedge_wins')
+        else:
+            winner, w_buf, w_thread = primary, p_buf, p_thread
+            loser_buf, loser_thread = s_buf, s_thread
+        if loser_buf is not None:
+            loser_buf.cancel()
+            self._bump('hedge_cancelled')
+        try:
+            w_buf.promote()
+        except _ClientGone:
+            return 'client_gone', winner
+        w_thread.join()
+        if loser_thread is not None:
+            # The loser unwinds on its next write (HedgeCancelled) or
+            # at stream EOF; bounded by the attempt timeout either way.
+            loser_thread.join(timeout=self._attempt_timeout(left))
+        relay.chunks_forwarded = w_buf.chunks_forwarded
+        relay.resumed = w_buf.resumed
+        return results.get(winner, 'broken'), winner
+
     def _handle_stream_generate(self, handler, route: dict) -> None:
         """SSE generate with mid-stream failover: resumable streams are
         continued on a survivor byte-identically; non-resumable streams
-        that already relayed tokens fail fast with a typed error."""
+        that already relayed tokens fail fast with a typed error.  The
+        first attempt of a resumable stream is hedged when
+        SKYTPU_LB_HEDGE_MS is set."""
         remaining = self._deadline_clock(route)
         relay = _SSERelay(handler)
         payload = route['payload']
         tried = set()
         shed_replica = None
+        first_attempt = True
         for _ in range(_MAX_ATTEMPTS):
             left = remaining()
             if left is not None and left <= 0:
@@ -1011,18 +1510,19 @@ class SkyTpuLoadBalancer:
             if replica is None:
                 break
             tried.add(replica)
-            self._bump('attempts')
             resuming = relay.resumed
-            self._adjust_outstanding(replica, 1)
-            try:
-                outcome = self._proxy_stream_once(
-                    replica, route['path'], payload, relay,
-                    timeout=self._attempt_timeout(left))
-            finally:
-                self._adjust_outstanding(replica, -1)
-                self.policy.request_done(replica)
+            if first_attempt and route['resumable'] and \
+                    self._hedge_s > 0:
+                outcome, replica = self._hedged_attempt(
+                    replica, route, relay, tried, left)
+            else:
+                outcome = self._attempt_stream(
+                    replica, route, payload, relay,
+                    self._attempt_timeout(left))
+            first_attempt = False
             if outcome == 'done':
                 self._rep(replica).breaker.record_success()
+                self.retry_budget.deposit()
                 if resuming:
                     self._bump('streams_resumed')
                 return
@@ -1042,14 +1542,23 @@ class SkyTpuLoadBalancer:
             # unreachable / broken.
             self._rep(replica).breaker.record_failure()
             if outcome == 'unreachable':
+                if not self._retry_budget_spend():
+                    self._stream_budget_exhausted(handler, relay)
+                    return
                 continue
             # broken: the replica died mid-stream.
             if relay.chunks_forwarded == 0 and not relay.headers_sent:
+                if not self._retry_budget_spend():
+                    self._stream_budget_exhausted(handler, relay)
+                    return
                 continue   # nothing reached the client: plain retry
             if not route['resumable']:
                 if relay.chunks_forwarded == 0:
                     # Headers out but no tokens: a fresh replay is
                     # observationally identical for the client.
+                    if not self._retry_budget_spend():
+                        self._stream_budget_exhausted(handler, relay)
+                        return
                     continue
                 # Tokens already relayed and the continuation is not
                 # reconstructible (sampled / unbounded / text prompt):
@@ -1060,6 +1569,9 @@ class SkyTpuLoadBalancer:
                     'replica died mid-stream; request is not resumable '
                     '(requires temperature=0, token prompt and '
                     'max_new_tokens)', 'non_resumable')
+                return
+            if not self._retry_budget_spend():
+                self._stream_budget_exhausted(handler, relay)
                 return
             self._bump('failovers')
             left = remaining()
@@ -1114,6 +1626,9 @@ class SkyTpuLoadBalancer:
             outstanding = {u: h.outstanding
                            for u, h in self._health.items()
                            if h.outstanding}
+            probation = sorted(u for u, h in self._health.items()
+                               if h.breaker.in_probation())
+            unverified = sorted(self._adopted_unverified)
             tiers = [dict(t) for t in self._replica_host_tier.values()]
         # Fleet host-tier aggregate: occupancy + spill/restore traffic
         # summed over tier-enabled replicas, hit rate averaged.
@@ -1147,6 +1662,11 @@ class SkyTpuLoadBalancer:
             'policy': self.policy.stats(),
             'qos': self.limiter.stats(),  # wire-ok: operator metrics surface
             'replica_latency': self._latency_summary(),  # wire-ok: operator metrics surface
+            'probation_replicas': probation,
+            'retry_budget_remaining': self.retry_budget.remaining(),
+            'journal_age_s': (None if self.journal is None
+                              else self.journal.age_s()),
+            'adopted_unverified': unverified,
         })
         return counters
 
@@ -1180,7 +1700,8 @@ class SkyTpuLoadBalancer:
         probe_thread = threading.Thread(target=self._probe_loop,
                                         daemon=True, name='lb-probe')
         probe_thread.start()
-        class _Server(ThreadingHTTPServer):
+
+        class _Server(self._server_cls):
             # Default listen backlog (5) RSTs connections during
             # arrival bursts; user traffic funnels through this port.
             request_queue_size = 128
@@ -1197,7 +1718,23 @@ class SkyTpuLoadBalancer:
             self._httpd.shutdown()
 
 
+def make_load_balancer(controller_url: Optional[str], port: int,
+                       policy_name: str) -> SkyTpuLoadBalancer:
+    """Build an LB with the journal wired from SKYTPU_LB_JOURNAL (empty
+    = no journal = cold restarts).  This is the supervisor's factory:
+    each restart re-runs it, and journal re-adoption happens in the LB
+    constructor."""
+    policy = LoadBalancingPolicy.make(policy_name)
+    journal = None
+    path = constants.lb_journal_path()
+    if path:
+        journal = LBJournal(
+            os.path.expanduser(path), clock=time.monotonic,
+            compact_every=constants.lb_journal_compact_every())
+    return SkyTpuLoadBalancer(controller_url, port, policy,
+                              journal=journal)
+
+
 def run_load_balancer(controller_url: str, port: int,
                       policy_name: str) -> None:
-    policy = LoadBalancingPolicy.make(policy_name)
-    SkyTpuLoadBalancer(controller_url, port, policy).run()
+    make_load_balancer(controller_url, port, policy_name).run()

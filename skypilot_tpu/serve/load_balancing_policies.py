@@ -100,6 +100,15 @@ class LoadBalancingPolicy:
         """Policy-specific counters for GET /lb/stats."""
         return {'name': self.NAME}
 
+    def export_seen(self) -> Optional[dict]:
+        """Warm-restart journal export of the policy's slow-moving
+        routing state; None (the default) = nothing to journal."""
+        return None
+
+    def import_seen(self, doc: dict) -> None:
+        """Re-adopt a prior export_seen() doc after an LB restart.
+        Default: no-op."""
+
 
 class RoundRobinPolicy(LoadBalancingPolicy):
     """Parity: sky/serve/load_balancing_policies.py:47."""
@@ -431,6 +440,43 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
                 self._block_size = bs
                 self._seen.clear()
             self._kv[replica] = kv
+
+    # ------------------------------------------------ journal (PR 18)
+
+    def export_seen(self) -> Optional[dict]:
+        """The residency shadow map + tick, JSON-shaped: chain hashes
+        become decimal strings (JSON object keys are strings).  This is
+        the state an LB restart cannot re-learn quickly — losing it
+        costs one full cold pass of prefix re-prefills fleet-wide."""
+        with self._lock:
+            return {
+                'tick': self._tick,
+                'block_size': self._block_size,
+                'seen': {str(h): dict(holders)
+                         for h, holders in self._seen.items()},
+            }
+
+    def import_seen(self, doc: dict) -> None:
+        """Re-adopt an export_seen() doc.  Residency is a hint, never a
+        correctness input, so a stale entry is harmless (worst case:
+        one spill picks a colder survivor)."""
+        if not isinstance(doc, dict):
+            return
+        with self._lock:
+            self._tick = max(self._tick, int(doc.get('tick', 0)))
+            bs = doc.get('block_size')
+            if isinstance(bs, int) and bs > 0:
+                self._block_size = bs
+            for key, holders in (doc.get('seen') or {}).items():
+                try:
+                    h = int(key)
+                except (TypeError, ValueError):
+                    continue
+                if isinstance(holders, dict):
+                    self._seen[h] = {str(u): int(t)
+                                     for u, t in holders.items()}
+            while len(self._seen) > self._SEEN_CAP:
+                self._seen.popitem(last=False)
 
     def stats(self) -> dict:
         with self._lock:

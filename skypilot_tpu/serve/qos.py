@@ -49,6 +49,17 @@ class TokenBucket:
             return None
         return max(0.0, (n - self._tokens) / self.rate)
 
+    def level(self) -> float:
+        """Current token level WITHOUT refilling (journal snapshot)."""
+        return self._tokens
+
+    def set_level(self, tokens: float) -> None:
+        """Re-adopt a journalled level (clamped to capacity); resets
+        the refill clock to now so no phantom refill accrues for the
+        downtime."""
+        self._tokens = max(0.0, min(self.burst, float(tokens)))
+        self._last = self._clock()
+
 
 class TenantRateLimiter:
     """Per-tenant token buckets with admitted/rejected counters.
@@ -115,3 +126,95 @@ class TenantRateLimiter:
                 'tenants': {t: dict(c)
                             for t, c in self._counters.items()},
             }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Journalable bucket levels + counters (JSON-serialisable).
+        Unlimited tenants (bucket None) carry a null level."""
+        with self._lock:
+            return {
+                'levels': {t: (None if b is None else b.level())
+                           for t, b in self._buckets.items()},
+                'counters': {t: dict(c)
+                             for t, c in self._counters.items()},
+            }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        """Re-adopt journalled bucket levels + counters: a tenant that
+        burned its burst before the LB died must not get a fresh burst
+        from the restart."""
+        with self._lock:
+            for tenant, level in (snap.get('levels') or {}).items():
+                bucket = self._bucket(tenant)
+                if bucket is not None and level is not None:
+                    bucket.set_level(float(level))
+            for tenant, row in (snap.get('counters') or {}).items():
+                self._counters[tenant] = {
+                    'admitted': int(row.get('admitted', 0)),
+                    'rejected': int(row.get('rejected', 0))}
+
+
+class RetryBudget:
+    """Finagle-style retry budget for a replica set: retries and
+    mid-stream failovers WITHDRAW a token; completed requests DEPOSIT
+    ``ratio`` tokens (refill proportional to successes), plus a small
+    constant ``reserve_per_s`` trickle so a cold fleet can still retry.
+    When the bucket is dry the LB answers a typed 503
+    (`error_class='retry_budget'`) instead of amplifying a brownout
+    into a retry storm — with ratio=0.2 the fleet can never spend more
+    than ~20% extra attempts on top of its successful throughput.
+
+    Starts FULL (cap tokens): a fresh LB facing a flaky replica must be
+    able to retry immediately; the budget only bites under sustained
+    failure.  Clock injected; thread-safe."""
+
+    def __init__(self, ratio: float = 0.2, reserve_per_s: float = 0.1,
+                 cap: float = 100.0, clock=None) -> None:
+        assert clock is not None, 'inject the LB clock seam'
+        self.ratio = float(ratio)
+        self.reserve_per_s = float(reserve_per_s)
+        self.cap = float(cap)
+        self._clock = clock
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.qos.retry_budget._lock')
+        self._tokens = self.cap  # guarded-by: _lock
+        self._last = clock()  # guarded-by: _lock (reserve-refill clock)
+
+    def _refill(self) -> None:  # locked: _lock
+        now = self._clock()
+        self._tokens = min(
+            self.cap,
+            self._tokens + (now - self._last) * self.reserve_per_s)
+        self._last = now
+
+    def deposit(self) -> None:
+        """One request completed successfully: earn `ratio` retries."""
+        with self._lock:
+            self._refill()
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_withdraw(self) -> bool:
+        """Spend one retry/hedge token.  False = budget exhausted: the
+        caller must fail the request with error_class='retry_budget'
+        rather than pile on."""
+        with self._lock:
+            self._refill()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def remaining(self) -> float:
+        with self._lock:
+            self._refill()
+            return self._tokens
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            self._refill()
+            return {'tokens': self._tokens}
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        with self._lock:
+            self._tokens = max(
+                0.0, min(self.cap, float(snap.get('tokens', self.cap))))
+            self._last = self._clock()

@@ -10,11 +10,12 @@ flow is deterministic and testable.
 import concurrent.futures
 import json
 import os
+import threading
 import time
 import traceback
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu import logsys
 from skypilot_tpu.serve import constants, serve_state
@@ -352,3 +353,129 @@ class ReplicaManager:
                     'replica job failed')
                 self.scale_down(rid, purge=False,
                                 final_status=ReplicaStatus.FAILED)
+
+
+class LoadBalancerSupervisor:
+    """Supervise the load balancer like a replica (PR 18).
+
+    The LB is the one single point of failure in the serve plane: every
+    replica has a prober and a replacement path, but a dead LB used to
+    mean a dead service until a human noticed.  This supervisor closes
+    that gap with the same probe-count-restart shape the replicas get:
+
+    - ``make_lb`` is an injected factory returning a fresh LB object
+      (duck-typed: ``.run()`` blocks, ``.stop()`` shuts down, ``.port``
+      for the probe URL).  Re-running the factory on restart is what
+      re-adopts the warm-restart journal — adoption lives in the LB
+      constructor, not here.
+    - the probe hits ``/lb/stats`` (any HTTP answer = alive); after
+      ``lb_restart_threshold`` consecutive failures the old incarnation
+      is stopped and a new one started on the SAME port, so replica
+      URLs handed to clients stay stable across LB generations.
+
+    Deterministic seam: ``poll_once()`` is public, so tests drive the
+    fail-count-restart machinery step by step without a sleep."""
+
+    def __init__(self,
+                 make_lb: Callable[[], object],
+                 host: str = '127.0.0.1',
+                 restart_threshold: Optional[int] = None,
+                 probe_timeout: float = 2.0):
+        self._make_lb = make_lb
+        self._host = host
+        self._threshold = (constants.lb_restart_threshold()
+                           if restart_threshold is None
+                           else int(restart_threshold))
+        self._probe_timeout = probe_timeout
+        self._stop = threading.Event()
+        self.lb = make_lb()
+        self._lb_thread: Optional[threading.Thread] = None
+        self.restarts = 0
+        self.consecutive_failures = 0
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _spawn(self) -> None:
+        self._lb_thread = threading.Thread(
+            target=self.lb.run, daemon=True,
+            name=f'lb-gen{self.restarts}')
+        self._lb_thread.start()
+
+    def start(self) -> None:
+        """Start the LB thread + the background probe loop."""
+        self._spawn()
+        threading.Thread(target=self._probe_loop, daemon=True,
+                         name='lb-supervisor').start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.lb.stop()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+    # ------------------------------------------------------------- probing
+
+    def _probe(self) -> bool:
+        url = f'http://{self._host}:{self.lb.port}/lb/stats'
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=self._probe_timeout) as resp:
+                resp.read()
+            return True
+        except urllib.error.HTTPError:
+            # A status code — any status code — proves a live process;
+            # an unhappy LB is the LB's problem, not the supervisor's.
+            return True
+        except Exception:  # pylint: disable=broad-except
+            # Only a connection-level failure (refused/reset/timeout)
+            # lands here — the LB process/thread is gone or wedged.
+            return False
+
+    def poll_once(self) -> bool:
+        """One supervision step: probe, count, maybe restart.  Returns
+        True iff a restart happened this step."""
+        if self._probe():
+            self.consecutive_failures = 0
+            return False
+        self.consecutive_failures += 1
+        if self.consecutive_failures < self._threshold:
+            return False
+        logger.warning('LB failed %d consecutive probes; restarting on '
+                       'port %d', self.consecutive_failures, self.lb.port)
+        self.restart()
+        return True
+
+    def restart(self) -> None:
+        """Tear down the current LB incarnation and start a fresh one on
+        the same port (journal re-adoption happens in the factory)."""
+        try:
+            self.lb.stop()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        if self._lb_thread is not None:
+            self._lb_thread.join(timeout=5.0)
+        self.restarts += 1
+        self.consecutive_failures = 0
+        self.lb = self._make_lb()
+        self._spawn()
+
+    def _probe_loop(self) -> None:
+        interval = constants.lb_health_probe_interval()
+        while not self._stop.is_set():
+            self._stop.wait(interval)
+            if self._stop.is_set():
+                return
+            try:
+                self.poll_once()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.error('LB supervisor step failed: %s', e,
+                             exc_info=True)
+
+    def stats(self) -> dict:
+        return {
+            'restarts': self.restarts,
+            'consecutive_probe_failures': self.consecutive_failures,
+            'alive': (self._lb_thread is not None and
+                      self._lb_thread.is_alive()),
+        }

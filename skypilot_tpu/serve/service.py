@@ -18,6 +18,7 @@ from skypilot_tpu.serve import constants, load_balancer, serve_state
 from skypilot_tpu.serve.controller import ServeController
 from skypilot_tpu.serve.load_balancing_policies import (DEFAULT_POLICY,
                                                         LoadBalancingPolicy)
+from skypilot_tpu.serve.replica_managers import LoadBalancerSupervisor
 from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
 from skypilot_tpu.utils import locks
@@ -77,13 +78,20 @@ def _start(service_name: str, task_yaml: str, policy_name: str) -> None:
     os.makedirs(os.path.expanduser(constants.SIGNAL_DIR), exist_ok=True)
     controller = ServeController(service_name, spec, task_yaml,
                                  controller_port)
-    lb = load_balancer.SkyTpuLoadBalancer(
-        f'http://127.0.0.1:{controller_port}', lb_port,
-        LoadBalancingPolicy.make(policy_name))
+    # The LB runs SUPERVISED, like a replica: probed on
+    # lb_health_probe_interval, restarted on the same port after
+    # lb_restart_threshold consecutive probe failures.  The factory
+    # wires the warm-restart journal from SKYTPU_LB_JOURNAL, so each
+    # restart re-adopts breaker/affinity/budget state instead of
+    # relearning the fleet cold.
+    supervisor = LoadBalancerSupervisor(
+        lambda: load_balancer.make_load_balancer(
+            f'http://127.0.0.1:{controller_port}', lb_port, policy_name))
+    controller.lb_supervisor = supervisor
 
     threading.Thread(target=controller.run, daemon=True,
                      name='controller').start()
-    threading.Thread(target=lb.run, daemon=True, name='lb').start()
+    supervisor.start()
     serve_state.set_service_status(service_name, ServiceStatus.REPLICA_INIT)
     logger.info('Service %r up: controller :%d, load balancer :%d',
                 service_name, controller_port, lb_port)
@@ -97,7 +105,7 @@ def _start(service_name: str, task_yaml: str, policy_name: str) -> None:
                 break
             time.sleep(1)
     finally:
-        lb.stop()
+        supervisor.stop()
         controller.stop()
         _cleanup(service_name, controller)
     logger.info('Service %r torn down.', service_name)

@@ -37,11 +37,15 @@ def test_param_count_close_to_formula():
     assert actual == pytest.approx(cfg.num_params, rel=0.02)
 
 
+# Tier-1 keeps the pure-FSDP spec; the multi-axis specs (~10-15 s of
+# jit each) run in the slow lane.
 @pytest.mark.parametrize('spec', [
     MeshSpec(fsdp=8),
-    MeshSpec(data=2, fsdp=4),
-    MeshSpec(fsdp=4, tensor=2),  # tensor must divide num_kv_heads (2)
-    MeshSpec(data=2, fsdp=2, tensor=2),
+    pytest.param(MeshSpec(data=2, fsdp=4), marks=pytest.mark.slow),
+    # tensor must divide num_kv_heads (2)
+    pytest.param(MeshSpec(fsdp=4, tensor=2), marks=pytest.mark.slow),
+    pytest.param(MeshSpec(data=2, fsdp=2, tensor=2),
+                 marks=pytest.mark.slow),
 ])
 def test_sharded_train_step(spec):
     cfg = get_model_config('llama-debug')
@@ -104,6 +108,7 @@ def test_loss_decreases_on_fixed_batch():
     assert last < first
 
 
+@pytest.mark.slow  # ~17 s of jit per model: tier-1 budget
 @pytest.mark.parametrize('model', ['llama-debug', 'gpt2-debug',
                                    'mixtral-debug'])
 def test_fused_loss_matches_full_logits(model):
@@ -158,6 +163,7 @@ def test_fused_loss_respects_mask():
 
 
 @pytest.mark.parametrize('accum', [2, 4])
+@pytest.mark.slow  # ~14 s/param wall: tier-1 budget, see docs/testing.md
 def test_grad_accum_matches_full_batch(accum):
     """K microbatches must reproduce the full-batch update (same grads up
     to accumulation-order float error), with K-fold less live activation
@@ -232,6 +238,7 @@ def test_trainer_evaluate_reports_perplexity():
                                np.exp(out['eval_loss']), rtol=1e-5)
 
 
+@pytest.mark.slow  # ~16 s wall: jits both accum and full-batch steps
 def test_grad_accum_masked_matches_full_batch():
     """Unequal mask counts per microbatch must still reproduce the
     full-batch masked loss/grads exactly: the accumulation keeps each

@@ -1,0 +1,579 @@
+"""Control-plane crash recovery + gray-failure ejection (tier-1, CPU).
+
+Unit coverage for the PR-18 resilience layer, everything on injected
+clocks — no sleeps except the two short hedge races (bounded, real
+threads racing is the thing under test there):
+
+- probation hysteresis: the breaker's TTFT-outlier track needs
+  `probation_enter` consecutive outlier evaluations to eject and
+  `probation_exit` clean ones to readmit (one GC pause must not eject;
+  one lucky request must not readmit);
+- retry budget: Finagle-style token bucket — deposits proportional to
+  successes, reserve trickle, exhaustion ⇒ the LB's typed 503 with
+  ``error_class='retry_budget'``;
+- hedge dedup: `_BufferRelay` promote/cancel — the client can never
+  observe bytes from both hedge arms, and the loser unwinds;
+- journal: append-compact roundtrip, torn-tail tolerance, and the LB's
+  restart re-adoption (breaker state survives, adopted replicas are
+  quarantined until re-verified by a probe).
+"""
+import io
+import json
+import socket
+import threading
+import time
+from http.client import HTTPConnection
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.serve import qos as serve_qos
+from skypilot_tpu.serve.circuit_breaker import CircuitBreaker
+from skypilot_tpu.serve.lb_journal import LBJournal
+from skypilot_tpu.serve.load_balancer import (SkyTpuLoadBalancer,
+                                              _BufferRelay,
+                                              _HedgeCancelled, _SSERelay)
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------- probation hysteresis
+
+
+def _breaker(clock, **kw):
+    kw.setdefault('probation_enter', 3)
+    kw.setdefault('probation_exit', 3)
+    kw.setdefault('probation_k', 3.0)
+    kw.setdefault('ewma_alpha', 1.0)   # EWMA == last sample: exact tests
+    return CircuitBreaker(now=clock, rng=np.random.default_rng(0), **kw)
+
+
+def test_probation_needs_consecutive_outlier_evals():
+    br = _breaker(_Clock())
+    br.record_latency(1.0)             # 10x a 0.1 median: clear outlier
+    assert br.evaluate_probation(0.1) is False
+    assert br.evaluate_probation(0.1) is False
+    assert not br.in_probation()       # 2 < probation_enter
+    assert br.evaluate_probation(0.1) is True
+    assert br.in_probation()
+    assert br.state == CircuitBreaker.PROBATION
+
+
+def test_probation_streak_resets_on_one_clean_eval():
+    """One GC pause (2 outlier evals) followed by recovery never
+    ejects: the enter streak is consecutive, not cumulative."""
+    br = _breaker(_Clock())
+    br.record_latency(1.0)
+    br.evaluate_probation(0.1)
+    br.evaluate_probation(0.1)
+    br.record_latency(0.1)             # recovered (alpha=1: ewma=0.1)
+    br.evaluate_probation(0.1)         # clean: streak resets
+    br.record_latency(1.0)
+    br.evaluate_probation(0.1)
+    br.evaluate_probation(0.1)
+    assert not br.in_probation()
+
+
+def test_probation_exit_hysteresis_and_ewma_reset():
+    br = _breaker(_Clock())
+    br.record_latency(1.0)
+    for _ in range(3):
+        br.evaluate_probation(0.1)
+    assert br.in_probation()
+    br.record_latency(0.1)             # back to healthy
+    assert br.evaluate_probation(0.1) is False
+    assert br.evaluate_probation(0.1) is False
+    assert br.in_probation()           # 2 < probation_exit
+    assert br.evaluate_probation(0.1) is True
+    assert not br.in_probation()
+    # The slow era's memory is shed: next verdict rests on new samples.
+    assert br.latency_ewma is None
+
+
+def test_probation_no_samples_counts_as_clean():
+    br = _breaker(_Clock())
+    for _ in range(5):
+        assert br.evaluate_probation(0.1) is False
+    assert not br.in_probation()
+
+
+def test_probation_survives_snapshot_roundtrip():
+    clock = _Clock()
+    br = _breaker(clock)
+    br.record_latency(1.0)
+    for _ in range(3):
+        br.evaluate_probation(0.1)
+    sd = br.snapshot()
+    assert sd['probation'] is True
+    br2 = _breaker(_Clock(5000.0))     # restarted process, new clock era
+    br2.restore(json.loads(json.dumps(sd)))
+    assert br2.in_probation()
+    assert br2.latency_ewma == pytest.approx(1.0)
+
+
+def test_breaker_open_window_survives_restart_relative():
+    """The backoff deadline journals as seconds-REMAINING: monotonic
+    readings from the dead process mean nothing to the new one."""
+    clock = _Clock()
+    br = _breaker(clock, failure_threshold=2, base_backoff_s=10.0,
+                  jitter_frac=0.0)
+    br.record_failure()
+    br.record_failure()
+    clock.t += 4.0                     # 6s of the 10s window left
+    sd = br.snapshot()
+    assert sd['open_remaining_s'] == pytest.approx(6.0)
+    clock2 = _Clock(77.0)
+    br2 = _breaker(clock2, failure_threshold=2, base_backoff_s=10.0,
+                   jitter_frac=0.0)
+    br2.restore(sd)
+    assert not br2.available()
+    clock2.t += 6.01
+    assert br2.available()
+
+
+# ----------------------------------------------------------- retry budget
+
+
+def test_retry_budget_starts_full_and_exhausts():
+    clock = _Clock()
+    rb = serve_qos.RetryBudget(ratio=0.2, reserve_per_s=0.0, cap=3.0,
+                               clock=clock)
+    assert rb.try_withdraw() and rb.try_withdraw() and rb.try_withdraw()
+    assert not rb.try_withdraw()       # dry: caller answers typed 503
+
+
+def test_retry_budget_refills_proportional_to_successes():
+    clock = _Clock()
+    rb = serve_qos.RetryBudget(ratio=0.2, reserve_per_s=0.0, cap=10.0,
+                               clock=clock)
+    for _ in range(10):
+        rb.try_withdraw()
+    assert not rb.try_withdraw()
+    for _ in range(4):
+        rb.deposit()                   # 4 successes -> 0.8 tokens
+    assert not rb.try_withdraw()       # still under one whole token
+    rb.deposit()                       # 5th success -> 1.0
+    assert rb.try_withdraw()
+
+
+def test_retry_budget_reserve_trickle_on_injected_clock():
+    clock = _Clock()
+    rb = serve_qos.RetryBudget(ratio=0.2, reserve_per_s=0.1, cap=5.0,
+                               clock=clock)
+    for _ in range(5):
+        rb.try_withdraw()
+    assert not rb.try_withdraw()
+    clock.t += 10.0                    # 10s * 0.1/s = one token
+    assert rb.try_withdraw()
+    assert not rb.try_withdraw()
+
+
+def test_retry_budget_snapshot_restore_clamps():
+    clock = _Clock()
+    rb = serve_qos.RetryBudget(ratio=0.2, reserve_per_s=0.0, cap=5.0,
+                               clock=clock)
+    rb.try_withdraw()
+    snap = rb.snapshot()
+    rb2 = serve_qos.RetryBudget(ratio=0.2, reserve_per_s=0.0, cap=5.0,
+                                clock=_Clock(9.0))
+    rb2.restore(snap)
+    assert rb2.remaining() == pytest.approx(4.0)
+    rb2.restore({'tokens': 99.0})      # stale journal from a bigger cap
+    assert rb2.remaining() == pytest.approx(5.0)
+
+
+def test_lb_answers_typed_503_when_budget_dry(monkeypatch):
+    """End-to-end: a fleet of dead replicas burns the retry budget;
+    the next failure-driven retry gets the typed 503 instead of an
+    unbounded failover storm."""
+    monkeypatch.setenv('SKYTPU_LB_RETRY_CAP', '1')
+    monkeypatch.setenv('SKYTPU_LB_RETRY_RESERVE', '0')
+    monkeypatch.setenv('SKYTPU_SERVE_LB_PROBE_INTERVAL', '30')
+    dead1, dead2 = _free_port(), _free_port()
+    policy = LoadBalancingPolicy.make('least_load')
+    policy.set_ready_replicas([f'http://127.0.0.1:{dead1}',
+                               f'http://127.0.0.1:{dead2}'])
+    lb = SkyTpuLoadBalancer(None, _free_port(), policy)
+    threading.Thread(target=lb.run, daemon=True).start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(('127.0.0.1', lb.port),
+                                          timeout=0.2):
+                break
+        except OSError:
+            time.sleep(0.02)
+    try:
+        conn = HTTPConnection('127.0.0.1', lb.port, timeout=20)
+        conn.request('POST', '/generate',
+                     body=json.dumps({'tokens': [1, 2, 3],
+                                      'max_new_tokens': 2}).encode(),
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 503
+        assert body['error_class'] == 'retry_budget'
+        conn = HTTPConnection('127.0.0.1', lb.port, timeout=20)
+        conn.request('GET', '/lb/stats')
+        stats = json.loads(conn.getresponse().read())
+        conn.close()
+        assert stats['retry_budget_remaining'] == pytest.approx(0.0)
+        assert stats['retry_budget_exhausted'] >= 1
+        assert stats['probation_replicas'] == []
+        assert stats['journal_age_s'] is None   # journalling off
+        assert stats['adopted_unverified'] == []
+    finally:
+        lb.stop()
+
+
+# ------------------------------------------------------------ hedge dedup
+
+
+class _FakeHandler:
+    """Just enough of BaseHTTPRequestHandler for _SSERelay."""
+
+    def __init__(self):
+        self.wfile = io.BytesIO()
+        self.close_connection = False
+        self.status = None
+        self.headers_out = []
+
+    def send_response(self, status, reason=None):
+        self.status = status
+
+    def send_header(self, key, value):
+        self.headers_out.append((key, value))
+
+    def end_headers(self):
+        pass
+
+
+def _events_of(handler) -> list:
+    out = []
+    for ev in handler.wfile.getvalue().split(b'\n\n'):
+        for line in ev.split(b'\n'):
+            if line.startswith(b'data: '):
+                out.append(json.loads(line[6:]))
+    return out
+
+
+def test_buffer_relay_promote_replays_once_and_streams_through():
+    relay = _SSERelay(_FakeHandler())
+    buf = _BufferRelay(relay, lambda: None)
+    buf.send_headers_raw(200, 'OK', [('Content-Type',
+                                      'text/event-stream')])
+    buf.note_tokens([5, 6])
+    buf.emit_event({'tokens': [5, 6], 'done': False})
+    assert not relay.headers_sent     # everything held in the buffer
+    buf.promote()
+    assert relay.headers_sent
+    assert relay.streamed == [5, 6]   # bookkeeping merged into the relay
+    buf.note_tokens([7])              # post-promote: straight through
+    buf.emit_event({'done': True, 'output_tokens': [5, 6, 7]})
+    assert relay.streamed == [5, 6, 7]
+    evs = _events_of(relay.handler)
+    assert [e.get('done') for e in evs] == [False, True]
+    buf.promote()                     # idempotent
+    assert [e.get('done') for e in _events_of(relay.handler)] == [
+        False, True]
+
+
+def test_buffer_relay_cancel_unwinds_loser():
+    relay = _SSERelay(_FakeHandler())
+    buf = _BufferRelay(relay, lambda: None)
+    buf.send_headers_raw(200, 'OK', [])
+    buf.emit_event({'tokens': [9], 'done': False})
+    buf.cancel()
+    with pytest.raises(_HedgeCancelled):
+        buf.emit_event({'tokens': [10], 'done': False})
+    buf.promote()                     # cancelled arms stay cancelled
+    assert relay.handler.wfile.getvalue() == b''
+    assert not relay.headers_sent
+
+
+def _hedge_lb(monkeypatch, hedge_ms: float) -> SkyTpuLoadBalancer:
+    monkeypatch.setenv('SKYTPU_LB_HEDGE_MS', str(hedge_ms))
+    policy = LoadBalancingPolicy.make('least_load')
+    policy.set_ready_replicas(['slow://a', 'fast://b'])
+    return SkyTpuLoadBalancer(None, 7000, policy)
+
+
+def _fake_attempt(tag_done: bool = True):
+    """A stand-in for _attempt_stream: slow:// URLs sleep past the
+    hedge deadline before their first byte; both speak the SSE shape
+    and honour the cancel contract."""
+
+    def attempt(url, route, payload, relay, timeout):
+        try:
+            if url.startswith('slow'):
+                time.sleep(0.4)
+            relay.send_headers_raw(200, 'OK',
+                                   [('Content-Type',
+                                     'text/event-stream')])
+            relay.note_tokens([1, 2])
+            relay.emit_event({'tokens': [1, 2], 'done': False})
+            relay.note_tokens([3])
+            relay.emit_event({'done': tag_done, 'src': url,
+                              'output_tokens': [1, 2, 3],
+                              'finish_reason': 'length'})
+            return 'done'
+        except _HedgeCancelled:
+            return 'cancelled'
+
+    return attempt
+
+
+def test_hedge_second_arm_wins_and_loser_is_cancelled(monkeypatch):
+    lb = _hedge_lb(monkeypatch, hedge_ms=50.0)
+    monkeypatch.setattr(lb, '_attempt_stream', _fake_attempt())
+    relay = _SSERelay(_FakeHandler())
+    route = {'path': '/generate', 'payload': {}, 'resumable': True,
+             'context': None}
+    tried = {'slow://a'}
+    outcome, winner = lb._hedged_attempt('slow://a', route, relay,
+                                         tried, None)
+    assert (outcome, winner) == ('done', 'fast://b')
+    assert tried == {'slow://a', 'fast://b'}
+    evs = _events_of(relay.handler)
+    # Dedup: the client saw exactly one stream — the fast arm's.
+    assert [e.get('src') for e in evs if e.get('done')] == ['fast://b']
+    assert len([e for e in evs if e.get('done')]) == 1
+    assert relay.streamed == [1, 2, 3]
+    with lb._stats_lock:
+        counters = dict(lb._counters)
+    assert counters['hedges'] == 1
+    assert counters['hedge_wins'] == 1
+    assert counters['hedge_cancelled'] == 1
+
+
+def test_hedge_primary_fast_enough_skips_hedge(monkeypatch):
+    lb = _hedge_lb(monkeypatch, hedge_ms=2000.0)
+    monkeypatch.setattr(lb, '_attempt_stream', _fake_attempt())
+    relay = _SSERelay(_FakeHandler())
+    route = {'path': '/generate', 'payload': {}, 'resumable': True,
+             'context': None}
+    outcome, winner = lb._hedged_attempt('slow://a', route, relay,
+                                         {'slow://a'}, None)
+    assert (outcome, winner) == ('done', 'slow://a')
+    with lb._stats_lock:
+        assert lb._counters['hedges'] == 0
+
+
+def test_hedge_dry_budget_skips_silently(monkeypatch):
+    lb = _hedge_lb(monkeypatch, hedge_ms=50.0)
+    lb.retry_budget.restore({'tokens': 0.0})
+    lb.retry_budget.reserve_per_s = 0.0
+    monkeypatch.setattr(lb, '_attempt_stream', _fake_attempt())
+    relay = _SSERelay(_FakeHandler())
+    route = {'path': '/generate', 'payload': {}, 'resumable': True,
+             'context': None}
+    outcome, winner = lb._hedged_attempt('slow://a', route, relay,
+                                         {'slow://a'}, None)
+    # No budget, no hedge: the primary still completes the stream.
+    assert (outcome, winner) == ('done', 'slow://a')
+    with lb._stats_lock:
+        assert lb._counters['hedges'] == 0
+        assert lb._counters['retry_budget_exhausted'] == 1
+
+
+# ---------------------------------------------------------------- journal
+
+
+def test_journal_roundtrip_and_last_write_wins(tmp_path):
+    clock = _Clock()
+    path = str(tmp_path / 'j.jsonl')
+    j = LBJournal(path, clock=clock)
+    assert j.age_s() is None           # nothing written this process
+    j.put('a', {'x': 1})
+    j.put('a', {'x': 2})
+    j.put('b', [1, 2, 3])
+    clock.t += 4.0
+    assert j.age_s() == pytest.approx(4.0)
+    j.close()
+    j2 = LBJournal(path, clock=_Clock())
+    assert j2.get('a') == {'x': 2}
+    assert j2.get('b') == [1, 2, 3]
+    assert j2.age_s() is None          # a fresh process hasn't written
+    j2.close()
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / 'j.jsonl')
+    j = LBJournal(path, clock=_Clock())
+    j.put('good', {'v': 1})
+    j.close()
+    with open(path, 'ab') as f:
+        f.write(b'{"k": "torn", "v": {"half')   # crash mid-append
+    j2 = LBJournal(path, clock=_Clock())
+    assert j2.get('good') == {'v': 1}
+    assert j2.get('torn') is None
+    j2.put('after', 7)                 # still writable after a torn tail
+    j2.close()
+    j3 = LBJournal(path, clock=_Clock())
+    assert j3.get('after') == 7
+    j3.close()
+
+
+def test_journal_compaction_keeps_live_keys_only(tmp_path):
+    path = str(tmp_path / 'j.jsonl')
+    j = LBJournal(path, clock=_Clock(), compact_every=8)
+    for i in range(40):
+        j.put('k', {'i': i})
+    j.close()
+    with open(path, 'rb') as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    assert len(lines) <= 8             # compacted, not 40 lines
+    j2 = LBJournal(path, clock=_Clock())
+    assert j2.get('k') == {'i': 39}
+    j2.close()
+
+
+def _seed_lb(port: int, journal: LBJournal,
+             urls) -> SkyTpuLoadBalancer:
+    policy = LoadBalancingPolicy.make('least_load')
+    policy.set_ready_replicas(list(urls))
+    return SkyTpuLoadBalancer(None, port, policy, journal=journal)
+
+
+def test_lb_journal_restart_readopts_and_quarantines(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv('SKYTPU_LB_RETRY_CAP', '10')
+    path = str(tmp_path / 'lb.jsonl')
+    urls = ['http://127.0.0.1:1', 'http://127.0.0.1:2']
+    lb1 = _seed_lb(6001, LBJournal(path, clock=_Clock()), urls)
+    # Open the first replica's breaker (journalled fsync'd on the edge)
+    # and burn some retry budget + latency into the soft state.
+    for _ in range(2):
+        lb1._rep(urls[0]).breaker.record_failure()
+    assert not lb1._rep(urls[0]).breaker.available()
+    lb1.retry_budget.try_withdraw()
+    lb1._record_ttft(urls[1], 0.05)
+    lb1._journal_soft_state()
+    lb1.journal.close()
+
+    # "Restart": a fresh LB over the same journal file.
+    lb2 = _seed_lb(6001, LBJournal(path, clock=_Clock()), urls)
+    assert not lb2._rep(urls[0]).breaker.available()   # OPEN survived
+    # abs tolerance: the LB's budget runs on the real monotonic clock,
+    # so the reserve trickle deposits a hair between snapshot and check.
+    assert lb2.retry_budget.remaining() == pytest.approx(9.0, abs=0.05)
+    # Both journalled replicas are quarantined until a probe answers;
+    # the quarantine is availability-bounded (never empties routing).
+    stats = lb2.lb_stats()
+    assert set(stats['adopted_unverified']) == set(urls)
+    ex = lb2._routing_exclude(set())
+    assert urls[0] in ex               # open breaker excluded anyway
+    lb2._mark_verified(urls[1])
+    st = lb2.lb_stats()
+    assert st['adopted_unverified'] == [urls[0]]
+    # Age is this-process-only: None until the revived LB's first write.
+    assert st['journal_age_s'] is None
+    lb2._journal_soft_state()
+    assert lb2.lb_stats()['journal_age_s'] is not None
+
+
+def test_lb_journal_probation_survives_restart(tmp_path):
+    # Three replicas: with two, the fleet median is the mean of the two
+    # EWMAs and a >3x outlier is mathematically impossible.
+    path = str(tmp_path / 'lb.jsonl')
+    urls = ['http://127.0.0.1:1', 'http://127.0.0.1:2',
+            'http://127.0.0.1:3']
+    lb1 = _seed_lb(6002, LBJournal(path, clock=_Clock()), urls)
+    lb1._record_ttft(urls[0], 1.0)
+    lb1._record_ttft(urls[1], 0.05)
+    lb1._record_ttft(urls[2], 0.05)
+    for _ in range(3):
+        lb1._evaluate_probation()
+    assert lb1._rep(urls[0]).breaker.in_probation()
+    lb1.journal.close()
+    lb2 = _seed_lb(6002, LBJournal(path, clock=_Clock()), urls)
+    assert lb2._rep(urls[0]).breaker.in_probation()
+    assert lb2.lb_stats()['probation_replicas'] == [urls[0]]
+
+
+# -------------------------------------------------- controller state mirror
+
+
+def test_controller_state_mirrors_lb_resilience_block():
+    import threading as _threading
+    import unittest.mock as mock
+
+    from skypilot_tpu.analysis import sanitizers
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve.controller import ServeController
+    from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+
+    spec = SkyTpuServiceSpec(min_replicas=1, max_replicas=2)
+    ctl = ServeController.__new__(ServeController)
+    ctl.service_name = 'svc-cp'
+    ctl.spec = spec
+    ctl.version = 1
+    ctl.autoscaler = autoscalers.Autoscaler.make(spec)
+    ctl._lb_lock = sanitizers.instrument_lock(
+        _threading.Lock(), 'serve.controller._lb_lock.cp-test')
+    ctl._lb_inflight, ctl._lb_draining = {}, set()
+    ctl._lb_affinity, ctl._lb_tenant_qos = {}, {}
+    ctl._lb_latency, ctl._lb_tp = {}, {}
+    ctl._lb_probation, ctl._lb_retry_budget = [], None
+    ctl._lb_journal_age, ctl.lb_supervisor = None, None
+    payload = {'request_timestamps': [],
+               'replica_probation': ['http://r2:9'],
+               'retry_budget': 42.5,
+               'journal_age_s': 1.25}
+    with mock.patch('skypilot_tpu.serve.serve_state.'
+                    'ready_replica_endpoints', return_value=[]):
+        ctl._handle('/controller/load_balancer_sync', payload)
+    with mock.patch('skypilot_tpu.serve.serve_state.get_replicas',
+                    return_value=[]):
+        snap = ctl.state_snapshot()
+    assert snap['load_balancer']['probation_replicas'] == ['http://r2:9']
+    assert snap['load_balancer']['retry_budget_remaining'] == 42.5
+    assert snap['load_balancer']['journal_age_s'] == 1.25
+    assert snap['load_balancer']['supervisor'] is None
+
+
+def test_lb_supervisor_restarts_after_threshold():
+    from skypilot_tpu.serve.replica_managers import LoadBalancerSupervisor
+
+    class _FakeLB:
+        instances = []
+
+        def __init__(self):
+            self.port = 1        # nothing listens: every probe fails
+            self.stopped = False
+            _FakeLB.instances.append(self)
+
+        def run(self):
+            pass
+
+        def stop(self):
+            self.stopped = True
+
+    sup = LoadBalancerSupervisor(_FakeLB, restart_threshold=3,
+                                 probe_timeout=0.1)
+    first = sup.lb
+    assert sup.poll_once() is False
+    assert sup.poll_once() is False
+    assert sup.consecutive_failures == 2
+    assert sup.poll_once() is True     # third strike: restart
+    assert sup.restarts == 1
+    assert sup.consecutive_failures == 0
+    assert first.stopped
+    assert sup.lb is not first
+    assert len(_FakeLB.instances) == 2
+    st = sup.stats()
+    assert st['restarts'] == 1

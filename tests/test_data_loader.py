@@ -91,6 +91,7 @@ def test_dataset_too_small_error(token_file):
         next(loader.token_batches(ds, 128, 512))
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_feeds_the_trainer_on_the_mesh(token_file):
     """End-to-end: memmap file → sharded global batches → train steps."""
     import jax

@@ -91,6 +91,7 @@ def test_gang_failure_cancels_all_hosts(tmp_path):
     assert time.time() - start < 25
 
 
+@pytest.mark.slow  # ~8 s wall: two full provision cycles
 def test_version_lockstep_upgrade_path(tmp_path, monkeypatch):
     """VERDICT r2 missing #6 (ref tests/backward_compatibility_tests.sh,
     client-newer-than-cluster): provision at runtime-tree hash A,
@@ -156,6 +157,7 @@ def test_setup_and_exec_and_queue(tmp_path):
     assert {j['status'] for j in q} == {'SUCCEEDED'}
 
 
+@pytest.mark.slow  # ~8 s wall: real launch + cancel polling
 def test_cancel_job(tmp_path):
     task = Task('sleeper', run='sleep 120')
     task.set_resources(Resources(cloud='local'))
@@ -243,6 +245,7 @@ def test_exec_on_missing_cluster_raises(tmp_path):
         execution.exec_(task, 'nope')
 
 
+@pytest.mark.slow  # ~6 s wall: tier-1 budget, see docs/testing.md
 def test_launch_16_host_gang_full_slice_width(tmp_path):
     """Gang fan-out at REAL slice width (r3 verdict #7): a v5e-64 is 16
     hosts — parallel setup, rank env on every host, log fan-in from all

@@ -59,6 +59,7 @@ def test_duplicate_mount_path_rejected(tmp_path):
         Task.from_yaml(str(p))
 
 
+@pytest.mark.slow  # ~15 s wall: two full train_llama.py subprocesses
 def test_resume_past_target_step_exits_cleanly(tmp_path):
     ckpt = str(tmp_path / 'ckpts')
     common = ['--model', 'llama-debug', '--batch-size', '8',
@@ -92,6 +93,7 @@ def test_ici_bench_reports_busbw():
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~21 s wall: two full train_llama.py subprocesses
 def test_train_llama_script_with_checkpoint_resume(tmp_path):
     """The managed-spot recipe's core promise: a second run resumes from
     the checkpoint the first run wrote."""

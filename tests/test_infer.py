@@ -30,6 +30,7 @@ def engine(tiny_config):
     return InferenceEngine(tiny_config, cfg, rng=jax.random.PRNGKey(7))
 
 
+@pytest.mark.slow  # ~13 s wall: tier-1 budget, see docs/testing.md
 def test_incremental_decode_matches_full_forward(tiny_config):
     m = Llama(tiny_config)
     toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 101)
@@ -267,6 +268,7 @@ def test_http_server_generate(tiny_config):
         srv.stop()
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_decode_steps_window_matches_single_step(tiny_config):
     """Greedy generation must be identical for decode_steps=1 and K>1
     (the scan window only amortizes dispatch, never changes tokens)."""
@@ -466,6 +468,7 @@ def test_tp_mesh_rejects_indivisible_kv_heads(tiny_config):
         InferenceEngine(bad, InferConfig(max_cache_len=64), mesh=mesh)
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_tp_engine_inits_params_born_sharded(tiny_config):
     """mesh + no params: init lands directly on the mesh shardings."""
     from skypilot_tpu.parallel import MeshSpec, make_mesh
@@ -542,6 +545,7 @@ def tiny_moe_config():
                          tie_embeddings=True, dtype=jnp.float32)
 
 
+@pytest.mark.slow  # ~39 s wall: tier-1 budget, see docs/testing.md
 def test_mixtral_engine_matches_full_forward_argmax(tiny_moe_config):
     """VERDICT r1 #5: the engine serves MoE — cached incremental decode
     must reproduce the full-forward greedy continuation (router + experts
@@ -563,6 +567,7 @@ def test_mixtral_engine_matches_full_forward_argmax(tiny_moe_config):
     assert res.output_tokens == seq[len(prompt):]
 
 
+@pytest.mark.slow  # ~7 s wall: tier-1 budget, see docs/testing.md
 def test_mixtral_engine_continuous_batching(tiny_moe_config):
     cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
                       max_new_tokens=4, cache_dtype=jnp.float32)
@@ -574,6 +579,7 @@ def test_mixtral_engine_continuous_batching(tiny_moe_config):
     assert all(len(r.output_tokens) == 4 for r in results)
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_mixtral_tp_serving_matches_single_device(tiny_moe_config):
     """Expert-sharded tensor-parallel MoE serving: a tensor=2 mesh must
     reproduce the single-device greedy output (experts shard over
@@ -594,6 +600,7 @@ def test_mixtral_tp_serving_matches_single_device(tiny_moe_config):
     assert got.output_tokens == want.output_tokens
 
 
+@pytest.mark.slow  # ~6 s wall: tier-1 budget, see docs/testing.md
 def test_mixtral_http_server_e2e(tiny_moe_config):
     """e2e at the replica level: the HTTP serving surface (the process a
     serve-plane replica runs) generates from a Mixtral engine."""
@@ -624,6 +631,7 @@ def test_mixtral_http_server_e2e(tiny_moe_config):
         srv.stop()
 
 
+@pytest.mark.slow  # ~5 s wall: tier-1 budget, see docs/testing.md
 def test_mixtral_engine_benchmark_runs(tiny_moe_config):
     """`infer bench` path on a small Mixtral (VERDICT r1 #5 done-bar)."""
     cfg = InferConfig(num_slots=2, max_cache_len=64, prefill_buckets=(8,),
@@ -633,6 +641,7 @@ def test_mixtral_engine_benchmark_runs(tiny_moe_config):
     assert m['output_tokens_per_second'] > 0
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_mixtral_serving_exact_with_default_capacity_factor():
     """Serving must be drop-free even with a training capacity_factor
     (1.25): the cache path routes exactly (dense-all-experts), so the
@@ -691,6 +700,7 @@ def test_int8_engine_generates_and_matches_bf16_greedy(tiny_config):
     assert got.output_tokens == want.output_tokens
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_int8_random_init_engine_runs(tiny_config):
     """weight_dtype='int8' with random init (the bench path) compiles
     and generates without a float checkpoint."""
@@ -708,6 +718,7 @@ def test_int8_random_init_engine_runs(tiny_config):
     assert leaf.dtype == jnp.int8
 
 
+@pytest.mark.slow  # ~6 s wall: tier-1 budget, see docs/testing.md
 def test_benchmark_serving_metrics(tiny_config):
     """Serving-mode benchmark: arrival-rate load through the stream
     loop; TTFT measures from ARRIVAL (slot-queue wait counts)."""
@@ -726,6 +737,7 @@ def test_benchmark_serving_metrics(tiny_config):
 # ---------------------------------------------------------------- gpt2
 
 
+@pytest.mark.slow  # ~16 s wall: tier-1 budget, see docs/testing.md
 def test_gpt2_engine_matches_full_forward_argmax():
     """GPT-2 rides the same engine: cached incremental decode (learned
     positions via the wpe lookup, MHA cache) reproduces the
@@ -749,6 +761,7 @@ def test_gpt2_engine_matches_full_forward_argmax():
     assert res.output_tokens == seq[len(prompt):]
 
 
+@pytest.mark.slow  # ~7 s wall: tier-1 budget, see docs/testing.md
 def test_gpt2_engine_continuous_batching():
     from skypilot_tpu.models.gpt2 import GPT2Config
     cfg_m = GPT2Config(name='gpt2-cb', vocab_size=101, hidden_size=32,
@@ -764,6 +777,7 @@ def test_gpt2_engine_continuous_batching():
 
 
 @pytest.mark.parametrize('name', ['gemma-debug', 'gemma-mqa-debug'])
+@pytest.mark.slow  # ~20 s/param wall: tier-1 budget, see docs/testing.md
 def test_gemma_engine_matches_full_forward_argmax(name):
     """Gemma rides the same engine: cached incremental decode
     reproduces the full-forward greedy continuation — for both the GQA
@@ -838,6 +852,7 @@ def _spec_pair(tiny_config, draft_len, max_cache_len=64, eos_id=None):
     return plain, spec
 
 
+@pytest.mark.slow  # ~32 s wall: tier-1 budget, see docs/testing.md
 def test_spec_decode_matches_plain_greedy(tiny_config):
     """Speculative decode is EXACT for greedy requests: identical output
     to the windowed decode on repetitive and non-repetitive prompts."""
@@ -857,6 +872,7 @@ def test_spec_decode_matches_plain_greedy(tiny_config):
     assert spec.spec_stats['accepted'] <= spec.spec_stats['drafted']
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_spec_decode_oracle_drafts_full_acceptance(tiny_config,
                                                    monkeypatch):
     """With a perfect draft source, every dispatch yields 1+D tokens:
@@ -882,6 +898,7 @@ def test_spec_decode_oracle_drafts_full_acceptance(tiny_config,
     assert st['dispatches'] <= 4
 
 
+@pytest.mark.slow  # ~13 s wall: tier-1 budget, see docs/testing.md
 def test_spec_decode_respects_eos_and_max_new(tiny_config):
     plain, spec = _spec_pair(tiny_config, draft_len=3)
     res = plain.generate([Request(tokens=[9, 8, 7], max_new_tokens=10)])[0]
@@ -915,6 +932,7 @@ def test_spec_decode_mixed_sampled_and_greedy(tiny_config):
     assert len(results['s'].output_tokens) == 8
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_spec_decode_near_cache_end_falls_back(tiny_config):
     """Slots within draft_len+1 of the cache end take the exact windowed
     path (a clamped k-row write would corrupt live rows); output still
@@ -967,6 +985,7 @@ def test_prefix_cache_exact_vs_full_prefill(tiny_config):
     assert cached.prefix_stats['tokens_reused'] == 3 * len(prefix)
 
 
+@pytest.mark.slow  # ~18 s wall: tier-1 budget, see docs/testing.md
 def test_prefix_cache_prompt_equals_prefix(tiny_config):
     """Prompt == prefix reuses all rows but the last (one token must
     forward to produce logits).  A prompt strictly INSIDE the prefix
@@ -996,6 +1015,7 @@ def test_prefix_cache_prompt_equals_prefix(tiny_config):
     assert cached32.prefix_stats['hits'] == 0
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_prefix_cache_nonmatching_prompt_unaffected(tiny_config):
     plain, cached = _prefix_pair(tiny_config)
     cached.register_prefix([1, 2, 3, 4, 5, 6])
@@ -1008,6 +1028,7 @@ def test_prefix_cache_nonmatching_prompt_unaffected(tiny_config):
     assert cached.prefix_stats['hits'] == 0
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_prefix_cache_lru_eviction(tiny_config):
     _, cached = _prefix_pair(tiny_config, max_prefixes=2)
     cached.register_prefix([1, 2, 3])
@@ -1021,6 +1042,7 @@ def test_prefix_cache_lru_eviction(tiny_config):
         off.register_prefix([1, 2])
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_prefix_cache_longest_match_wins(tiny_config):
     plain, cached = _prefix_pair(tiny_config)
     cached.register_prefix([1, 2, 3, 4])
@@ -1034,6 +1056,7 @@ def test_prefix_cache_longest_match_wins(tiny_config):
     assert cached.prefix_stats['tokens_reused'] == 8
 
 
+@pytest.mark.slow  # ~12 s wall: tier-1 budget, see docs/testing.md
 def test_prefix_cache_composes_with_spec_decode(tiny_config):
     """Prefix reuse + speculative decode together still match plain
     greedy exactly (the two features touch prefill and decode
@@ -1054,6 +1077,7 @@ def test_prefix_cache_composes_with_spec_decode(tiny_config):
     assert both.prefix_stats['hits'] == 1
 
 
+@pytest.mark.slow  # ~11 s wall: tier-1 budget, see docs/testing.md
 def test_prefix_cache_http_endpoint(tiny_config):
     """POST /cache_prefix registers through the live server; matched
     generation is exact."""
@@ -1092,6 +1116,7 @@ def test_prefix_cache_http_endpoint(tiny_config):
     assert cached.prefix_stats['hits'] == 1
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_prefix_cache_lane_batched_burst(tiny_config):
     """A burst of shared-prefix requests prefills in lane-batched
     groups (not one dispatch per request) and every result is exact."""
@@ -1273,6 +1298,7 @@ def test_openai_stream_stop_straddling_windows(tiny_config):
 # ------------------------------------------------------------- logprobs
 
 
+@pytest.mark.slow  # ~12 s wall: tier-1 budget, see docs/testing.md
 def test_logprobs_match_full_forward(tiny_config):
     """Generated-token and prompt logprobs from the engine equal the
     full-forward log_softmax (the lm-eval loglikelihood contract)."""
@@ -1454,6 +1480,7 @@ def test_lm_eval_loglikelihood_client_end_to_end(tiny_config):
     assert not diverged_flag
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_adaptive_decode_window_token_identity(tiny_config):
     """Queue-aware adaptive windows (2-step dispatches while an arrival
     waits with a free slot — _select_window) change only the dispatch
@@ -1554,6 +1581,7 @@ def test_openai_chat_top_logprobs_requires_logprobs(tiny_config):
     assert out['choices'][0]['logprobs'] is None
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_auto_prefix_caching(tiny_config):
     """--auto-prefix (vLLM-APC analog): the same prompt head seen twice
     registers itself (bucket-quantized), and later matching prompts
@@ -1591,6 +1619,7 @@ def test_auto_prefix_caching(tiny_config):
     srv.stop()
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_auto_prefix_disabled_by_default(tiny_config):
     from skypilot_tpu.infer import server as srv_mod
     eng = InferenceEngine(
@@ -1609,6 +1638,7 @@ def test_auto_prefix_disabled_by_default(tiny_config):
     srv.stop()
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_lm_eval_loglikelihood_rolling(tiny_config):
     """loglikelihood_rolling over HTTP: a long stream scored in
     windows (1-token left context each) equals the sum of per-window
@@ -1728,6 +1758,7 @@ def test_cancel_frees_slot_midstream(tiny_config):
     srv.stop()
 
 
+@pytest.mark.slow  # ~8 s wall: tier-1 budget, see docs/testing.md
 def test_adaptive_window_is_queue_aware(tiny_config):
     """The adaptive decode window is QUEUE-aware: full decode_steps
     whenever nothing is waiting (TPOT = s + F/K — per-dispatch fixed
@@ -1802,6 +1833,7 @@ def test_adaptive_window_full_for_lone_stream(tiny_config):
     assert max(sizes) == 6, sizes     # full window, not the short 2
 
 
+@pytest.mark.slow  # ~7 s wall: tier-1 budget, see docs/testing.md
 def test_auto_prefix_counts_n_clones_once(tiny_config):
     """ADVICE r4: one n=3 request counts its prompt head ONCE toward
     auto-prefix hotness — clones must not self-certify a one-off
@@ -1951,6 +1983,7 @@ def test_decode_lookahead_token_identity(tiny_config):
     assert dispatches['n'] == len(prompts) * (24 // 4), dispatches
 
 
+@pytest.mark.slow  # ~9 s wall: tier-1 budget, see docs/testing.md
 def test_decode_lookahead_prefill_during_flight(tiny_config):
     """A request arriving while another stream's lookahead window is in
     flight prefills WITHOUT waiting for it: the snapshot keeps the
@@ -1998,6 +2031,7 @@ def test_decode_lookahead_prefill_during_flight(tiny_config):
     assert results['b'].output_tokens == want_b
 
 
+@pytest.mark.slow  # ~12 s wall: tier-1 budget, see docs/testing.md
 def test_decode_lookahead_stress_randomized(tiny_config):
     """Randomized interleaving stress for the lookahead state machine:
     24 greedy requests with random lengths and random arrival gaps
@@ -2130,6 +2164,7 @@ def test_chunked_prefill_accepts_beyond_largest_bucket(tiny_config):
     assert chunked.chunk_stats['requests'] == 4
 
 
+@pytest.mark.slow  # ~13 s wall: tier-1 budget, see docs/testing.md
 def test_chunked_prefill_serving_randomized_identity(tiny_config):
     """Randomized chunked-vs-monolithic greedy identity through the
     serving loop: long prompts (beyond the largest bucket) arriving at

@@ -545,6 +545,7 @@ sys.exit(0)
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~7 s wall: tier-1 budget, see docs/testing.md
 def test_multihost_gang_through_kubectl_seam(exec_kubectl, skytpu_home):
     """VERDICT r2 #2: a 2-host podslice launch runs a REAL gang job with
     correct ranks — provision (kubectl apply) -> runtime sync (tar pipe

@@ -184,6 +184,7 @@ def test_tier_serving_chunked_identity(tier_pair):
         )['host_tier']['entries']
 
 
+@pytest.mark.slow  # ~14 s wall: tier-1 budget, see docs/testing.md
 def test_tier_qos_park_resume_identity(tiny_config, shared_params):
     """QoS preemption over the tiered pool: a part-prefilled batch
     prompt parks for an interactive arrival and resumes suffix-only —
@@ -367,6 +368,8 @@ def _post_generate(port, payload, timeout=60):
         conn.close()
 
 
+@pytest.mark.slow  # ~55 s wall: two live engines + LB drain handoff;
+# the multi-replica chaos sweep covers drain-with-handoff in tier-1.
 def test_drain_hot_handoff_warm_failover(tiny_config, shared_params,
                                          monkeypatch):
     """Drain a replica whose radix holds the hot prefix: the LB ships

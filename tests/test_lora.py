@@ -55,6 +55,7 @@ def test_adapters_cover_all_targets():
     assert lora.num_adapter_params(params) > 0
 
 
+@pytest.mark.slow  # ~11 s wall: full train-step jit on an 8-way mesh
 def test_training_updates_only_adapters():
     _, lora_cfg = _cfgs()
     mesh = make_mesh(MeshSpec(fsdp=8))
@@ -136,6 +137,7 @@ def test_mixtral_lora_forwards_to_attention():
     assert lora.num_adapter_params(params) > 0
 
 
+@pytest.mark.slow  # ~29 s wall: full train-step jit on an 8-way mesh
 def test_subtree_gradient_path_matches_optimizer_masking():
     """The production LoRA path (make_train_step(trainable=is_lora_path),
     what Trainer.setup wires) must behave like the optimizer-mask-only
@@ -231,6 +233,7 @@ def _greedy_ref(model, params, prompt, steps):
     return out
 
 
+@pytest.mark.slow  # ~21 s wall: decodes 3 reference models token-by-token
 def test_multi_lora_engine_matches_single_adapter_reference():
     """Requests naming different adapters (and the base) decode in ONE
     batch, each token-identical to its single-adapter reference model
@@ -313,6 +316,7 @@ def test_adapter_npz_round_trip(tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_multi_lora_http_server_e2e(tmp_path):
     """Full LoRAX-shaped flow over HTTP: /load_adapter from an .npz
     artifact, adapter selection via the OpenAI `model` field AND the
@@ -395,6 +399,7 @@ def test_multi_lora_http_server_e2e(tmp_path):
     assert base_out == _greedy_ref(Llama(base_cfg), base_params, prompt, 6)
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_multi_lora_review_fixes(tmp_path):
     """r3 review: (a) given-params + lora_rank engine builds (boxed
     init tree), (b) re-registering an adapter drops its stale prefix

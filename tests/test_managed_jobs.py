@@ -174,6 +174,7 @@ def _wait_status(jobs_mod, job_id, want, timeout=90):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_managed_job_end_to_end(local_jobs):
     from skypilot_tpu import jobs
     task = Task('mjob', run='echo "managed says hi"')
@@ -190,6 +191,7 @@ def test_managed_job_end_to_end(local_jobs):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~20 s wall: real preemption + recovery polling
 def test_managed_job_recovery_on_preemption(local_jobs, skytpu_home):
     from skypilot_tpu import jobs
     task = Task('sleepy', run='sleep 6 && echo survived')
@@ -237,6 +239,7 @@ def _kill_tree_and_remove(cluster_dir):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~11 s wall: tier-1 budget, see docs/testing.md
 def test_managed_job_cancel(local_jobs):
     from skypilot_tpu import jobs
     task = Task('longjob', run='sleep 300')
@@ -249,6 +252,7 @@ def test_managed_job_cancel(local_jobs):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~17 s wall: full 2-stage chain under the controller
 def test_managed_pipeline_two_stage_chain(local_jobs, skytpu_home):
     """A 2-task chain DAG runs stage-by-stage under the controller:
     stage2 starts only after stage1 succeeded (ordering proven by a
@@ -279,6 +283,7 @@ def test_managed_pipeline_two_stage_chain(local_jobs, skytpu_home):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~31 s wall: waits out the idle-autostop clock
 def test_controller_idle_autostop_and_restart(local_jobs, skytpu_home):
     """The jobs controller stops itself once idle (STOP, not down — the
     managed-job history must survive) and the next jobs.launch restarts

@@ -25,6 +25,8 @@ def test_lm_forward_shapes(name):
     assert jnp.all(jnp.isfinite(logits))
 
 
+@pytest.mark.slow  # ~14 s wall per family: 3-axis mesh train-step jit;
+# tier-1 keeps the forward-shape sweep above as the fast zoo gate.
 @pytest.mark.parametrize('name', ['gpt2-debug', 'mixtral-debug',
                                   'gemma-debug'])
 def test_lm_families_train_on_mesh(name):

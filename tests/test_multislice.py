@@ -169,6 +169,7 @@ def test_vm_gang_rejected(skytpu_home, monkeypatch):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow  # ~6 s wall: tier-1 budget, see docs/testing.md
 def test_reuse_keeps_existing_gang_width(skytpu_home, enable_local_cloud):
     """A narrower task on a wider cluster reuses ALL existing slices
     (shrinking would orphan slice resources)."""

@@ -131,6 +131,7 @@ def test_paged_serving_interleaved_identity(tiny_config, shared_params):
     assert st['blocks_allocated'] == 0
 
 
+@pytest.mark.slow  # ~10 s wall: tier-1 budget, see docs/testing.md
 def test_paged_speculative_identity(tiny_config, shared_params):
     """Prompt-lookup speculative decoding over the pool: small vocab
     makes n-gram draft hits frequent, so the verify path actually
@@ -192,6 +193,7 @@ def test_paged_prefix_identity_and_sharing(tiny_config, shared_params):
     assert paged.stats()['blocks_allocated'] == 2
 
 
+@pytest.mark.slow  # ~7 s wall: tier-1 budget, see docs/testing.md
 def test_paged_fp8_cache_identity(tiny_config, shared_params):
     """fp8 cache_dtype through the paged write/gather path: both
     layouts quantize rows the same way, so greedy streams still

@@ -248,6 +248,7 @@ def test_expired_at_dequeue_uses_same_typed_shape(tiny_config,
     assert eng.stats()['qos']['sheds'] == 1
 
 
+@pytest.mark.slow  # ~13 s wall: tier-1 budget, see docs/testing.md
 def test_interactive_preempts_batch_at_chunk_boundary(tiny_config,
                                                       shared_params):
     """A part-prefilled batch prompt parks at its chunk boundary for
@@ -522,6 +523,8 @@ def test_controller_ingests_qos_and_latency_sync():
     ctl._lb_inflight, ctl._lb_draining = {}, set()
     ctl._lb_affinity, ctl._lb_tenant_qos = {}, {}
     ctl._lb_latency, ctl._lb_tp = {}, {}
+    ctl._lb_probation, ctl._lb_retry_budget = [], None
+    ctl._lb_journal_age, ctl.lb_supervisor = None, None
     payload = {
         'request_timestamps': [],
         'tenant_qos': {'default_rate': 0.0,

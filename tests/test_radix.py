@@ -263,6 +263,8 @@ def test_radix_trie_property():
 
 # ----------------------------------------------------- byte identity
 
+@pytest.mark.slow  # ~13 s wall: two offline waves x radix-on/off;
+# serving/chunked identity keep the radix gate in tier-1.
 def test_radix_offline_identity_and_stats(tiny_config, shared_params):
     """Two offline waves of overlapping prompts: token streams are
     byte-identical radix-on vs radix-off, the second wave hits the
@@ -322,6 +324,7 @@ def test_radix_chunked_identity(tiny_config, shared_params):
     _assert_radix_conserved(on)
 
 
+@pytest.mark.slow  # ~8 s wall: speculative decode over shared blocks
 def test_radix_speculative_identity(tiny_config, shared_params):
     """Prompt-lookup speculative decode over radix-shared blocks: the
     verify path reads shared prefix rows, so acceptance decisions (and

@@ -750,6 +750,54 @@ def test_rolling_update_keeps_autoscaled_capacity(serve_home, tmp_path):
     assert mgr.ups == 0             # replacement 7 still provisioning
 
 
+def test_controller_restart_mid_update_resumes_conservatively(
+        serve_home, tmp_path):
+    """A controller that crashes mid-rolling-update and restarts over
+    the surviving serve_state must (a) re-adopt the updated version,
+    (b) recover a drain-pacing fleet size, and (c) resume WITHOUT
+    draining on the rejoin tick — the recovered old-fleet size is
+    old READY + latest READY, which makes every pre-crash drain permit
+    look spent; drains resume only as NEW replicas come ready."""
+    from skypilot_tpu.serve.controller import ServeController
+    yaml_path = str(tmp_path / 't.yaml')
+    open(yaml_path, 'w').write('run: echo hi\n')
+    spec = SkyTpuServiceSpec(min_replicas=2)
+    serve_state.add_service('svc', 20001, 30001, 'round_robin',
+                            spec.to_json(), yaml_path, 1)
+    for rid in range(1, 4):                    # pre-update fleet of 3
+        serve_state.add_replica('svc', rid, 1, f'svc-{rid}', False)
+        serve_state.set_replica_status('svc', rid, ReplicaStatus.READY)
+    c1 = ServeController('svc', spec, yaml_path, 20001)
+    c1._handle('/controller/update_service', {
+        'spec': spec.to_json(), 'task_yaml': yaml_path,
+        'mode': 'rolling'})
+    assert c1.version == 2 and c1._update_old_fleet == 3
+    # One replacement came READY before the crash.
+    serve_state.add_replica('svc', 4, 2, 'svc-4', False)
+    serve_state.set_replica_status('svc', 4, ReplicaStatus.READY)
+
+    # Crash + restart: a FRESH controller over the same serve_state.
+    c2 = ServeController('svc', spec, yaml_path, 20001)
+    assert c2.version == 2                     # update not forgotten
+    assert c2._update_old_fleet == 3 + 1       # old READY + latest READY
+    assert c2.autoscaler.latest_version == 2   # no spurious re-update
+    mgr = _RecordingManager()
+    c2.replica_manager = mgr
+    old = [_view(i, ReplicaStatus.READY, 1) for i in range(1, 4)]
+    # Rejoin tick: replica 4's pre-crash permit reads as already spent
+    # (old_drained = 4 - 3 = 1 = latest_ready), so nothing drains and
+    # the next replacement launches.
+    c2._update_replicas(old + [_view(4, ReplicaStatus.READY, 2)])
+    assert mgr.downs == []
+    assert mgr.ups == 1
+    # A new post-restart READY replacement grants exactly one permit.
+    mgr.ups = 0
+    c2._update_replicas(old + [_view(4, ReplicaStatus.READY, 2),
+                               _view(5, ReplicaStatus.READY, 2)])
+    assert len(mgr.downs) == 1
+    assert mgr.ups == 1                        # replacement for the drain
+
+
 def test_blue_green_update_replaces_live_fleet_size(serve_home, tmp_path):
     """blue_green sizes the green fleet to the LIVE (autoscaled) fleet,
     not min_replicas — 'zero capacity dip' means all 5, not 2."""

@@ -650,11 +650,12 @@ def test_wire_golden_schema_snapshot():
         'drained', 'draining', 'inflight', 'kv', 'loop_alive',
         'model_ready', 'status'}
     assert sc['/lb/stats'].produced.always == {
-        'breaker_open_now', 'breaker_opens', 'draining_replicas',
-        'outstanding', 'policy', 'qos', 'ready_replicas',
-        'replica_latency'}
+        'adopted_unverified', 'breaker_open_now', 'breaker_opens',
+        'draining_replicas', 'journal_age_s', 'kv_host_tier',
+        'outstanding', 'policy', 'probation_replicas', 'qos',
+        'ready_replicas', 'replica_latency', 'retry_budget_remaining'}
     assert sc['/controller/state'].produced.always == {
-        'qos', 'replicas', 'service', 'version'}
+        'load_balancer', 'qos', 'replicas', 'service', 'version'}
     # Stability invariant: NO surface key may be branch-dependent —
     # a mixed dense/paged fleet must see one schema.
     for name in ('/stats', '/healthz', '/healthz.kv', '/lb/stats',

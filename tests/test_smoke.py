@@ -34,6 +34,7 @@ class SmokeTest(NamedTuple):
     timeout: int = 15 * 60         # per command
     env: Optional[Dict[str, str]] = None
     gcp: bool = False              # real-cloud row: needs --gcp
+    slow: bool = False             # flagship recipe: slow lane, not tier-1
 
 
 def run_one_test(test: SmokeTest, home: str) -> None:
@@ -135,7 +136,10 @@ _LOCAL_TESTS = [
             f'{SKYTPU} logs smkb 1 | grep -q "final acc"',
         ],
         teardown=f'{SKYTPU} down -y smkb',
-        timeout=20 * 60),
+        timeout=20 * 60,
+        # ~18 s wall: the flagship recipes run in the slow lane; the
+        # tier-1 window keeps the cheap CLI-surface rows.
+        slow=True),
     SmokeTest(
         # BASELINE.json flagship recipe 5/5 (ref
         # examples/resnet_distributed_torch.yaml): 2-node gang via the
@@ -151,7 +155,8 @@ _LOCAL_TESTS = [
             f'{SKYTPU} logs smkr 1 | grep -q "final acc"',
         ],
         teardown=f'{SKYTPU} down -y smkr',
-        timeout=20 * 60),
+        timeout=20 * 60,
+        slow=True),  # ~21 s wall
     SmokeTest(
         # BASELINE.json flagship recipe 3/5 (ref llm/mixtral/serve.yaml):
         # serve up through the REAL serve plane on the local cloud —
@@ -167,7 +172,11 @@ _LOCAL_TESTS = [
             '--replicas 2 --timeout 900 --generate',
         ],
         teardown=f'{SKYTPU} serve down -y smkmx || true',
-        timeout=20 * 60),
+        # ~66 s wall: the serve plane has dedicated tier-1 coverage
+        # (test_serve, test_control_plane, the chaos sweeps); the full
+        # CLI-driven recipe runs in the slow lane.
+        timeout=20 * 60,
+        slow=True),
     SmokeTest(
         name='cli-surfaces',
         commands=[
@@ -209,6 +218,8 @@ def _gated(test: SmokeTest):
     marks = [pytest.mark.e2e]
     if test.gcp:
         marks.append(pytest.mark.gcp)
+    if test.slow:
+        marks.append(pytest.mark.slow)
     return pytest.param(test, id=test.name,
                         marks=marks)
 

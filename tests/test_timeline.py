@@ -1,6 +1,8 @@
 """Timeline tracing tests (parity: sky/utils/timeline.py)."""
 import json
 
+import pytest
+
 from skypilot_tpu.utils import timeline
 
 
@@ -81,6 +83,7 @@ def test_concurrent_saves_do_not_drop_events(tmp_path, monkeypatch):
     assert names == {'p0', 'p1', 'p2', 'p3'}
 
 
+@pytest.mark.slow  # ~14 s wall: tier-1 budget, see docs/testing.md
 def test_trainer_device_profile_capture(tmp_path):
     """profile_dir captures a jax.profiler trace of the configured step
     window (device-level complement of the Chrome timeline)."""

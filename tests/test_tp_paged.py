@@ -195,6 +195,7 @@ def test_tp_paged_per_chip_accounting_and_sanitizer(pair):
     assert rep['paged_pool_leaves'] == len(tp.cache) * 2
 
 
+@pytest.mark.slow  # ~13 s wall: tier-1 budget, see docs/testing.md
 def test_tp_qos_preemption_park_resume_identity(tiny_config,
                                                 shared_params):
     """A part-prefilled batch prompt on the tp=2 engine parks at its
@@ -324,6 +325,8 @@ def test_controller_state_exposes_per_replica_tp():
     ctl._lb_inflight, ctl._lb_draining = {}, set()
     ctl._lb_affinity, ctl._lb_tenant_qos = {}, {}
     ctl._lb_latency, ctl._lb_tp = {}, {}
+    ctl._lb_probation, ctl._lb_retry_budget = [], None
+    ctl._lb_journal_age, ctl.lb_supervisor = None, None
     payload = {'request_timestamps': [],
                'replica_tp': {'http://r1:9': 2}}
     with mock.patch('skypilot_tpu.serve.serve_state.'
