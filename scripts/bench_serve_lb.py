@@ -950,6 +950,202 @@ def run_qos_bench(out: str, interactive_n: int = 128,
     print(f'wrote {out}')
 
 
+# ---------------------------------------------------- batch section
+
+
+def run_batch_bench(out: str, n_replicas: int = 2, n_rows: int = 48,
+                    row_workers: int = 4,
+                    interactive_slo_s: float = 2.0) -> None:
+    """Bulk-inference goodput: the same `n_rows` greedy rows pushed
+    through the fleet two ways, with an open-loop interactive tenant
+    probing TTFT throughout —
+
+      `online`       every row POSTed directly to the LB as an
+                     interactive-class stream from `row_workers`
+                     closed-loop lanes (what a user without the batch
+                     plane would script)
+      `batch_plane`  one `/v1/batches` job: journaled rows dispatched
+                     as QoS batch-class requests by the
+                     BatchCoordinator with the same worker width
+
+    The claim under measurement: the batch plane sustains comparable
+    fleet goodput (output tokens/s) while the interactive tenant's
+    p99 TTFT holds the SLO — batch rows yield at the WFQ scheduler
+    instead of queueing ahead of interactive work.  Greedy outputs
+    must be byte-identical between arms.  Writes BENCH_SERVE_r10.json.
+    """
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.infer import InferConfig
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    from skypilot_tpu.infer.engine import InferenceEngine
+    from skypilot_tpu.models.llama import LlamaConfig
+    from skypilot_tpu.serve.batch import BatchCoordinator
+
+    os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    mc = LlamaConfig(name='batch-bench', vocab_size=101, hidden_size=64,
+                     intermediate_size=128, num_layers=2, num_heads=4,
+                     num_kv_heads=2, max_seq_len=256,
+                     tie_embeddings=True, dtype='float32')
+    cfg = InferConfig(num_slots=4, max_cache_len=128,
+                      prefill_buckets=(16, 32), max_new_tokens=16,
+                      cache_dtype=jnp.float32, decode_steps=4,
+                      kv_block_size=16, kv_blocks=160,
+                      auto_prefix_cache=True, qos=True)
+
+    def make_engine():
+        eng = InferenceEngine(mc, cfg, rng=jax.random.PRNGKey(0))
+        eng.warmup()
+        return eng
+
+    rows = [_batch_prompt(0, i, n=24) for i in range(n_rows)]
+    max_new = 16
+
+    def run_arm(name, drive):
+        """drive(port) -> (outputs_by_idx, n_output_tokens); returns a
+        bench row.  A fresh fleet per arm keeps radix state equal."""
+        fleet = ChaosFleet(make_engine, n_replicas)
+        fleet.start()
+        try:
+            port = fleet.lb.port
+            # Warm the LB hop + interactive shape before measuring.
+            _qos_stream(port, _interactive_prompt(0), 4,
+                        'interactive', 'live')
+            stop = threading.Event()
+            ttfts, probe_err = [], []
+
+            def prober():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        ttft, _ = _qos_stream(
+                            port, _interactive_prompt(i), 4,
+                            'interactive', 'live')
+                        ttfts.append(ttft)
+                    except Exception as e:  # pylint: disable=broad-except
+                        probe_err.append(str(e))
+                        return
+                    i += 1
+                    time.sleep(0.05)
+
+            pt = threading.Thread(target=prober, daemon=True)
+            pt.start()
+            t0 = time.time()
+            outputs, out_tokens = drive(port)
+            elapsed = time.time() - t0
+            stop.set()
+            pt.join(timeout=60)
+            if probe_err:
+                raise RuntimeError(f'{name} prober died: {probe_err[:1]}')
+            vals = sorted(ttfts)
+            row = {
+                'arm': name,
+                'rows': n_rows,
+                'row_workers': row_workers,
+                'elapsed_s': elapsed,
+                'rows_per_s': n_rows / elapsed,
+                'goodput_tokens_per_s': out_tokens / elapsed,
+                'interactive_probes': len(vals),
+                'interactive_ttft_p50_s': statistics.median(vals),
+                'interactive_ttft_p99_s': vals[min(len(vals) - 1,
+                                                   int(len(vals) * 0.99))],
+            }
+            print(json.dumps(row), flush=True)
+            return row, outputs
+        finally:
+            fleet.stop()
+
+    def drive_online(port):
+        outputs, errors = {}, []
+        lock = threading.Lock()
+        pending = list(range(n_rows))
+
+        def lane():
+            while True:
+                with lock:
+                    if not pending or errors:
+                        return
+                    i = pending.pop()
+                try:
+                    _, toks = _qos_stream(port, rows[i], max_new,
+                                          'interactive', 'bulk')
+                except Exception as e:  # pylint: disable=broad-except
+                    with lock:
+                        errors.append(f'row {i}: {e}')
+                    return
+                with lock:
+                    outputs[i] = toks
+
+        lanes = [threading.Thread(target=lane, daemon=True)
+                 for _ in range(row_workers)]
+        for t in lanes:
+            t.start()
+        for t in lanes:
+            t.join(timeout=600)
+        if errors:
+            raise RuntimeError(f'online arm failed: {errors[:3]}')
+        return outputs, sum(len(t) for t in outputs.values())
+
+    def drive_batch(port):
+        with tempfile.TemporaryDirectory() as tmp:
+            coord = BatchCoordinator(
+                os.path.join(tmp, 'batch_journal.jsonl'), port,
+                spool_dir=os.path.join(tmp, 'spool'),
+                row_workers=row_workers)
+            try:
+                jid = coord.submit(rows, max_new,
+                                   completion_window_s=600.0,
+                                   job_id='bench')
+                if not coord.join(jid, timeout=600):
+                    raise RuntimeError(
+                        f'batch job never finished: {coord.status(jid)}')
+                st = coord.status(jid)
+                if st['state'] != 'done':
+                    raise RuntimeError(f'batch job failed: {st}')
+                outputs = {}
+                with open(coord.result_path(jid)) as fh:
+                    for line in fh:
+                        rec = json.loads(line)
+                        outputs[rec['row']] = rec['output_tokens']
+                return outputs, sum(len(t) for t in outputs.values())
+            finally:
+                coord.stop()
+
+    results = {}
+    for name, drive in [('online', drive_online),
+                        ('batch_plane', drive_batch)]:
+        print(f'-- batch arm={name}', flush=True)
+        results[name] = run_arm(name, drive)
+    if results['batch_plane'][1] != results['online'][1]:
+        raise RuntimeError('greedy outputs diverged between the online '
+                           'and batch-plane arms')
+    on, bp = results['online'][0], results['batch_plane'][0]
+    summary = {
+        'interactive_slo_s': interactive_slo_s,
+        'goodput_ratio_batch_vs_online':
+            bp['goodput_tokens_per_s'] / on['goodput_tokens_per_s'],
+        'interactive_p99_online_s': on['interactive_ttft_p99_s'],
+        'interactive_p99_batch_s': bp['interactive_ttft_p99_s'],
+        'interactive_p99_within_slo':
+            bp['interactive_ttft_p99_s'] <= interactive_slo_s,
+        'outputs_byte_identical': True,
+    }
+    print(json.dumps(summary), flush=True)
+    try:
+        doc = json.load(open(out))
+    except (FileNotFoundError, ValueError):
+        doc = {}
+    doc['batch_plane'] = {'rows': [on, bp], 'summary': summary,
+                          'model': 'tiny-cpu',
+                          'measured_at': 'load_balancer_endpoint'}
+    json.dump(doc, open(out, 'w'), indent=2)
+    print(f'wrote {out}')
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--qps', action='append', type=float, default=[])
@@ -997,7 +1193,15 @@ def main() -> None:
                         help='interactive sample count (p99 needs '
                              'enough draws to not be the single max)')
     parser.add_argument('--qos-batch-lanes', type=int, default=4)
+    parser.add_argument('--batch', action='store_true',
+                        help='run the batch-plane vs online goodput '
+                             'section (in-process fleet, CPU-friendly)')
+    parser.add_argument('--batch-rows', type=int, default=48)
     args = parser.parse_args()
+    if args.batch:
+        run_batch_bench(args.out or 'BENCH_SERVE_r10.json',
+                        n_rows=args.batch_rows)
+        return
     if args.failover:
         run_failover_bench(args.failover_iters,
                            args.out or 'BENCH_SERVE_r06.json')

@@ -35,6 +35,14 @@ The multi-replica sweep ends with two control-plane legs (PR 18):
      outlier track must put it in probation within the detection
      window while traffic through it stays byte-identical.
 
+``--batch`` runs the durable-batch leg (PR 20) instead: one journaled
+batch job submitted through a BatchCoordinator, with a replica, the
+LB, and the coordinator itself each killed mid-job.  The resumed
+job's final output file must be byte-identical to the fault-free
+run's — zero lost rows, zero duplicated spool writes (exactly-once),
+zero determinism violations — and the restarted LB must show it
+re-adopted the orphaned row leases from its journal.
+
 Exit code: 0 = all episodes passed, 1 = any property violated.
 """
 import argparse
@@ -198,7 +206,7 @@ def episode(eng: InferenceEngine, seed: int, n: int) -> list:
 # ------------------------------------------------ multi-replica sweep
 
 
-def _replica_engine(tp: int = 0) -> InferenceEngine:
+def _replica_engine(tp: int = 0, stall_s: float = 0.04) -> InferenceEngine:
     from skypilot_tpu.parallel import tp_mesh
     mc = LlamaConfig(name='chaos-replica', vocab_size=101,
                      hidden_size=32, intermediate_size=64, num_layers=2,
@@ -214,7 +222,7 @@ def _replica_engine(tp: int = 0) -> InferenceEngine:
     # Stretch generations across loop iterations so kills land while
     # streams are genuinely in flight (sleep only; tokens unaffected).
     eng.arm_faults(FaultPlan(seed=0, specs=[
-        FaultSpec(site='stall', prob=1.0, stall_s=0.04)]))
+        FaultSpec(site='stall', prob=1.0, stall_s=stall_s)]))
     return eng
 
 
@@ -601,6 +609,188 @@ def multi_replica_sweep(n_replicas: int, seeds, n_requests: int,
     return 0
 
 
+# ------------------------------------------------------- batch sweep
+
+
+def _wait_for(pred, timeout_s: float, what: str):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return None
+        time.sleep(0.05)
+    return f'batch: timed out waiting for {what}'
+
+
+def batch_sweep(n_replicas: int, n_rows: int) -> int:
+    """Durable-batch chaos leg (PR 20): one journaled batch job run
+    twice — fault-free, then with every actor killed mid-flight
+    (replica kill, LB kill + warm restart, coordinator crash-stop +
+    resume on the same journal) — and the final output file must be
+    byte-identical with zero lost rows and zero determinism
+    violations.  Duplicates are allowed to OCCUR (that is the crash
+    replay) but must dedup against the spooled digest instead of
+    double-writing."""
+    import tempfile
+
+    from skypilot_tpu.infer.chaos import ChaosFleet
+    from skypilot_tpu.serve.batch import BatchCoordinator
+
+    os.environ.setdefault('SKYTPU_SERVE_LB_PROBE_INTERVAL', '0.2')
+    prompts = [[(5 * i + j) % 97 + 1 for j in range(3 + i % 5)]
+               for i in range(n_rows)]
+    print(f'batch chaos: {n_replicas} replicas rows={n_rows}')
+
+    def fresh_fleet() -> ChaosFleet:
+        journal = os.path.join(
+            tempfile.mkdtemp(prefix='chaos-batch-lb-'),
+            'lb_journal.jsonl')
+        # Slower decode (bigger stall) + a single row worker below:
+        # each kill must land while the job is genuinely mid-flight,
+        # not in the gap between an instant job and the choreography.
+        fleet = ChaosFleet(functools.partial(_replica_engine,
+                                             stall_s=0.08),
+                           n_replicas, journal_path=journal)
+        fleet.start()
+        return fleet
+
+    failures = []
+
+    # ---- fault-free pass: the byte-exact reference ------------------
+    fleet = fresh_fleet()
+    ref_bytes = None
+    try:
+        d = tempfile.mkdtemp(prefix='chaos-batch-ref-')
+        coord = BatchCoordinator(os.path.join(d, 'batch.jsonl'),
+                                 fleet.lb_port,
+                                 spool_dir=os.path.join(d, 'spool'),
+                                 row_workers=2)
+        jid = coord.submit(prompts, 10,
+                           completion_window_s=EPISODE_WALL_S,
+                           job_id='chaosjob')
+        if not coord.join(jid, EPISODE_WALL_S):
+            failures.append('batch: fault-free job never finished: '
+                            f'{coord.status(jid)}')
+        else:
+            st = coord.status(jid)
+            if st['state'] != 'done':
+                failures.append(f'batch: fault-free run ended {st}')
+            with open(coord.result_path(jid), 'rb') as fh:
+                ref_bytes = fh.read()
+        coord.stop()
+    finally:
+        fleet.stop()
+    if failures:
+        print('BATCH CHAOS FAILED (reference pass):')
+        for f in failures:
+            print(f'  {f}')
+        return 1
+
+    # ---- chaos pass: same job, every actor dies mid-flight ----------
+    fleet = fresh_fleet()
+    try:
+        d = tempfile.mkdtemp(prefix='chaos-batch-run-')
+        jpath = os.path.join(d, 'batch.jsonl')
+        spool = os.path.join(d, 'spool')
+        # ONE row worker: rows dispatch strictly serially, so the
+        # choreography below (each wait is a row-count threshold)
+        # always finds the job mid-flight.
+        coord = BatchCoordinator(jpath, fleet.lb_port, spool_dir=spool,
+                                 row_workers=1)
+        jid = coord.submit(prompts, 10,
+                           completion_window_s=3 * EPISODE_WALL_S,
+                           job_id='chaosjob')
+
+        def done_rows():
+            return coord.status(jid)['completed']
+
+        # 1. Replica killed mid-job: the LB fails the stream over;
+        #    only unfinished rows are ever (re)dispatched.  The dead
+        #    replica stays down until the successor coordinator is up
+        #    (respawn compiles a fresh engine, which takes long enough
+        #    for a small job to finish — the later kills must still
+        #    land mid-flight).
+        err = _wait_for(lambda: done_rows() >= 2, 60, 'first rows')
+        if err:
+            failures.append(err)
+        if fleet.kill_one() is None:
+            failures.append('batch: no replica available to kill')
+        marker = done_rows()
+        err = _wait_for(lambda: done_rows() > marker, 60,
+                        'progress past the replica kill')
+        if err:
+            failures.append(err)
+
+        # 2. LB killed mid-row, restarted on the same port: the row
+        #    transport retries through the outage and the restarted LB
+        #    re-adopts the orphaned row leases from its journal
+        #    (adoption runs in the constructor, so the counter is
+        #    valid the moment restart_lb returns).
+        err = _wait_for(
+            lambda: fleet.lb_stats()['batch_rows_inflight'] >= 1,
+            60, 'a batch row in flight at the LB')
+        if err:
+            failures.append(err)
+        fleet.kill_lb()
+        time.sleep(0.3)
+        fleet.restart_lb(wait_adopted=False)
+        lb_stats = fleet.lb_stats()
+        if lb_stats.get('batch_leases_adopted', 0) < 1:
+            failures.append('batch: restarted LB adopted no row '
+                            f'leases (stats={lb_stats})')
+
+        # 3. Coordinator (the controller-side actor) crash-stopped
+        #    mid-job: a successor on the same journal path RESUMES —
+        #    completed rows are recognised by digest and never re-run.
+        before = coord.status(jid)
+        coord.stop()
+        if before['state'] != 'running':
+            failures.append('batch: job finished before the '
+                            f'coordinator crash ({before}) — '
+                            'resume leg proved nothing')
+        coord2 = BatchCoordinator(jpath, fleet.lb_port,
+                                  spool_dir=spool, row_workers=2)
+        fleet.respawn_dead()   # capacity back while the successor runs
+        resumed = coord2.status(jid)
+        if resumed['completed'] < before['completed']:
+            failures.append(
+                'batch: resume lost completed rows '
+                f'({resumed["completed"]} < {before["completed"]})')
+        if not coord2.join(jid, 2 * EPISODE_WALL_S):
+            failures.append('batch: resumed job never finished: '
+                            f'{coord2.status(jid)}')
+        final = coord2.status(jid)
+        if final['state'] != 'done' or final['completed'] != n_rows:
+            failures.append(f'batch: final status {final}')
+        if final['determinism_violations']:
+            failures.append('batch: determinism violations: '
+                            f'{final["determinism_violations"]}')
+        chaos_bytes = None
+        try:
+            with open(coord2.result_path(jid), 'rb') as fh:
+                chaos_bytes = fh.read()
+        except OSError as e:
+            failures.append(f'batch: no output file: {e}')
+        if ref_bytes is not None and chaos_bytes != ref_bytes:
+            failures.append('batch: chaos output is not '
+                            'byte-identical to the fault-free run')
+        print(f'  batch: rows={final["completed"]}/{n_rows} '
+              f'retries={final["retries"]} dups={final["duplicates"]} '
+              f'resumed_from={before["completed"]} '
+              f'leases_adopted='
+              f'{lb_stats.get("batch_leases_adopted")} '
+              f'{"FAIL" if failures else "ok"}')
+        coord2.stop()
+    finally:
+        fleet.stop()
+    if failures:
+        print('BATCH CHAOS FAILED:')
+        for f in failures:
+            print(f'  {f}')
+        return 1
+    print('batch chaos: PASS')
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--seeds', type=int, nargs='+', default=[0, 1, 2],
@@ -613,7 +803,16 @@ def main() -> int:
     ap.add_argument('--policy', default='least_load',
                     help='LB policy for --multi-replica (byte-identity '
                          'must hold under ANY routing policy)')
+    ap.add_argument('--batch', action='store_true',
+                    help='durable batch-job chaos leg: kill a replica, '
+                         'the LB, and the coordinator mid-job; the '
+                         'final output must be byte-identical to the '
+                         'fault-free run with zero lost/duplicated '
+                         'rows')
     args = ap.parse_args()
+    if args.batch:
+        return batch_sweep(args.multi_replica or 3,
+                           n_rows=2 * args.requests)
     if args.multi_replica:
         return multi_replica_sweep(args.multi_replica, args.seeds,
                                    args.requests, args.policy)

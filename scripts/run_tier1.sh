@@ -43,6 +43,7 @@ python scripts/check_tier1_budget.py "$LOG" --budget "$BUDGET" \
     --require tests/test_tp_paged.py \
     --require tests/test_kv_tier.py \
     --require tests/test_control_plane.py \
+    --require tests/test_batch_plane.py \
     --skycheck-json "$SKYJSON" \
     --extra-seconds "bench_dryrun:$BENCH_SECS" || rc=1
 # Seeded chaos sweep (fault injection): no hang + full request
@@ -65,4 +66,10 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu SKYTPU_COMPILE_SANITIZER=1 SKYTPU_SHARD_
 timeout -k 10 420 env JAX_PLATFORMS=cpu SKYTPU_SANITIZERS=1 \
     python scripts/chaos_smoke.py --multi-replica 3 --seeds 0 1 \
     --requests 8 --policy prefix_affinity || rc=1
+# Batch-plane chaos leg: one journaled batch job survives a replica
+# kill, an LB kill/warm-restart (row-lease re-adoption), and a
+# coordinator crash/resume mid-flight — final output byte-identical
+# to the fault-free reference, zero lost or duplicated rows.
+timeout -k 10 300 env JAX_PLATFORMS=cpu SKYTPU_SANITIZERS=1 \
+    python scripts/chaos_smoke.py --batch || rc=1
 exit "$rc"

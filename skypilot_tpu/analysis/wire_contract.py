@@ -89,6 +89,8 @@ _ENGINE = 'skypilot_tpu/infer/engine.py'
 _LB = 'skypilot_tpu/serve/load_balancer.py'
 _CTRL = 'skypilot_tpu/serve/controller.py'
 _POLICIES = 'skypilot_tpu/serve/load_balancing_policies.py'
+_BATCH = 'skypilot_tpu/serve/batch.py'
+_AUTOSCALERS = 'skypilot_tpu/serve/autoscalers.py'
 
 # The wire contract: every cross-plane JSON document the system
 # exchanges.  Producer modes: ('return',) = returned dict,
@@ -160,7 +162,9 @@ SURFACES: Tuple[SurfaceSpec, ...] = (
                      vars=('radix',)),
         ),
     ),
-    # LB-plane /lb/stats observability document.
+    # LB-plane /lb/stats observability document (batch row-lease
+    # counters included: the chaos harness asserts lease adoption
+    # across an LB restart off this surface).
     SurfaceSpec(
         '/lb/stats',
         producers=(Producer(_LB, 'lb_stats', ('return',)),),
@@ -173,8 +177,40 @@ SURFACES: Tuple[SurfaceSpec, ...] = (
                      vars=('stats', 'st')),
             Consumer('tests/test_control_plane.py', None,
                      vars=('stats', 'st')),
+            Consumer('tests/test_batch_plane.py', None,
+                     vars=('stats',)),
             Consumer('scripts/bench_serve_lb.py', None,
                      vars=('stats',)),
+            Consumer('scripts/chaos_smoke.py', None,
+                     vars=('stats', 'lb_stats')),
+        ),
+    ),
+    # Batch-plane job-status document: the POST /v1/batches response
+    # carries it under 'status', GET /v1/batches/<id> returns it bare
+    # (controller.batch_status is a pass-through).
+    SurfaceSpec(
+        '/v1/batches.status',
+        producers=(Producer(_BATCH, 'BatchCoordinator.status',
+                            ('return',)),),
+        consumers=(
+            Consumer('tests/test_batch_plane.py', None,
+                     vars=('st', 'resumed')),
+            Consumer('scripts/chaos_smoke.py', 'batch_sweep',
+                     vars=('st', 'before', 'final')),
+        ),
+    ),
+    # Batch backlog -> autoscaler signal: rows remaining + measured
+    # completion rate drive the backlog scale-up term.
+    SurfaceSpec(
+        'batch.backlog',
+        producers=(Producer(_BATCH, 'BatchCoordinator.backlog',
+                            ('return',)),),
+        consumers=(
+            Consumer(_AUTOSCALERS,
+                     'SloLatencyAutoscaler._batch_meets_window',
+                     vars=('b',)),
+            Consumer('tests/test_batch_plane.py', None,
+                     vars=('b',)),
         ),
     ),
     # Controller /controller/state snapshot.

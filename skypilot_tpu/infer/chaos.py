@@ -26,7 +26,7 @@ import socket
 import threading
 import time
 import urllib.request
-from typing import Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -276,6 +276,11 @@ class ChaosFleet:
         proxied connection RST — from a client's view the service's one
         front door slams shut mid-stream."""
         lb, thread = self.lb, self._lb_thread
+        # Crash fidelity: a SIGKILL'd LB never gets to journal its
+        # in-flight lease releases.  Detach the journal BEFORE severing
+        # so unwinding handler threads can't write `held: False` — the
+        # successor must see the orphaned leases and adopt them.
+        lb.journal = None
         httpd = lb._httpd  # pylint: disable=protected-access
         if httpd is not None:
             httpd.shutdown()
@@ -321,6 +326,17 @@ class ChaosFleet:
                 time.sleep(0.05)
         logger.info('chaos: restarted LB :%d (journal=%s)', self.lb_port,
                     bool(self.journal_path))
+
+    def lb_stats(self) -> Dict[str, Any]:
+        """One `/lb/stats` snapshot from the CURRENT LB generation.
+        The batch chaos leg reads this after restart_lb() to assert the
+        journal hand-off (``batch_leases_adopted``) actually happened —
+        a restart that silently dropped its leases would still pass the
+        byte-identity check (the coordinator retries), so the counter
+        is the only witness that recovery took the journal path."""
+        with urllib.request.urlopen(f'{self.lb_url}/lb/stats',
+                                    timeout=5) as resp:
+            return json.loads(resp.read())
 
     def degrade_one(self, index: int, plan,
                     seed: int = 0) -> 'DegradedReplica':

@@ -4031,6 +4031,7 @@ class InferenceEngine:
                 elapsed = (now - req.arrival_time
                            if req.arrival_time is not None else 0.0)
                 shed_reason = None
+                projection_miss = False
                 if (req.deadline_s is not None and
                         req.arrival_time is not None and
                         elapsed >= req.deadline_s):
@@ -4054,6 +4055,41 @@ class InferenceEngine:
                         shed_reason = (
                             f'projected completion {elapsed + proj:.3f}s '
                             f'cannot meet deadline_s={req.deadline_s}')
+                        projection_miss = True
+                if shed_reason is not None and projection_miss and \
+                        hasattr(self._sched, 'shed_victim'):
+                    # WFQ-aware shed order (parked since PR 8): before
+                    # sacrificing the popped head — which WFQ sorts to
+                    # the UNDER-share tenant — shed a doomed queued row
+                    # from a tenant strictly more over its fair share.
+                    # Only rows that would miss their own deadlines
+                    # qualify (the `doomed` bound), so totals are
+                    # unchanged; fairness just picks who goes first.
+                    def _doomed(r, _now=now):
+                        if r.deadline_s is None:
+                            return False
+                        el = (_now - r.arrival_time
+                              if r.arrival_time is not None else 0.0)
+                        p = self._svc_estimator.projected_s(
+                            len(r.tokens) + self._max_new(r))
+                        return p is not None and el + p > r.deadline_s
+                    victim = self._sched.shed_victim(
+                        prefer_over=req.tenant_id or
+                        qos_mod.DEFAULT_TENANT,
+                        doomed=_doomed)
+                    if victim is not None:
+                        self._sched.requeue(req)
+                        v_el = (now - victim.arrival_time
+                                if victim.arrival_time is not None
+                                else 0.0)
+                        self._shed_request(
+                            victim, v_el,
+                            'over-fair-share victim: projected '
+                            'completion cannot meet '
+                            f'deadline_s={victim.deadline_s}',
+                            result_cb)
+                        moved = True
+                        continue
                 if shed_reason is not None:
                     self._shed_request(req, elapsed, shed_reason,
                                        result_cb)

@@ -172,6 +172,58 @@ class WfqScheduler(Scheduler):
                 return req
             return None
 
+    def _over_share(self, tenant: str) -> float:
+        """Weight-normalised service already consumed: the fairness
+        measure shed ordering ranks by."""
+        return self.served.get(tenant, 0.0) / self.weight(tenant)
+
+    def shed_victim(self, prefer_over: Optional[str] = None,
+                    doomed=None) -> Optional['Request']:
+        """Deadline-shedding victim selection (parked since PR 8):
+        when the projection bound says work must be dropped, drop the
+        MOST-over-fair-share tenant's most recent deadline-bearing row
+        — batch class before interactive, lane tail before lane head —
+        instead of whatever FIFO/WFQ pop order happens to surface
+        (which punishes the under-share tenant at the head).
+
+        Only requests carrying a deadline_s are eligible (no-deadline
+        work is never shed), and `doomed(req)` — when given — must
+        also confirm the candidate cannot meet its own deadline, so
+        fairness never sacrifices a row that would have made it.  With
+        `prefer_over` set, only tenants STRICTLY more over-share than
+        that tenant qualify; None then means "shed the caller's own
+        request instead".  The removed request is returned un-charged
+        (its push() cost stands; shedding is not service)."""
+        with self._lock:
+            floor = (self._over_share(prefer_over)
+                     if prefer_over is not None else None)
+            best = None        # (share, cls_idx, pos_in_lane)
+            for cls_idx, cls in enumerate(reversed(PRIORITY_CLASSES)):
+                for tenant, lane in self._lanes[cls].items():
+                    if not lane.entries:
+                        continue
+                    share = self._over_share(tenant)
+                    if floor is not None and share <= floor:
+                        continue
+                    for pos in range(len(lane.entries) - 1, -1, -1):
+                        req = lane.entries[pos][1]
+                        if getattr(req, 'deadline_s', None) is None:
+                            continue
+                        if doomed is not None and not doomed(req):
+                            continue
+                        cand = (share, -cls_idx, pos)
+                        if best is None or cand > best[0]:
+                            best = (cand, cls, tenant, pos)
+                        break      # most recent eligible in this lane
+            if best is None:
+                return None
+            _, cls, tenant, pos = best
+            lane = self._lanes[cls][tenant]
+            _, victim = lane.entries[pos]
+            del lane.entries[pos]
+            self._depth -= 1
+            return victim
+
     def requeue(self, req: 'Request') -> None:
         """Preempted work re-enters at the FRONT of its lane with the
         class's current virtual time: immediately eligible again, and

@@ -188,6 +188,38 @@ class StrategyExecutor:
         return None
 
 
+class BatchRowRecovery:
+    """Row-level recovery policy for the serve-plane bulk-inference
+    coordinator (serve/batch.py).  Rows are not clusters: a failed row
+    re-enters the job's pending queue (the fleet's PR 5 failover plus
+    the LB retry budget are the transport-level recovery), so the only
+    policy here is how patiently the coordinator retries before the
+    completion window declares the row lost.
+
+    Kept in this module so the jobs plane owns ALL recovery policy —
+    the serve side asks for a policy, it never invents one."""
+
+    def __init__(self, max_attempts: int = 8,
+                 init_backoff_s: float = 0.2,
+                 max_backoff_s: float = 5.0):
+        if max_attempts < 1:
+            raise ValueError('max_attempts must be >= 1')
+        self.max_attempts = max_attempts
+        self.init_backoff_s = init_backoff_s
+        self.max_backoff_s = max_backoff_s
+
+    def should_retry(self, attempt: int,
+                     window_remaining_s: float) -> bool:
+        """attempt is 1-based: the count of failures so far."""
+        return attempt < self.max_attempts and window_remaining_s > 0.0
+
+    def backoff_s(self, attempt: int) -> float:
+        """Exponential, capped — deterministic (no jitter): the batch
+        plane's byte-identity contract wants replayable schedules."""
+        return min(self.max_backoff_s,
+                   self.init_backoff_s * (2 ** max(0, attempt - 1)))
+
+
 class EagerNextZoneExecutor(StrategyExecutor):
     """After preemption/stockout, immediately move to the optimizer's next
     ranked zone (the preempting zone goes to the back of the line).

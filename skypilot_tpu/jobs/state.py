@@ -73,6 +73,12 @@ def _db() -> sqlite3.Connection:
         failure_reason TEXT,
         run_timestamp TEXT,
         PRIMARY KEY (job_id, task_id))""")
+    conn.execute("""CREATE TABLE IF NOT EXISTS batch_jobs (
+        batch_id TEXT PRIMARY KEY,
+        status TEXT,
+        completed_rows INTEGER DEFAULT 0,
+        total_rows INTEGER DEFAULT 0,
+        updated_at REAL)""")
     conn.commit()
     return conn
 
@@ -192,6 +198,48 @@ def set_cancelled(job_id: int) -> None:
             'AND status=?',
             (ManagedJobStatus.CANCELLED.value, time.time(), job_id,
              ManagedJobStatus.CANCELLING.value))
+
+
+# ------------------------------------------------------------ batch mirror
+# Thin jobs-plane view of the serve-side bulk-inference coordinator
+# (serve/batch.py): lifecycle + row progress, so `sky jobs queue`-style
+# tooling sees batch jobs next to managed jobs.  The journal in
+# serve/batch.py stays the source of truth; this mirror is best-effort
+# and written only on lifecycle edges / checkpoints.
+
+_BATCH_STATUS = {
+    'running': ManagedJobStatus.RUNNING,
+    'done': ManagedJobStatus.SUCCEEDED,
+    'failed': ManagedJobStatus.FAILED,
+}
+
+
+def record_batch_job(batch_id: str, state: str, completed: int,
+                     total: int) -> None:
+    status = _BATCH_STATUS.get(state, ManagedJobStatus.RUNNING)
+    with _db() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO batch_jobs '
+            '(batch_id, status, completed_rows, total_rows, updated_at) '
+            'VALUES (?,?,?,?,?)',
+            (batch_id, status.value, int(completed), int(total),
+             time.time()))
+
+
+def get_batch_job(batch_id: str) -> Optional[Dict[str, Any]]:
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    row = conn.execute('SELECT * FROM batch_jobs WHERE batch_id=?',
+                       (batch_id,)).fetchone()
+    return dict(row) if row else None
+
+
+def get_batch_queue() -> List[Dict[str, Any]]:
+    conn = _db()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute(
+        'SELECT * FROM batch_jobs ORDER BY updated_at DESC').fetchall()
+    return [dict(r) for r in rows]
 
 
 # ------------------------------------------------------------------- queries

@@ -238,6 +238,10 @@ class SloLatencyAutoscaler(Autoscaler):
         # Latest per-replica summary from the LB; replaced wholesale
         # each sync (the LB owns the rolling window).
         self.replica_latency: Dict[str, Any] = {}
+        # Batch-plane backlog from the controller's coordinator
+        # (serve/batch.py backlog()): rows remaining, tightest
+        # completion window, and the measured completion rate.
+        self.batch_backlog: Optional[Dict[str, Any]] = None
         self._upscale_since: Optional[float] = None
         self._downscale_since: Optional[float] = None
 
@@ -251,6 +255,34 @@ class SloLatencyAutoscaler(Autoscaler):
             self.replica_latency = {
                 str(u): row for u, row in replica_latency.items()
                 if isinstance(row, dict)}
+
+    def collect_batch_backlog(
+            self, backlog: Optional[Dict[str, Any]]) -> None:
+        self.batch_backlog = backlog if isinstance(backlog, dict) \
+            else None
+
+    def _batch_meets_window(self, n_replicas: int,
+                            n_now: int) -> bool:
+        """Would a fleet of ``n_replicas`` finish the batch backlog
+        inside its completion window?  Projection sizes work to the
+        MEASURED completion rate (rows/s at the current fleet size,
+        scaled linearly); with a backlog but no rate signal yet the
+        answer is pessimistic — pressure until measured otherwise."""
+        b = self.batch_backlog or {}
+        rows = b.get('rows_remaining') or 0
+        if rows <= 0 or n_replicas <= 0:
+            return True                # nothing to finish
+        window = b.get('window_remaining_s')
+        if window is None:
+            return True
+        if window <= 0.0:
+            return False               # already blown: all hands
+        rate = b.get('rows_per_s')
+        if not isinstance(rate, (int, float)) or rate <= 0.0 or \
+                n_now <= 0:
+            return False
+        per_replica = float(rate) / n_now
+        return rows / (per_replica * n_replicas) <= window
 
     def fleet_ttft_p95_ms(self) -> Optional[float]:
         """Worst replica p95 (the SLO is per-request, so the slowest
@@ -279,7 +311,16 @@ class SloLatencyAutoscaler(Autoscaler):
         slo = self.spec.slo_ttft_ms
         p95 = self.fleet_ttft_p95_ms()
         now = self._now()
-        if p95 is not None and p95 > slo and len(alive) < hi:
+        # Batch backlog term (ISSUE 20): a completion window the
+        # current fleet cannot meet is upscale pressure too — but only
+        # while interactive TTFT holds its SLO (an interactive breach
+        # already drives the first branch; batch never outranks it).
+        slo_breach = p95 is not None and p95 > slo
+        interactive_ok = p95 is None or p95 <= slo
+        backlog_pressure = (
+            interactive_ok and
+            not self._batch_meets_window(len(alive), len(alive)))
+        if (slo_breach or backlog_pressure) and len(alive) < hi:
             self._downscale_since = None
             if self._upscale_since is None:
                 self._upscale_since = now
@@ -289,7 +330,11 @@ class SloLatencyAutoscaler(Autoscaler):
                                            {'use_spot': False})]
             return []
         if (p95 is not None and len(alive) > lo and
-                p95 < slo * constants.slo_downscale_factor()):
+                p95 < slo * constants.slo_downscale_factor() and
+                # Drain batch capacity first: shrink only while the
+                # SMALLER fleet still meets the completion window —
+                # the batch surplus goes before the window is at risk.
+                self._batch_meets_window(len(alive) - 1, len(alive))):
             self._upscale_since = None
             if self._downscale_since is None:
                 self._downscale_since = now

@@ -1,0 +1,505 @@
+"""Durable bulk-inference plane: journaled batch jobs with
+exactly-once row accounting (ROADMAP item 4).
+
+A batch job is a list of greedy prompts plus a completion window.  The
+coordinator persists the job to an append-compacted journal (the
+`serve/lb_journal.py` pattern: one JSON doc per line, torn-tail
+tolerant, injected clock, fsync only on state edges), shards it into
+rows dispatched as QoS ``batch``-class requests through the load
+balancer, and spools each completed row to disk keyed by
+``(job_id, row_idx)`` together with a content hash of the output.
+
+The durability contract, per actor:
+
+- **Replica dies** — the LB's failover (PR 5) reissues the in-flight
+  stream; rows that were never dispatched simply stay pending.  Only
+  unfinished rows are ever (re)sent.
+- **LB dies and restarts** — the row transport retries connection
+  errors through the outage (greedy decode is deterministic, so a
+  from-scratch reissue yields identical tokens); the LB's own journal
+  re-adopts the orphaned row leases for observability
+  (``batch_leases_adopted`` in ``/lb/stats``).
+- **Controller/coordinator dies** — a fresh coordinator on the same
+  journal path resumes from the last checkpoint: completed rows are
+  recognised by their ``row:`` journal docs + spool files and are
+  NEVER re-run; only the unfinished remainder re-enters the queue.
+
+Exactly-once: a replayed row (e.g. its first attempt completed but the
+ack was lost to a crash) recomputes the same greedy bytes, hashes to
+the same digest, and dedups against the spooled record — the
+``duplicates`` counter ticks instead of a second spool write.  A
+*different* hash for an already-recorded row is a determinism
+violation and fails the job loudly (it would silently corrupt output
+otherwise).
+
+Journal schema (all docs carry no wall-clock timestamps; ages come
+from the injected clock):
+
+- ``job:<id>``  — the job body + lifecycle state (fsync'd on edges:
+  submitted / done / failed).
+- ``row:<id>:<idx>`` — ``{'hash': <sha256>}`` per completed row (the
+  payload itself lives in the spool; the journal only needs the
+  digest to dedup replays).
+- ``ckpt:<id>`` — ``{'completed': n}`` fsync'd every
+  ``batch_checkpoint_every`` rows: bounds how much a crash can force
+  the coordinator to re-VERIFY (never re-run).
+"""
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from http.client import HTTPConnection
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.analysis import sanitizers
+from skypilot_tpu.serve import constants
+from skypilot_tpu.serve.lb_journal import LBJournal
+
+# Terminal row finish reasons: anything else is a failed attempt the
+# transport retries inside the row wall.
+_ROW_OK = ('length', 'eos')
+
+JOB_STATES = ('running', 'done', 'failed')
+
+
+def row_hash(output_tokens: List[int], finish_reason: str) -> str:
+    """Content digest a replayed row must reproduce exactly."""
+    doc = json.dumps([list(output_tokens), finish_reason],
+                     separators=(',', ':')).encode()
+    return hashlib.sha256(doc).hexdigest()
+
+
+def _http_row_transport(lb_port: int) -> Callable[[dict, float], dict]:
+    """Default row transport: stream a greedy row through the LB,
+    retrying connection-level errors (an LB mid-restart, a severed
+    stream) until the row wall expires.  Returns the terminal SSE
+    event; raises after the wall."""
+
+    def send(payload: dict, wall_s: float) -> dict:
+        deadline = time.time() + wall_s   # det-ok: HTTP retry wall
+        last: Optional[BaseException] = None
+        while time.time() < deadline:     # det-ok: HTTP retry wall
+            try:
+                conn = HTTPConnection('127.0.0.1', lb_port, timeout=30)
+                try:
+                    conn.request(
+                        'POST', '/generate',
+                        body=json.dumps(payload).encode(),
+                        headers={'Content-Type': 'application/json'})
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        raise RuntimeError(f'LB answered {resp.status}')
+                    buf, events = b'', []
+                    while True:
+                        chunk = resp.read1(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                        while b'\n\n' in buf:
+                            ev, buf = buf.split(b'\n\n', 1)
+                            for line in ev.split(b'\n'):
+                                if line.startswith(b'data: '):
+                                    events.append(json.loads(line[6:]))
+                finally:
+                    conn.close()
+                done = [e for e in events if e.get('done')]
+                if len(done) == 1 and \
+                        done[0].get('finish_reason') in _ROW_OK:
+                    return done[0]
+                last = RuntimeError(
+                    f'incomplete stream ({len(done)} terminal events)')
+            except (OSError, RuntimeError) as e:
+                last = e
+            time.sleep(0.2)               # det-ok: HTTP retry backoff
+        raise RuntimeError(f'row never completed: {last}')
+
+    return send
+
+
+class BatchCoordinator:
+    """Owns the batch-job journal, the row dispatch pool, and the
+    completed-row spool.  One coordinator per controller; the chaos
+    harness also runs it standalone (the coordinator IS the
+    controller-side actor the ``--batch`` leg kills and resumes)."""
+
+    def __init__(self, journal_path: str,
+                 lb_port: Optional[int] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 transport: Optional[Callable[[dict, float], dict]] = None,
+                 spool_dir: Optional[str] = None,
+                 row_workers: Optional[int] = None,
+                 state_sink: Optional[Callable[..., None]] = None) -> None:
+        if transport is None:
+            if lb_port is None:
+                raise ValueError('need an lb_port or an injected '
+                                 'transport to dispatch rows')
+            transport = _http_row_transport(lb_port)
+        self._transport = transport
+        self._clock = clock
+        self._row_workers = row_workers or constants.batch_row_workers()
+        self._ckpt_every = max(1, constants.batch_checkpoint_every())
+        self._row_wall_s = constants.batch_row_wall_s()
+        self.spool_dir = spool_dir or constants.batch_spool_dir() or \
+            os.path.join(os.path.dirname(os.path.abspath(journal_path)),
+                         'spool')
+        os.makedirs(self.spool_dir, exist_ok=True)
+        # state_sink(job_id, state, completed, total): thin jobs-plane
+        # mirror (jobs/state.py batch_jobs table) — never on the row
+        # hot path, only on lifecycle edges and checkpoints.
+        self._state_sink = state_sink
+        self._journal = LBJournal(journal_path, clock=clock)
+        self._lock = sanitizers.instrument_lock(
+            threading.Lock(), 'serve.batch._lock')
+        self._jobs: Dict[str, Dict[str, Any]] = {}   # guarded-by: _lock
+        self._pending: Dict[str, deque] = {}         # guarded-by: _lock
+        self._inflight: Dict[str, int] = {}          # guarded-by: _lock
+        self._row_attempts: Dict[Any, int] = {}      # guarded-by: _lock
+        # Measured completion rate (rows/s EWMA, injected clock):
+        # the autoscaler's backlog projection sizes the fleet against
+        # THIS, never an assumed per-replica throughput.
+        self._rows_per_s: Optional[float] = None     # guarded-by: _lock
+        self._last_done_t: Optional[float] = None    # guarded-by: _lock
+        # Row-retry policy belongs to the jobs plane
+        # (jobs/recovery_strategy.py); lazy import keeps serve/ free
+        # of the jobs plane's launch-stack imports at module load.
+        from skypilot_tpu.jobs.recovery_strategy import BatchRowRecovery
+        self._recovery = BatchRowRecovery()
+        self._done_events: Dict[str, threading.Event] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._recover()
+
+    # ------------------------------------------------------ lifecycle
+
+    def submit(self, prompts: List[List[int]], max_new_tokens: int, *,
+               completion_window_s: float = 3600.0,
+               tenant_id: Optional[str] = None,
+               temperature: Optional[float] = None,
+               job_id: Optional[str] = None) -> str:
+        """Accept a job.  Greedy-only: a nonzero temperature breaks
+        the determinism the exactly-once contract hashes against, so
+        it is a typed client error, not a silent downgrade."""
+        if temperature not in (None, 0, 0.0):
+            raise ValueError(
+                'batch jobs are greedy-only (temperature must be 0): '
+                'replay determinism is the durability contract')
+        if not prompts or not all(
+                isinstance(p, list) and p and
+                all(isinstance(t, int) for t in p) for p in prompts):
+            raise ValueError('prompts must be non-empty lists of '
+                             'int token ids')
+        if not isinstance(max_new_tokens, int) or max_new_tokens <= 0:
+            raise ValueError('max_new_tokens must be a positive int')
+        jid = job_id or uuid.uuid4().hex[:12]
+        doc = {'job_id': jid, 'prompts': prompts,
+               'max_new_tokens': max_new_tokens,
+               'completion_window_s': float(completion_window_s),
+               'tenant_id': tenant_id, 'state': 'running',
+               'n_rows': len(prompts),
+               'submitted_at': self._clock(),
+               'duplicates': 0, 'retries': 0,
+               'determinism_violations': 0}
+        with self._lock:
+            if jid in self._jobs:
+                raise ValueError(f'job {jid!r} already exists')
+            self._jobs[jid] = doc
+            self._pending[jid] = deque(range(len(prompts)))
+            self._inflight[jid] = 0
+            self._done_events[jid] = threading.Event()
+        self._journal.put(f'job:{jid}', doc, fsync=True)
+        self._sink(jid, 'running', 0, len(prompts))
+        self._spawn_workers(jid)
+        return jid
+
+    def _recover(self) -> None:
+        """Resume from the journal: jobs still 'running' re-enter the
+        queue with ONLY their unfinished rows; completed rows are
+        trusted by digest (journal ``row:`` doc + spool file)."""
+        snap = self._journal.snapshot()
+        for key, doc in snap.items():
+            if not key.startswith('job:'):
+                continue
+            jid = doc['job_id']
+            with self._lock:
+                self._jobs[jid] = doc
+                self._done_events[jid] = threading.Event()
+                if doc['state'] != 'running':
+                    self._done_events[jid].set()
+                    continue
+                pending = deque(
+                    i for i in range(doc['n_rows'])
+                    if self._row_record(snap, jid, i) is None)
+                self._pending[jid] = pending
+                self._inflight[jid] = 0
+            if doc['state'] == 'running':
+                if pending:
+                    self._spawn_workers(jid)
+                else:
+                    self._finish_job(jid)
+
+    def _row_record(self, snap: dict, jid: str,
+                    idx: int) -> Optional[dict]:
+        """A row counts as completed only when BOTH the journal digest
+        and the spool payload agree — a torn spool write re-runs the
+        row (same greedy bytes, same digest)."""
+        rec = snap.get(f'row:{jid}:{idx}')
+        if rec is None:
+            return None
+        spooled = self._read_spool(jid, idx)
+        if spooled is None or spooled.get('hash') != rec.get('hash'):
+            return None
+        return rec
+
+    def stop(self) -> None:
+        """Halt dispatch WITHOUT touching job state — the crash the
+        chaos harness simulates for the controller actor.  In-flight
+        rows are abandoned mid-stream; a successor coordinator on the
+        same journal path re-runs only what never spooled."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        self._journal.close()
+
+    # ------------------------------------------------------- dispatch
+
+    def _spawn_workers(self, jid: str) -> None:
+        n = min(self._row_workers,
+                max(1, len(self._pending.get(jid, ()))))
+        for _ in range(n):
+            t = threading.Thread(target=self._worker, args=(jid,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self, jid: str) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                job = self._jobs.get(jid)
+                pending = self._pending.get(jid)
+                if job is None or job['state'] != 'running' or \
+                        not pending:
+                    break
+                idx = pending.popleft()
+                self._inflight[jid] += 1
+            try:
+                self._run_row(jid, job, idx)
+            except Exception as e:  # noqa: BLE001 — row wall expired
+                backoff = 0.0
+                with self._lock:
+                    job['retries'] += 1
+                    attempts = self._row_attempts[(jid, idx)] = \
+                        self._row_attempts.get((jid, idx), 0) + 1
+                    if self._stop.is_set():
+                        # Crash-stop: leave the row pending for the
+                        # successor, don't fail the job.
+                        self._pending[jid].appendleft(idx)
+                    elif not self._recovery.should_retry(
+                            attempts, self._window_remaining(job)):
+                        job['state'] = 'failed'
+                        job['error'] = (
+                            f'row {idx} unfinished after {attempts} '
+                            f'attempts / past the window: {e}')
+                    else:
+                        self._pending[jid].append(idx)
+                        backoff = self._recovery.backoff_s(attempts)
+                if backoff:
+                    self._stop.wait(backoff)
+            finally:
+                with self._lock:
+                    self._inflight[jid] -= 1
+            self._maybe_finish(jid)
+        with self._lock:
+            if self._jobs.get(jid, {}).get('state') == 'failed':
+                self._done_events[jid].set()
+
+    def _run_row(self, jid: str, job: dict, idx: int) -> None:
+        payload = {'request_id': f'batch:{jid}:{idx}',
+                   'tokens': job['prompts'][idx],
+                   'max_new_tokens': job['max_new_tokens'],
+                   'temperature': 0.0, 'stream': True,
+                   'priority': 'batch'}
+        if job.get('tenant_id'):
+            payload['tenant_id'] = job['tenant_id']
+        done = self._transport(payload, self._row_wall_s)
+        self._record_row(jid, idx, list(done.get('output_tokens', [])),
+                         str(done.get('finish_reason')))
+
+    def _record_row(self, jid: str, idx: int,
+                    output_tokens: List[int],
+                    finish_reason: str) -> None:
+        h = row_hash(output_tokens, finish_reason)
+        key = f'row:{jid}:{idx}'
+        with self._lock:
+            job = self._jobs[jid]
+            prior = self._journal.get(key)
+            if prior is not None:
+                if prior.get('hash') == h:
+                    job['duplicates'] += 1     # exactly-once dedup
+                    if self._read_spool(jid, idx) is None:
+                        # Journaled digest with a torn spool write:
+                        # the replay heals the payload (same bytes,
+                        # same digest) without a second journal line.
+                        self._write_spool(
+                            jid, idx,
+                            {'hash': h, 'output_tokens': output_tokens,
+                             'finish_reason': finish_reason})
+                    return
+                job['determinism_violations'] += 1
+                job['state'] = 'failed'
+                job['error'] = (f'row {idx} replay hash mismatch: '
+                                f'{prior.get("hash")} != {h}')
+                self._journal.put(f'job:{jid}', job, fsync=True)
+                self._sink(jid, 'failed', self._completed(jid),
+                           job['n_rows'])
+                return
+            self._write_spool(jid, idx, {'hash': h,
+                                         'output_tokens': output_tokens,
+                                         'finish_reason': finish_reason})
+            self._journal.put(key, {'hash': h})
+            t = self._clock()
+            if self._last_done_t is not None and t > self._last_done_t:
+                r = 1.0 / (t - self._last_done_t)
+                self._rows_per_s = r if self._rows_per_s is None else \
+                    0.3 * r + 0.7 * self._rows_per_s
+            self._last_done_t = t
+            completed = self._completed(jid)
+            if completed % self._ckpt_every == 0:
+                self._journal.put(f'ckpt:{jid}',
+                                  {'completed': completed}, fsync=True)
+                self._sink(jid, 'running', completed, job['n_rows'])
+
+    def _maybe_finish(self, jid: str) -> None:
+        with self._lock:
+            job = self._jobs.get(jid)
+            if job is None:
+                return
+            if job['state'] == 'running' and \
+                    not self._pending.get(jid) and \
+                    self._inflight.get(jid, 0) == 0 and \
+                    self._completed(jid) >= job['n_rows']:
+                pass                  # fall through to finish below
+            elif job['state'] == 'failed' and \
+                    not self._done_events[jid].is_set():
+                self._journal.put(f'job:{jid}', job, fsync=True)
+                self._sink(jid, 'failed', self._completed(jid),
+                           job['n_rows'])
+                self._done_events[jid].set()
+                return
+            else:
+                return
+        self._finish_job(jid)
+
+    def _finish_job(self, jid: str) -> None:
+        """All rows spooled: assemble the final output file (row order,
+        one JSON line per row) and fsync the 'done' edge."""
+        with self._lock:
+            job = self._jobs[jid]
+            if job['state'] == 'done':
+                return
+            job['state'] = 'done'
+            n = job['n_rows']
+        out = self.result_path(jid)
+        tmp = out + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as fh:
+            for i in range(n):
+                rec = self._read_spool(jid, i)
+                fh.write(json.dumps(
+                    {'row': i, 'hash': rec['hash'],
+                     'output_tokens': rec['output_tokens'],
+                     'finish_reason': rec['finish_reason']},
+                    separators=(',', ':'), sort_keys=True) + '\n')
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, out)
+        self._journal.put(f'job:{jid}', self._jobs[jid], fsync=True)
+        self._sink(jid, 'done', n, n)
+        self._done_events[jid].set()
+
+    # -------------------------------------------------------- spool
+
+    def _spool_path(self, jid: str, idx: int) -> str:
+        d = os.path.join(self.spool_dir, jid)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f'{idx}.json')
+
+    def result_path(self, jid: str) -> str:
+        return os.path.join(self.spool_dir, f'{jid}.out.jsonl')
+
+    def _write_spool(self, jid: str, idx: int, doc: dict) -> None:
+        path = self._spool_path(jid, idx)
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as fh:
+            json.dump(doc, fh, separators=(',', ':'), sort_keys=True)
+        os.replace(tmp, path)
+
+    def _read_spool(self, jid: str, idx: int) -> Optional[dict]:
+        try:
+            with open(self._spool_path(jid, idx),
+                      encoding='utf-8') as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    # ------------------------------------------------------- queries
+
+    def _completed(self, jid: str) -> int:
+        # Counted off the journal (source of truth), not an in-memory
+        # counter: resume and dedup both keep it honest.
+        n = self._jobs[jid]['n_rows']
+        return sum(1 for i in range(n)
+                   if self._journal.get(f'row:{jid}:{i}') is not None)
+
+    def _window_remaining(self, job: dict) -> float:
+        return job['completion_window_s'] - \
+            (self._clock() - job['submitted_at'])
+
+    def status(self, jid: str) -> Dict[str, Any]:
+        with self._lock:
+            job = self._jobs.get(jid)
+            if job is None:
+                raise KeyError(jid)
+            completed = self._completed(jid)
+            return {'job_id': jid,  # wire-ok: client-facing API field
+                    'state': job['state'],
+                    'n_rows': job['n_rows'],  # wire-ok: client-facing API field
+                    'completed': completed,
+                    'pending': len(self._pending.get(jid, ())),  # wire-ok: client-facing API field
+                    'inflight': self._inflight.get(jid, 0),  # wire-ok: client-facing API field
+                    'duplicates': job['duplicates'],
+                    'retries': job['retries'],
+                    'determinism_violations':
+                        job['determinism_violations'],
+                    'window_remaining_s':  # wire-ok: client-facing API field
+                        self._window_remaining(job),
+                    'error': job.get('error')}
+
+    def backlog(self) -> Dict[str, Any]:
+        """The autoscaler's batch signal: how many rows remain across
+        running jobs and how much completion window is left (the
+        tightest job wins)."""
+        with self._lock:
+            jobs = [j for j in self._jobs.values()
+                    if j['state'] == 'running']
+            rows = sum(j['n_rows'] - self._completed(j['job_id'])
+                       for j in jobs)
+            window = min((self._window_remaining(j) for j in jobs),
+                         default=None)
+            return {'jobs': len(jobs), 'rows_remaining': rows,
+                    'window_remaining_s': window,
+                    'rows_per_s': self._rows_per_s}
+
+    def join(self, jid: str, timeout: float = 120.0) -> bool:
+        ev = self._done_events.get(jid)
+        return bool(ev and ev.wait(timeout))
+
+    def _sink(self, jid: str, state: str, completed: int,
+              total: int) -> None:
+        if self._state_sink is None:
+            return
+        try:
+            self._state_sink(jid, state, completed, total)
+        except Exception:  # noqa: BLE001 — the mirror must never
+            pass           # take down the dispatch plane

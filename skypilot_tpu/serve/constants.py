@@ -266,3 +266,35 @@ def lb_restart_threshold() -> int:
     """Consecutive failed LB health probes before the supervisor
     restarts the LB process/thread on the same port."""
     return int(_f('SKYTPU_LB_RESTART_THRESHOLD', 3))
+
+
+def batch_journal_path() -> str:
+    """Batch-job journal path; empty (the default) means the batch
+    plane is disabled on the controller — `POST /v1/batches` answers a
+    typed 503 until the operator points this somewhere durable."""
+    return os.environ.get('SKYTPU_BATCH_JOURNAL', '')
+
+
+def batch_spool_dir() -> str:
+    """Directory completed batch rows spool to, keyed by
+    (job_id, row_idx); defaults to a `spool/` sibling of the journal."""
+    return os.environ.get('SKYTPU_BATCH_SPOOL', '')
+
+
+def batch_row_workers() -> int:
+    """Concurrent batch rows in flight through the LB per job (the
+    fleet's QoS plane, not this fan-out, decides actual admission)."""
+    return int(_f('SKYTPU_BATCH_ROW_WORKERS', 4))
+
+
+def batch_checkpoint_every() -> int:
+    """Completed rows between fsync'd job checkpoints — the replay
+    window a controller crash can force the coordinator to re-verify
+    (never re-run: completed rows dedup by content hash)."""
+    return int(_f('SKYTPU_BATCH_CHECKPOINT_EVERY', 16))
+
+
+def batch_row_wall_s() -> float:
+    """Per-row retry wall: how long a row keeps retrying through LB
+    restarts / replica failovers before the job counts it failed."""
+    return _f('SKYTPU_BATCH_ROW_WALL_S', 90.0)

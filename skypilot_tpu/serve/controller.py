@@ -24,8 +24,19 @@ from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
 logger = logsys.init_logger(__name__)
 
 
+class BatchPlaneDisabled(RuntimeError):
+    """POST /v1/batches with no journal configured: a typed,
+    retryable 503 (the operator may be provisioning the path)."""
+
+
 class ServeController:
     """One per service; owns the autoscaler + replica manager."""
+
+    # Class-level defaults: the batch plane is optional, and several
+    # tests build bare controllers via __new__ — state_snapshot must
+    # not require the batch attrs to have been wired.
+    batch = None
+    lb_port: Optional[int] = None
 
     def __init__(self, service_name: str, spec: SkyTpuServiceSpec,
                  task_yaml: str, port: int):
@@ -95,6 +106,12 @@ class ServeController:
         # Set by service.py when the LB runs under a supervisor; its
         # stats() feed the state_snapshot 'load_balancer' block.
         self.lb_supervisor = None
+        # Batch plane (ISSUE 20): created lazily on the first
+        # POST /v1/batches — disabled (typed 503) until the operator
+        # sets SKYTPU_BATCH_JOURNAL.  lb_port is set by service.py
+        # once the LB is up; the coordinator dispatches rows there.
+        self.batch = None
+        self.lb_port: Optional[int] = None
 
     # ----------------------------------------------------------- HTTP API
 
@@ -200,7 +217,40 @@ class ServeController:
             self.replica_manager.scale_down(rid,
                                             purge=payload.get('purge', True))
             return {'terminated': rid}
+        if path == '/v1/batches':
+            b = self._ensure_batch()
+            jid = b.submit(
+                payload.get('prompts'),
+                payload.get('max_new_tokens'),
+                completion_window_s=float(
+                    payload.get('completion_window_s', 3600.0)),
+                tenant_id=payload.get('tenant_id'),
+                temperature=payload.get('temperature'),
+                job_id=payload.get('job_id'))
+            return {'job_id': jid, 'status': b.status(jid)}  # wire-ok: client-facing API field
+        if path.startswith('/v1/batches/'):
+            return self.batch_status(path[len('/v1/batches/'):])
         raise KeyError(path)
+
+    def _ensure_batch(self):
+        """The coordinator, or a typed 503 while the plane is off."""
+        if self.batch is None:
+            from skypilot_tpu.serve.batch import BatchCoordinator
+            path = constants.batch_journal_path()
+            if not path:
+                raise BatchPlaneDisabled(
+                    'batch plane disabled: set SKYTPU_BATCH_JOURNAL '
+                    'to a durable journal path')
+            from skypilot_tpu.jobs import state as jobs_state
+            self.batch = BatchCoordinator(
+                path, self.lb_port,
+                state_sink=jobs_state.record_batch_job)
+        return self.batch
+
+    def batch_status(self, job_id: str) -> dict:
+        if self.batch is None:
+            raise KeyError(job_id)
+        return self.batch.status(job_id)  # wire-ok: client-facing API field
 
     def state_snapshot(self) -> dict:
         """Per-replica failure-counter block for observability: replica
@@ -246,7 +296,9 @@ class ServeController:
         return {'service': self.service_name, 'version': self.version,  # wire-ok: CLI/debug surface
                 'replicas': replicas,
                 'qos': lb_tenant_qos,
-                'load_balancer': lb_block}
+                'load_balancer': lb_block,
+                'batch': (None if self.batch is None  # wire-ok: operator observability (batch backlog mirror)
+                          else self.batch.backlog())}
 
     def _serve_http(self) -> None:
         controller = self
@@ -258,6 +310,7 @@ class ServeController:
 
             def do_POST(self):  # noqa: N802
                 length = int(self.headers.get('Content-Length', 0))
+                headers = {}
                 try:
                     payload = json.loads(
                         self.rfile.read(length) or b'{}')
@@ -267,18 +320,46 @@ class ServeController:
                 except KeyError:
                     body = b'{"error": "not found"}'
                     self.send_response(404)
+                except ValueError as e:
+                    # Bad batch submission (non-greedy, malformed
+                    # prompts): the client's fault, typed as such.
+                    body = json.dumps(
+                        {'error': str(e),
+                         'error_class': 'client'}).encode()
+                    self.send_response(400)
+                except BatchPlaneDisabled as e:
+                    # Typed + retryable: the 5xx audit (ISSUE 20
+                    # satellite) bans untyped 5xx without Retry-After.
+                    body = json.dumps(
+                        {'error': str(e), 'error_class': 'batch_disabled',
+                         'retry_after_s': 5.0}).encode()
+                    headers['Retry-After'] = '5'
+                    self.send_response(503)
                 except Exception as e:  # pylint: disable=broad-except
-                    body = json.dumps({'error': str(e)}).encode()
+                    body = json.dumps(
+                        {'error': str(e),
+                         'error_class': 'internal'}).encode()
                     self.send_response(500)
+                for k, v in headers.items():
+                    self.send_header(k, v)
                 self.send_header('Content-Type', 'application/json')
                 self.send_header('Content-Length', str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
-                if self.path.split('?', 1)[0] == '/controller/state':
+                path = self.path.split('?', 1)[0]
+                if path == '/controller/state':
                     body = json.dumps(controller.state_snapshot()).encode()
                     self.send_response(200)
+                elif path.startswith('/v1/batches/'):
+                    try:
+                        body = json.dumps(controller.batch_status(
+                            path[len('/v1/batches/'):])).encode()
+                        self.send_response(200)
+                    except KeyError:
+                        body = b'{"error": "not found"}'
+                        self.send_response(404)
                 else:
                     body = b'{"error": "not found"}'
                     self.send_response(404)
@@ -317,6 +398,11 @@ class ServeController:
                 inflight=lb_inflight.get(r.get('endpoint'), 0),
             ) for r in serve_state.get_replicas(self.service_name)
         ]
+        if self.batch is not None and hasattr(
+                self.autoscaler, 'collect_batch_backlog'):
+            # Batch backlog feeds the SLO autoscaler: scale up to meet
+            # the completion window, release the surplus when it drains.
+            self.autoscaler.collect_batch_backlog(self.batch.backlog())
         update_in_progress = any(
             r.version < self.version and r.alive for r in replicas)
         if not update_in_progress:

@@ -373,7 +373,17 @@ class SkyTpuLoadBalancer:
             'hedge_wins': 0,
             'hedge_cancelled': 0,
             'retry_budget_exhausted': 0,
+            # Batch plane (ISSUE 20): batch-class rows routed, and row
+            # leases re-adopted (and released) from the journal after
+            # a warm restart — orphaned leases mean the old LB died
+            # with rows in flight; the coordinator's retry re-runs
+            # them, so adoption only has to account, not replay.
+            'batch_rows': 0,
+            'batch_leases_adopted': 0,
         }
+        # Live batch-row leases (journal-backed when a journal is
+        # configured): request_id -> 1 while the row relays.
+        self._batch_leases: set = set()  # guarded-by: _stats_lock
         # LB-side QoS plane: per-tenant token buckets (serve/qos.py)
         # share the LB's injected clock so rate-limit tests replay
         # deterministically.
@@ -516,6 +526,17 @@ class SkyTpuLoadBalancer:
                 for url, v in tp.items():
                     self._replica_tp[url] = int(v)
                     urls.add(url)
+        # Batch-row leases the dead LB held at the crash: account for
+        # them (the coordinator's retry re-runs the rows; exactly-once
+        # comes from the row hash dedup, not from the lease), then
+        # release so compaction clears the keys.
+        orphaned = [k for k, doc in snap.items()
+                    if k.startswith(self._JOURNAL_LEASE_PREFIX) and
+                    isinstance(doc, dict) and doc.get('held')]
+        if orphaned:
+            self._bump('batch_leases_adopted', len(orphaned))
+            for key in orphaned:
+                self.journal.put(key, {'held': False})
         with self._health_lock:
             self._adopted_unverified |= urls
         for url in sorted(urls):
@@ -1203,12 +1224,50 @@ class SkyTpuLoadBalancer:
                 headers={'Retry-After':
                          str(max(1, int(math.ceil(retry_after))))})
             return
-        if route is None:
-            self._handle_passthrough(handler, body)
-        elif route['stream']:
-            self._handle_stream_generate(handler, route)
-        else:
-            self._handle_buffered_generate(handler, route)
+        lease = self._batch_lease_acquire(route)
+        try:
+            if route is None:
+                self._handle_passthrough(handler, body)
+            elif route['stream']:
+                self._handle_stream_generate(handler, route)
+            else:
+                self._handle_buffered_generate(handler, route)
+        finally:
+            self._batch_lease_release(lease)
+
+    _JOURNAL_LEASE_PREFIX = 'lease:'
+
+    def _batch_lease_acquire(self,
+                             route: Optional[dict]) -> Optional[str]:
+        """Journal a row lease for a batch-class generate: a warm
+        restart can then tell exactly which rows died with the old
+        process (adopted + released on restart; the coordinator's
+        retry is the actual replay path)."""
+        if route is None or route.get('priority') != 'batch':
+            return None
+        self._bump('batch_rows')
+        payload = route.get('payload')
+        rid = payload.get('request_id') if isinstance(payload,
+                                                      dict) else None
+        if not isinstance(rid, str) or not rid:
+            return None
+        with self._stats_lock:
+            self._batch_leases.add(rid)
+        if self.journal is not None:
+            # Flushed, not fsync'd: losing a lease record costs one
+            # adoption count, never a row (rows dedup by hash).
+            self.journal.put(self._JOURNAL_LEASE_PREFIX + rid,
+                             {'held': True})
+        return rid
+
+    def _batch_lease_release(self, rid: Optional[str]) -> None:
+        if rid is None:
+            return
+        with self._stats_lock:
+            self._batch_leases.discard(rid)
+        if self.journal is not None:
+            self.journal.put(self._JOURNAL_LEASE_PREFIX + rid,
+                             {'held': False})
 
     @staticmethod
     def _peek_tenant(body: Optional[bytes]) -> Optional[str]:
@@ -1255,14 +1314,24 @@ class SkyTpuLoadBalancer:
             pass
 
     def _no_replica_response(self, handler, deadline_spent: bool) -> None:
+        # Typed on both branches (ISSUE 20 satellite: no untyped 5xx):
+        # the deadline 504 is final (the client's budget is spent, a
+        # retry cannot help), the no-replica 503 is retryable and says
+        # when.
         if deadline_spent:
             self._bump('deadline_exhausted')
             self._send_json(handler, 504, {
                 'error': 'deadline_s exhausted before any replica '
-                         'completed the request'})
+                         'completed the request',
+                'error_class': 'deadline'})
         else:
-            self._send_json(handler, 503,
-                            {'error': 'no ready replicas'})
+            self._send_json(
+                handler, 503,
+                {'error': 'no ready replicas',
+                 'error_class': 'no_replica',
+                 'retry_after_s': self._RETRY_AFTER_S},
+                headers={'Retry-After':
+                         str(int(self._RETRY_AFTER_S))})
 
     def _handle_passthrough(self, handler, body: Optional[bytes]) -> None:
         """The original streaming proxy: raw byte relay (OpenAI SSE
@@ -1297,9 +1366,7 @@ class SkyTpuLoadBalancer:
                     continue
                 self._rep(replica).breaker.record_failure()
                 if not self._retry_budget_spend():
-                    self._send_json(handler, 503, {
-                        'error': self._RETRY_BUDGET_MSG,
-                        'error_class': 'retry_budget'})
+                    self._retry_budget_response(handler)
                     return
                 logger.warning('LB: replica %s unreachable, retrying',
                                replica)
@@ -1362,9 +1429,7 @@ class SkyTpuLoadBalancer:
             self._rep(replica).breaker.record_failure()
             had_break |= outcome == 'broken'
             if not self._retry_budget_spend():
-                self._send_json(handler, 503, {
-                    'error': self._RETRY_BUDGET_MSG,
-                    'error_class': 'retry_budget'})
+                self._retry_budget_response(handler)
                 return
             logger.warning('LB: replica %s %s, retrying elsewhere',
                            replica, outcome)
@@ -1389,14 +1454,28 @@ class SkyTpuLoadBalancer:
 
     _RETRY_BUDGET_MSG = ('retry budget exhausted: the fleet is failing '
                          'faster than it is succeeding; not retrying')
+    # Hint on retryable LB 503s (retry_budget / no_replica): the
+    # reserve trickle refills ~1 token/10 s at defaults, and probe
+    # rounds re-admit replicas on the same order — batch coordinators
+    # and interactive clients both honor it.
+    _RETRY_AFTER_S = 1.0
+
+    def _retry_budget_response(self, handler) -> None:
+        """The typed, retryable 503 every budget-dry path answers —
+        one shape (error_class + Retry-After) on the buffered, stream,
+        and passthrough paths alike (ISSUE 20 satellite)."""
+        self._send_json(
+            handler, 503,
+            {'error': self._RETRY_BUDGET_MSG,
+             'error_class': 'retry_budget',
+             'retry_after_s': self._RETRY_AFTER_S},
+            headers={'Retry-After': str(int(self._RETRY_AFTER_S))})
 
     def _stream_budget_exhausted(self, handler, relay: _SSERelay) -> None:
         if relay.headers_sent:
             relay.emit_error_event(self._RETRY_BUDGET_MSG, 'retry_budget')
         else:
-            self._send_json(handler, 503, {
-                'error': self._RETRY_BUDGET_MSG,
-                'error_class': 'retry_budget'})
+            self._retry_budget_response(handler)
 
     def _attempt_stream(self, replica: str, route: dict, payload: dict,
                         relay, timeout: float) -> str:
@@ -1652,7 +1731,9 @@ class SkyTpuLoadBalancer:
             host_tier['restore_hit_rate'] = sum(rates) / len(rates)
         with self._stats_lock:
             counters = dict(self._counters)
+            batch_inflight = len(self._batch_leases)
         counters.update({
+            'batch_rows_inflight': batch_inflight,
             'kv_host_tier': host_tier,
             'breaker_opens': breaker_opens,  # wire-ok: operator metrics surface
             'breaker_open_now': open_now,

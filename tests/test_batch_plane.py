@@ -1,0 +1,563 @@
+"""Durable batch plane (PR 20): exactly-once row accounting, resume
+after a coordinator crash, the controller's /v1/batches surface, the
+LB's row-lease journal, the autoscaler's backlog term, and the typed
+5xx shapes the satellite audit pins.
+
+Everything here runs without an engine: the coordinator takes an
+injected ``transport(payload, wall_s) -> terminal_event`` callable, so
+row dispatch is a deterministic pure function and the journal/spool
+machinery is what's under test.  The end-to-end path (real LB, real
+replicas, real kills) lives in ``scripts/chaos_smoke.py --batch``.
+"""
+import json
+import os
+import socket
+import threading
+import time
+from http.client import HTTPConnection
+
+import pytest
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve.autoscalers import DecisionOperator
+from skypilot_tpu.serve.batch import BatchCoordinator, row_hash
+from skypilot_tpu.serve.lb_journal import LBJournal
+from skypilot_tpu.serve.load_balancer import SkyTpuLoadBalancer
+from skypilot_tpu.serve.load_balancing_policies import LoadBalancingPolicy
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import SkyTpuServiceSpec
+
+
+def _row_idx(payload: dict) -> int:
+    return int(payload['request_id'].rsplit(':', 1)[1])
+
+
+def _greedy_out(payload: dict):
+    """What the fake replica deterministically answers for a row."""
+    return list(reversed(payload['tokens']))[:payload['max_new_tokens']]
+
+
+def _fake_transport(calls=None, fail_once=()):
+    """Deterministic row transport: reversed prompt, 'length' finish.
+    Rows in ``fail_once`` raise on their FIRST attempt (the retry
+    path), then succeed."""
+    failed = set()
+    lock = threading.Lock()
+
+    def send(payload, wall_s):
+        idx = _row_idx(payload)
+        with lock:
+            if calls is not None:
+                calls.append(idx)
+            if idx in fail_once and idx not in failed:
+                failed.add(idx)
+                raise RuntimeError('injected row failure')
+        return {'output_tokens': _greedy_out(payload),
+                'finish_reason': 'length', 'done': True}
+
+    return send
+
+
+def _mk_coord(tmp_path, **kw):
+    kw.setdefault('transport', _fake_transport())
+    kw.setdefault('spool_dir', str(tmp_path / 'spool'))
+    kw.setdefault('row_workers', 2)
+    return BatchCoordinator(str(tmp_path / 'batch.jsonl'), **kw)
+
+
+# ------------------------------------------------------- coordinator
+
+
+def test_batch_job_completes_with_ordered_output(tmp_path):
+    coord = _mk_coord(tmp_path)
+    prompts = [[i + 1, i + 2, i + 3] for i in range(8)]
+    jid = coord.submit(prompts, 4, job_id='j1')
+    assert coord.join(jid, 30)
+    st = coord.status(jid)
+    assert st['state'] == 'done'
+    assert st['completed'] == 8
+    assert st['duplicates'] == 0
+    assert st['determinism_violations'] == 0
+    with open(coord.result_path(jid), encoding='utf-8') as fh:
+        rows = [json.loads(line) for line in fh]
+    assert [r['row'] for r in rows] == list(range(8))
+    for i, r in enumerate(rows):
+        want = list(reversed(prompts[i]))
+        assert r['output_tokens'] == want
+        assert r['hash'] == row_hash(want, 'length')
+    coord.stop()
+
+
+def test_batch_submit_validation(tmp_path):
+    coord = _mk_coord(tmp_path)
+    with pytest.raises(ValueError, match='greedy-only'):
+        coord.submit([[1, 2]], 4, temperature=0.7)
+    with pytest.raises(ValueError, match='prompts'):
+        coord.submit([], 4)
+    with pytest.raises(ValueError, match='prompts'):
+        coord.submit([[1, 'x']], 4)
+    with pytest.raises(ValueError, match='max_new_tokens'):
+        coord.submit([[1, 2]], 0)
+    jid = coord.submit([[1, 2]], 2, job_id='dup')
+    with pytest.raises(ValueError, match='already exists'):
+        coord.submit([[3]], 2, job_id='dup')
+    assert coord.join(jid, 30)
+    coord.stop()
+
+
+def test_batch_row_retry_then_success(tmp_path):
+    coord = _mk_coord(tmp_path, transport=_fake_transport(fail_once={2}))
+    jid = coord.submit([[i + 1, 9] for i in range(4)], 2, job_id='j1')
+    assert coord.join(jid, 30)
+    st = coord.status(jid)
+    assert st['state'] == 'done'
+    assert st['completed'] == 4
+    assert st['retries'] == 1
+    coord.stop()
+
+
+def _seed_crashed_job(tmp_path, prompts, done_rows, torn_row=None,
+                      bad_digest_row=None):
+    """Hand-write the journal + spool a crashed coordinator would
+    leave behind: job 'running', ``done_rows`` fully recorded,
+    ``torn_row`` journaled but its spool write torn, ``bad_digest_row``
+    journaled with a digest the deterministic replay cannot match."""
+    jpath = str(tmp_path / 'batch.jsonl')
+    spool = str(tmp_path / 'spool')
+    os.makedirs(os.path.join(spool, 'j1'))
+    j = LBJournal(jpath, clock=lambda: 0.0)
+    j.put('job:j1', {'job_id': 'j1', 'prompts': prompts,
+                     'max_new_tokens': 8, 'completion_window_s': 3600.0,
+                     'tenant_id': None, 'state': 'running',
+                     'n_rows': len(prompts), 'submitted_at': 0.0,
+                     'duplicates': 0, 'retries': 0,
+                     'determinism_violations': 0}, fsync=True)
+    for i in done_rows:
+        out = list(reversed(prompts[i]))
+        h = row_hash(out, 'length')
+        j.put(f'row:j1:{i}', {'hash': h})
+        with open(os.path.join(spool, 'j1', f'{i}.json'), 'w',
+                  encoding='utf-8') as fh:
+            json.dump({'hash': h, 'output_tokens': out,
+                       'finish_reason': 'length'}, fh)
+    if torn_row is not None:
+        out = list(reversed(prompts[torn_row]))
+        j.put(f'row:j1:{torn_row}', {'hash': row_hash(out, 'length')})
+    if bad_digest_row is not None:
+        j.put(f'row:j1:{bad_digest_row}', {'hash': 'deadbeef'})
+    j.close()
+    return jpath, spool
+
+
+def test_batch_resume_runs_only_unfinished_rows(tmp_path):
+    """Coordinator death: the successor re-dispatches ONLY rows whose
+    journal digest + spool payload don't both check out.  A journaled
+    row with a torn spool re-runs, dedups by digest, and heals the
+    spool without a second journal write."""
+    prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+    jpath, spool = _seed_crashed_job(tmp_path, prompts,
+                                     done_rows=(0, 1, 2), torn_row=3)
+    calls = []
+    coord = BatchCoordinator(jpath, transport=_fake_transport(calls),
+                             spool_dir=spool, row_workers=2)
+    assert coord.join('j1', 30)
+    st = coord.status('j1')
+    assert st['state'] == 'done'
+    assert st['completed'] == 6
+    assert sorted(calls) == [3, 4, 5]       # rows 0-2 never re-ran
+    assert st['duplicates'] == 1            # row 3's replay deduped
+    with open(coord.result_path('j1'), encoding='utf-8') as fh:
+        rows = [json.loads(line) for line in fh]
+    assert [r['row'] for r in rows] == list(range(6))
+    assert rows[3]['output_tokens'] == list(reversed(prompts[3]))
+    coord.stop()
+
+
+def test_batch_recovery_of_finished_job_is_a_noop(tmp_path):
+    prompts = [[i + 1, 7] for i in range(4)]
+    jpath, spool = _seed_crashed_job(tmp_path, prompts,
+                                     done_rows=range(4))
+    calls = []
+    coord = BatchCoordinator(jpath, transport=_fake_transport(calls),
+                             spool_dir=spool, row_workers=2)
+    assert coord.join('j1', 30)
+    assert coord.status('j1')['state'] == 'done'
+    assert calls == []                      # nothing re-dispatched
+    assert os.path.exists(coord.result_path('j1'))
+    coord.stop()
+
+
+def test_batch_determinism_violation_fails_job(tmp_path):
+    """A replayed row whose greedy bytes hash differently from the
+    journaled digest is silent corruption — the job must fail loudly,
+    never overwrite the spool."""
+    prompts = [[i + 1, 5] for i in range(3)]
+    jpath, spool = _seed_crashed_job(tmp_path, prompts, done_rows=(1,),
+                                     bad_digest_row=0)
+    coord = BatchCoordinator(jpath, transport=_fake_transport(),
+                             spool_dir=spool, row_workers=1)
+    assert coord.join('j1', 30)
+    st = coord.status('j1')
+    assert st['state'] == 'failed'
+    assert st['determinism_violations'] == 1
+    assert 'hash mismatch' in st['error']
+    coord.stop()
+
+
+def test_batch_crash_stop_preserves_state_for_successor(tmp_path):
+    """stop() is a crash, not a drain: job state stays 'running' in
+    the journal and a successor coordinator finishes the remainder."""
+    gate = threading.Event()
+    first_done = threading.Event()
+
+    def gated(payload, wall_s):
+        idx = _row_idx(payload)
+        if idx > 0:
+            first_done.set()
+            gate.wait(10)
+            raise OSError('coordinator crashed mid-row')
+        out = {'output_tokens': _greedy_out(payload),
+               'finish_reason': 'length', 'done': True}
+        first_done.set()
+        return out
+
+    spool = str(tmp_path / 'spool')
+    jpath = str(tmp_path / 'batch.jsonl')
+    coord = BatchCoordinator(jpath, transport=gated, spool_dir=spool,
+                             row_workers=1)
+    jid = coord.submit([[1, 2], [3, 4], [5, 6]], 2, job_id='j1')
+    assert first_done.wait(10)
+    gate.set()
+    coord.stop()
+    st = coord.status(jid)
+    assert st['state'] == 'running'         # crash-stop: no state edge
+    coord2 = BatchCoordinator(jpath, transport=_fake_transport(),
+                              spool_dir=spool, row_workers=2)
+    resumed = coord2.status(jid)
+    assert resumed['completed'] >= st['completed']
+    assert coord2.join(jid, 30)
+    assert coord2.status(jid)['state'] == 'done'
+    assert coord2.status(jid)['completed'] == 3
+    coord2.stop()
+
+
+def test_batch_backlog_and_rate_signal(tmp_path):
+    """backlog() feeds the autoscaler: rows_remaining while running,
+    rows/s EWMA off the injected clock (one row per simulated second
+    -> 1.0), empty once done."""
+    t = [0.0]
+
+    def timed(payload, wall_s):
+        t[0] += 1.0
+        return {'output_tokens': _greedy_out(payload),
+                'finish_reason': 'length', 'done': True}
+
+    gate = threading.Event()
+
+    def gated(payload, wall_s):
+        gate.wait(10)
+        return timed(payload, wall_s)
+
+    coord = BatchCoordinator(str(tmp_path / 'batch.jsonl'),
+                             transport=gated,
+                             spool_dir=str(tmp_path / 'spool'),
+                             row_workers=1, clock=lambda: t[0])
+    jid = coord.submit([[i + 1, 3] for i in range(5)], 2,
+                       completion_window_s=500.0, job_id='j1')
+    b = coord.backlog()
+    assert b['jobs'] == 1
+    assert b['rows_remaining'] == 5
+    assert b['window_remaining_s'] == pytest.approx(500.0)
+    gate.set()
+    assert coord.join(jid, 30)
+    b = coord.backlog()
+    assert b['jobs'] == 0 and b['rows_remaining'] == 0
+    assert coord._rows_per_s == pytest.approx(1.0)
+    coord.stop()
+
+
+# ----------------------------------------------- controller surface
+
+
+def test_controller_batch_routes(tmp_path, monkeypatch):
+    from skypilot_tpu.serve.controller import (BatchPlaneDisabled,
+                                               ServeController)
+    monkeypatch.delenv('SKYTPU_BATCH_JOURNAL', raising=False)
+    ctl = ServeController.__new__(ServeController)
+    ctl.batch = None
+    ctl.lb_port = None
+    with pytest.raises(BatchPlaneDisabled):
+        ctl._handle('/v1/batches', {'prompts': [[1, 2]],
+                                    'max_new_tokens': 2})
+    ctl.batch = _mk_coord(tmp_path)
+    res = ctl._handle('/v1/batches', {'prompts': [[1, 2], [3, 4]],
+                                      'max_new_tokens': 2})
+    jid = res['job_id']
+    assert res['status']['n_rows'] == 2
+    assert ctl.batch.join(jid, 30)
+    st = ctl._handle(f'/v1/batches/{jid}', {})
+    assert st['state'] == 'done' and st['completed'] == 2
+    with pytest.raises(KeyError):
+        ctl._handle('/v1/batches/no-such-job', {})
+    ctl.batch.stop()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _post(port, path, payload):
+    conn = HTTPConnection('127.0.0.1', port, timeout=10)
+    try:
+        conn.request('POST', path, body=json.dumps(payload).encode(),
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), \
+            json.loads(resp.read() or b'{}')
+    finally:
+        conn.close()
+
+
+def test_controller_batch_http_error_shapes(tmp_path, monkeypatch):
+    """Satellite audit: every batch-path 5xx is typed, and retryable
+    ones carry Retry-After.  400 for client mistakes (non-greedy),
+    503 + Retry-After while the plane is disabled, 404 for unknown
+    jobs."""
+    monkeypatch.setenv('HOME', str(tmp_path))
+    monkeypatch.delenv('SKYTPU_BATCH_JOURNAL', raising=False)
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.controller import ServeController
+    yaml_path = str(tmp_path / 't.yaml')
+    with open(yaml_path, 'w', encoding='utf-8') as fh:
+        fh.write('run: echo hi\n')
+    spec = SkyTpuServiceSpec(min_replicas=1)
+    port = _free_port()
+    serve_state.add_service('svc', port, port + 1, 'round_robin',
+                            spec.to_json(), yaml_path, 1)
+    c = ServeController('svc', spec, yaml_path, port)
+    th = threading.Thread(target=c._serve_http, daemon=True)
+    th.start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(('127.0.0.1', port),
+                                          timeout=0.2):
+                break
+        except OSError:
+            time.sleep(0.05)
+    try:
+        # Plane disabled: typed, retryable 503.
+        status, headers, body = _post(port, '/v1/batches',
+                                      {'prompts': [[1, 2]],
+                                       'max_new_tokens': 2})
+        assert status == 503
+        assert body['error_class'] == 'batch_disabled'
+        assert body['retry_after_s'] == 5.0
+        assert headers.get('Retry-After') == '5'
+        # Client mistake: typed 400, no Retry-After.
+        c.batch = _mk_coord(tmp_path)
+        status, headers, body = _post(port, '/v1/batches',
+                                      {'prompts': [[1, 2]],
+                                       'max_new_tokens': 2,
+                                       'temperature': 0.9})
+        assert status == 400
+        assert body['error_class'] == 'client'
+        assert 'Retry-After' not in headers
+        # Happy path through HTTP, then job-status GET.
+        status, _, body = _post(port, '/v1/batches',
+                                {'prompts': [[1, 2]],
+                                 'max_new_tokens': 2})
+        assert status == 200
+        jid = body['job_id']
+        assert c.batch.join(jid, 30)
+        conn = HTTPConnection('127.0.0.1', port, timeout=10)
+        conn.request('GET', f'/v1/batches/{jid}')
+        resp = conn.getresponse()
+        st = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200 and st['state'] == 'done'
+        conn = HTTPConnection('127.0.0.1', port, timeout=10)
+        conn.request('GET', '/v1/batches/nope')
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 404
+    finally:
+        if c.batch is not None:
+            c.batch.stop()
+        if c._httpd is not None:
+            c._httpd.shutdown()
+        th.join(10)
+
+
+# -------------------------------------------------- LB batch surface
+
+
+class _FakeHandler:
+    """Just enough of BaseHTTPRequestHandler for _send_json."""
+
+    def __init__(self):
+        self.status = None
+        self.headers = {}
+        outer = self
+
+        class _W:
+
+            @staticmethod
+            def write(b):
+                outer.body = getattr(outer, 'body', b'') + b
+
+        self.wfile = _W()
+        self.body = b''
+
+    def send_response(self, code):
+        self.status = code
+
+    def send_header(self, k, v):
+        self.headers[k] = v
+
+    def end_headers(self):
+        pass
+
+
+def _mk_lb(journal=None):
+    policy = LoadBalancingPolicy.make('round_robin')
+    return SkyTpuLoadBalancer(None, 0, policy, clock=lambda: 0.0,
+                              journal=journal)
+
+
+def test_lb_typed_5xx_shapes_carry_retry_after():
+    """Satellite audit regression pins: retry-budget 503 and
+    no-replica 503 are typed AND say when to come back; the deadline
+    504 is typed and final (no Retry-After — retrying cannot help)."""
+    lb = _mk_lb()
+    h = _FakeHandler()
+    lb._retry_budget_response(h)
+    body = json.loads(h.body)
+    assert h.status == 503
+    assert body['error_class'] == 'retry_budget'
+    assert body['retry_after_s'] == 1.0
+    assert h.headers['Retry-After'] == '1'
+
+    h = _FakeHandler()
+    lb._no_replica_response(h, deadline_spent=False)
+    body = json.loads(h.body)
+    assert h.status == 503
+    assert body['error_class'] == 'no_replica'
+    assert body['retry_after_s'] == 1.0
+    assert h.headers['Retry-After'] == '1'
+
+    h = _FakeHandler()
+    lb._no_replica_response(h, deadline_spent=True)
+    body = json.loads(h.body)
+    assert h.status == 504
+    assert body['error_class'] == 'deadline'
+    assert 'Retry-After' not in h.headers
+
+
+def test_lb_batch_row_leases_journal_and_adopt(tmp_path):
+    """A batch-class generate journals a row lease; an LB that dies
+    holding leases hands them to its successor, which counts and
+    releases them (the coordinator's retry is the replay path)."""
+    path = str(tmp_path / 'lb.jsonl')
+    lb = _mk_lb(journal=LBJournal(path, clock=lambda: 0.0))
+    route = {'priority': 'batch', 'payload': {'request_id': 'batch:j:0'},
+             'stream': True}
+    rid = lb._batch_lease_acquire(route)
+    assert rid == 'batch:j:0'
+    stats = lb.lb_stats()
+    assert stats['batch_rows'] == 1
+    assert stats['batch_rows_inflight'] == 1
+    # Clean release drops the lease.
+    lb._batch_lease_release(rid)
+    assert lb.lb_stats()['batch_rows_inflight'] == 0
+    # Interactive traffic never takes a lease.
+    assert lb._batch_lease_acquire(
+        {'priority': 'interactive',
+         'payload': {'request_id': 'x'}}) is None
+    # Crash while holding a lease: the successor adopts + releases.
+    lb._batch_lease_acquire(route)
+    lb2 = _mk_lb(journal=LBJournal(path, clock=lambda: 0.0))
+    stats2 = lb2.lb_stats()
+    assert stats2['batch_leases_adopted'] == 1
+    assert stats2['batch_rows_inflight'] == 0
+    # A third generation sees nothing held: adoption released it.
+    lb3 = _mk_lb(journal=LBJournal(path, clock=lambda: 0.0))
+    assert lb3.lb_stats()['batch_leases_adopted'] == 0
+
+
+# ------------------------------------------------ autoscaler backlog
+
+
+def _views(n):
+    return [autoscalers.ReplicaView(replica_id=i,
+                                    status=ReplicaStatus.READY,
+                                    version=1, is_spot=False)
+            for i in range(n)]
+
+
+def test_autoscaler_batch_backlog_term(monkeypatch):
+    """Backlog that cannot meet its completion window scales the
+    fleet up while interactive p99 holds SLO; scale-down drains batch
+    capacity first (blocked while n-1 replicas would blow the
+    window)."""
+    spec = SkyTpuServiceSpec(min_replicas=1, max_replicas=6,
+                             slo_ttft_ms=200.0,
+                             upscale_delay_seconds=10.0,
+                             downscale_delay_seconds=20.0)
+    a = autoscalers.Autoscaler.make(spec)
+    assert isinstance(a, autoscalers.SloLatencyAutoscaler)
+    now = [1000.0]
+    monkeypatch.setattr(a, '_now', lambda: now[0])
+    # Interactive healthy; backlog projects past the window at the
+    # current fleet size -> pressure through the same hysteresis.
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 50.0,
+                                          'count': 9}})
+    a.collect_batch_backlog({'jobs': 1, 'rows_remaining': 1000,
+                             'window_remaining_s': 10.0,
+                             'rows_per_s': 1.0})
+    assert a.evaluate_scaling(_views(2)) == []      # timer starts
+    now[0] += 11.0
+    d = a.evaluate_scaling(_views(2))
+    assert [x.operator for x in d] == [DecisionOperator.SCALE_UP]
+    # Backlog with no rate signal yet is pessimistic: still pressure.
+    a.collect_batch_backlog({'jobs': 1, 'rows_remaining': 5,
+                             'window_remaining_s': 1000.0,
+                             'rows_per_s': None})
+    assert a.evaluate_scaling(_views(2)) == []
+    now[0] += 11.0
+    d = a.evaluate_scaling(_views(2))
+    assert [x.operator for x in d] == [DecisionOperator.SCALE_UP]
+    # Interactive BREACH outranks batch: no double count, the breach
+    # branch is the one that fires.
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 400.0,
+                                          'count': 9}})
+    assert a.evaluate_scaling(_views(2)) == []
+    # Comfortable latency, window at risk for n-1: downscale blocked.
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 40.0,
+                                          'count': 9}})
+    a.collect_batch_backlog({'jobs': 1, 'rows_remaining': 100,
+                             'window_remaining_s': 40.0,
+                             'rows_per_s': 3.0})   # n-1=2: 50s > 40s
+    now[0] += 100.0
+    assert a.evaluate_scaling(_views(3)) == []
+    now[0] += 100.0
+    assert a.evaluate_scaling(_views(3)) == []     # held, not delayed
+    # Window comfortable even one replica down: drain batch surplus.
+    a.collect_batch_backlog({'jobs': 1, 'rows_remaining': 100,
+                             'window_remaining_s': 60.0,
+                             'rows_per_s': 3.0})   # n-1=2: 50s <= 60s
+    assert a.evaluate_scaling(_views(3)) == []      # timer starts
+    now[0] += 21.0
+    d = a.evaluate_scaling(_views(3))
+    assert [x.operator for x in d] == [DecisionOperator.SCALE_DOWN]
+    # No backlog at all: pure-latency behavior is unchanged.
+    a.collect_batch_backlog(None)
+    a.collect_latency_information({'u1': {'ttft_p95_ms': 40.0,
+                                          'count': 9}})
+    assert a.evaluate_scaling(_views(2)) == []
+    now[0] += 21.0
+    d = a.evaluate_scaling(_views(2))
+    assert [x.operator for x in d] == [DecisionOperator.SCALE_DOWN]

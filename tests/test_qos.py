@@ -100,6 +100,52 @@ def test_wfq_requeue_is_front_of_lane_and_not_recharged():
     assert s.backlog() == 0
 
 
+def test_wfq_shed_victim_prefers_over_share_tenant():
+    """Parked since PR 8: when projection forces a shed, the victim
+    is the MOST-over-fair-share tenant's most recent deadline-bearing
+    row (batch before interactive), not whatever FIFO order surfaces
+    — and never a row that could still make its deadline."""
+    s = iqos.WfqScheduler()
+    # 'hog' has consumed far more weight-normalised service.
+    s.served = {'hog': 100.0, 'meek': 1.0}
+    h_int = _req('h-int', tenant_id='hog', deadline_s=1.0)
+    h_old = _req('h-old', tenant_id='hog', deadline_s=1.0,
+                 priority='batch')
+    h_new = _req('h-new', tenant_id='hog', deadline_s=1.0,
+                 priority='batch')
+    h_free = _req('h-free', tenant_id='hog', priority='batch')
+    m1 = _req('m1', tenant_id='meek', deadline_s=1.0)
+    for r in (h_int, h_old, h_new, h_free, m1):
+        s.push(r)
+    depth = s.backlog()
+    # No tenant strictly more over-share than the hog itself.
+    assert s.shed_victim(prefer_over='hog') is None
+    # The meek tenant's shed picks the hog: batch class first, lane
+    # TAIL first — and never the no-deadline row at the actual tail.
+    v = s.shed_victim(prefer_over='meek')
+    assert v is h_new
+    assert s.backlog() == depth - 1
+    # A doomed predicate can veto fairness: only rows that cannot
+    # meet their own deadline are eligible.
+    assert s.shed_victim(prefer_over='meek',
+                         doomed=lambda r: False) is None
+    v = s.shed_victim(prefer_over='meek',
+                      doomed=lambda r: r.request_id == 'h-int')
+    assert v is h_int
+    # The older hog batch row is next in line (tail-first ordering).
+    assert s.shed_victim(prefer_over='meek') is h_old
+    # Victims are gone from the pop stream; the no-deadline batch row
+    # and the meek row survive — no-deadline work is NEVER shed.
+    assert s.shed_victim(prefer_over='meek') is None
+    got = {s.pop().request_id for _ in range(2)}
+    assert got == {'m1', 'h-free'}
+    assert s.pop() is None
+    # With no floor at all, the most over-share deadline row is shed.
+    s.push(_req('h-again', tenant_id='hog', deadline_s=1.0))
+    s.push(_req('m-again', tenant_id='meek', deadline_s=1.0))
+    assert s.shed_victim().request_id == 'h-again'
+
+
 def test_service_estimator_ewma_and_projection():
     est = iqos.ServiceEstimator(alpha=0.5)
     assert est.rate() is None
@@ -525,6 +571,7 @@ def test_controller_ingests_qos_and_latency_sync():
     ctl._lb_latency, ctl._lb_tp = {}, {}
     ctl._lb_probation, ctl._lb_retry_budget = [], None
     ctl._lb_journal_age, ctl.lb_supervisor = None, None
+    ctl.batch = None
     payload = {
         'request_timestamps': [],
         'tenant_qos': {'default_rate': 0.0,

@@ -650,16 +650,25 @@ def test_wire_golden_schema_snapshot():
         'drained', 'draining', 'inflight', 'kv', 'loop_alive',
         'model_ready', 'status'}
     assert sc['/lb/stats'].produced.always == {
-        'adopted_unverified', 'breaker_open_now', 'breaker_opens',
-        'draining_replicas', 'journal_age_s', 'kv_host_tier',
-        'outstanding', 'policy', 'probation_replicas', 'qos',
-        'ready_replicas', 'replica_latency', 'retry_budget_remaining'}
+        'adopted_unverified', 'batch_rows_inflight', 'breaker_open_now',
+        'breaker_opens', 'draining_replicas', 'journal_age_s',
+        'kv_host_tier', 'outstanding', 'policy', 'probation_replicas',
+        'qos', 'ready_replicas', 'replica_latency',
+        'retry_budget_remaining'}
     assert sc['/controller/state'].produced.always == {
-        'load_balancer', 'qos', 'replicas', 'service', 'version'}
+        'batch', 'load_balancer', 'qos', 'replicas', 'service',
+        'version'}
+    assert sc['/v1/batches.status'].produced.always == {
+        'job_id', 'state', 'n_rows', 'completed', 'pending',
+        'inflight', 'duplicates', 'retries', 'determinism_violations',
+        'window_remaining_s', 'error'}
+    assert sc['batch.backlog'].produced.always == {
+        'jobs', 'rows_remaining', 'window_remaining_s', 'rows_per_s'}
     # Stability invariant: NO surface key may be branch-dependent —
     # a mixed dense/paged fleet must see one schema.
     for name in ('/stats', '/healthz', '/healthz.kv', '/lb/stats',
-                 '/controller/state', 'engine.stats'):
+                 '/controller/state', 'engine.stats',
+                 '/v1/batches.status', 'batch.backlog'):
         assert sc[name].produced.sometimes == set(), (
             name, sc[name].produced.sometimes)
 
